@@ -1,0 +1,17 @@
+"""``deepspeed_tpu.comm`` — the communication facade (reference: deepspeed/comm/).
+
+Same op vocabulary as ``deepspeed.comm``; groups are mesh-axis names.
+"""
+
+from .comm import (ReduceOp, init_distributed, is_initialized, get_rank,
+                   get_world_size, get_local_rank, barrier, all_reduce,
+                   inference_all_reduce, all_gather, reduce_scatter,
+                   all_to_all_single, broadcast, ppermute, send_recv_next,
+                   send_recv_prev, axis_index, all_reduce_host,
+                   all_gather_host, reduce_scatter_host, all_to_all_host,
+                   configure, get_comms_logger, log_summary, CommsLogger,
+                   timed_host_op)
+from .mesh import (MESH_AXES, DENSE_DP_AXES, EXPERT_DP_AXES, MeshSpec,
+                   build_mesh, set_global_mesh, get_global_mesh, axis_size,
+                   dp_world_size, mp_world_size, pp_world_size, sp_world_size,
+                   ep_world_size)

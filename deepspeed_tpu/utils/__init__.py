@@ -1,0 +1,2 @@
+from .logging import logger, log_dist, print_json_dist, warn_once
+from .timer import SynchronizedWallClockTimer, ThroughputTimer

@@ -1,0 +1,29 @@
+"""Small compatibility layer over jax API drift.
+
+Keeps the rest of the framework on one spelling of shard_map regardless of
+jax version (0.8 experimental check_rep vs 0.9 jax.shard_map check_vma).
+"""
+
+import inspect
+import functools
+
+import jax
+
+
+@functools.lru_cache(None)
+def _shard_map_fn_and_kw():
+    if hasattr(jax, "shard_map"):
+        fn = jax.shard_map
+    else:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as fn
+    params = inspect.signature(fn).parameters
+    if "check_vma" in params:
+        return fn, "check_vma"
+    return fn, "check_rep"
+
+
+def shard_map(f, mesh, in_specs, out_specs, check=False):
+    """shard_map with replication checking off by default (our collectives
+    handle replication explicitly, as the reference's NCCL calls did)."""
+    fn, kw = _shard_map_fn_and_kw()
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **{kw: check})

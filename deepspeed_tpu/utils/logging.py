@@ -1,0 +1,83 @@
+"""Rank-aware logging.
+
+TPU-native analog of the reference logger (deepspeed/utils/logging.py): a
+process-rank-aware logger plus ``log_dist`` which logs only on the listed
+ranks. Rank here is the JAX process index rather than a torch.distributed
+rank.
+"""
+
+import logging
+import os
+import sys
+import functools
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class _LoggerFactory:
+    @staticmethod
+    def create_logger(name="DeepSpeedTPU", level=logging.INFO):
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = _LoggerFactory.create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DS_TPU_LOG_LEVEL", "info"), logging.INFO))
+
+
+def _process_rank():
+    # Avoid importing jax at module import time; cheap once initialized.
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the given process ranks (None/-1 = all)."""
+    my_rank = _process_rank()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+@functools.lru_cache(None)
+def warn_once(message):
+    logger.warning(message)
+
+
+def print_json_dist(message, ranks=None, path=None):
+    """Dump a dict as JSON from the given ranks (autotuner metrics exchange)."""
+    import json
+    my_rank = _process_rank()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        message["rank"] = my_rank
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(message, f)
+        else:
+            print(json.dumps(message))
+
+
+def should_log_le(max_log_level_str: str) -> bool:
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    max_log_level_str = max_log_level_str.lower()
+    if max_log_level_str not in LOG_LEVELS:
+        raise ValueError(f"{max_log_level_str} is not a valid log level")
+    return logger.getEffectiveLevel() <= LOG_LEVELS[max_log_level_str]

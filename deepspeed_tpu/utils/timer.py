@@ -1,0 +1,194 @@
+"""Wall-clock + throughput timers.
+
+TPU-native analog of the reference's SynchronizedWallClockTimer /
+ThroughputTimer (deepspeed/utils/timer.py). "Synchronized" here means we
+block on outstanding async XLA dispatches before reading the clock
+(jax arrays are dispatched asynchronously the way CUDA kernels are), via
+``jax.block_until_ready`` on a token the caller passes or
+``jax.effects_barrier`` when available.
+"""
+
+import time
+from .logging import log_dist
+
+try:
+    import psutil
+
+    PSUTIL_AVAILABLE = True
+except ImportError:  # pragma: no cover - psutil is normally present
+    PSUTIL_AVAILABLE = False
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+BACKWARD_INNER_MICRO_TIMER = "bwd_inner_microstep"
+BACKWARD_INNER_GLOBAL_TIMER = "bwd_inner"
+BACKWARD_REDUCE_MICRO_TIMER = "bwd_allreduce_microstep"
+BACKWARD_REDUCE_GLOBAL_TIMER = "bwd_allreduce"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+def _device_sync():
+    """Wait for all dispatched device work to finish before reading clocks."""
+    try:
+        import jax
+        jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Named timers that synchronize device work at start/stop."""
+
+    class Timer:
+        def __init__(self, name):
+            self.name_ = name
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.start_time = 0.0
+
+        def start(self):
+            assert not self.started_, f"{self.name_} timer has already been started"
+            _device_sync()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, reset=False):
+            assert self.started_, f"{self.name_} timer is not started"
+            _device_sync()
+            if reset:
+                self.elapsed_ = time.time() - self.start_time
+            else:
+                self.elapsed_ += time.time() - self.start_time
+            self.started_ = False
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started_ = False
+
+        def elapsed(self, reset=True):
+            started_ = self.started_
+            if self.started_:
+                self.stop()
+            elapsed_ = self.elapsed_
+            if reset:
+                self.reset()
+            if started_:
+                self.start()
+            return elapsed_
+
+        def mean(self):
+            return self.elapsed(reset=False)
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name):
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage():
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0) / (1024**3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+            return f"HBM in-use {in_use:.2f} GB | peak {peak:.2f} GB"
+        except Exception:
+            return "HBM stats unavailable"
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed_time:.2f}"
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
+        log_dist(string, ranks=ranks or [0])
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        means = {}
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].mean() * 1000.0 / normalizer
+                means[name] = elapsed_time
+                if reset:
+                    self.timers[name].reset()
+        return means
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPS estimation across train steps."""
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.step_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or log_dist
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.time()
+
+    def stop(self, global_step=False, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _device_sync()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step:
+                if report_speed and self.global_step_count % self.steps_per_output == 0:
+                    self.logging(
+                        f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                        f"global_step={self.global_step_count}, "
+                        f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.6f}, "
+                        f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.6f}, "
+                        f"MemAllocated={SynchronizedWallClockTimer.memory_usage()}")
+                self.step_elapsed_time = 0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > 0 and self.total_elapsed_time > 0:
+            samples_per_step = self.batch_size
+            total_step_offset = self.global_step_count - self.start_step
+            if total_step_offset <= 0:
+                return 0.0
+            avg_time_per_step = self.total_elapsed_time / total_step_offset
+            return samples_per_step / avg_time_per_step
+        return 0.0

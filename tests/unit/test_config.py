@@ -1,0 +1,108 @@
+"""Config tests, mirroring reference tests/unit/test_config.py +
+test_ds_config.py (batch arithmetic, precision exclusivity, sub-config parse)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+
+
+def test_batch_arithmetic_all_given():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+         "gradient_accumulation_steps": 2}, dp_world_size=8)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_arithmetic_infer_gas():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2}, dp_world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_arithmetic_infer_train():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_micro_batch_size_per_gpu": 4, "gradient_accumulation_steps": 3},
+        dp_world_size=2)
+    assert cfg.train_batch_size == 24
+
+
+def test_batch_arithmetic_only_train():
+    cfg = DeepSpeedConfig.from_dict({"train_batch_size": 16}, dp_world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_arithmetic_mismatch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.from_dict(
+            {"train_batch_size": 30, "train_micro_batch_size_per_gpu": 2,
+             "gradient_accumulation_steps": 2}, dp_world_size=8)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.from_dict(
+            {"fp16": {"enabled": True}, "bf16": {"enabled": True}}, dp_world_size=1)
+
+
+def test_zero_stage_parse():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 8,
+         "zero_optimization": {"stage": 3, "offload_optimizer": {"device": "cpu"}},
+         "bf16": {"enabled": True}}, dp_world_size=8)
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_optimization.offload_optimizer_device == "cpu"
+    assert cfg.zero_optimization.offload_param_device == "none"
+
+
+def test_zero_invalid_stage():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.from_dict({"zero_optimization": {"stage": 5}}, dp_world_size=1)
+
+
+def test_legacy_cpu_offload_alias():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 8, "zero_optimization": {"stage": 2, "cpu_offload": True},
+         "bf16": {"enabled": True}}, dp_world_size=8)
+    assert cfg.zero_optimization.offload_optimizer_device == "cpu"
+
+
+def test_optimizer_scheduler_blocks():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 8,
+         "optimizer": {"type": "Adam", "params": {"lr": 1e-3, "betas": [0.9, 0.999]}},
+         "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}}},
+        dp_world_size=8)
+    assert cfg.optimizer.type == "Adam"
+    assert cfg.optimizer.params["lr"] == 1e-3
+    assert cfg.scheduler.type == "WarmupLR"
+
+
+def test_unknown_keys_warn_not_fail():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 8, "bogus_key": 1}, dp_world_size=8)
+    assert cfg.train_batch_size == 8
+
+
+def test_mesh_block():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 8, "mesh": {"model": 2, "fsdp": 2}}, dp_world_size=2)
+    assert cfg.mesh.model == 2
+    assert cfg.mesh.fsdp == 2
+    assert cfg.mesh.data == -1
+
+
+def test_fp16_dynamic_loss_scale():
+    cfg = DeepSpeedConfig.from_dict(
+        {"train_batch_size": 8, "fp16": {"enabled": True, "initial_scale_power": 8}},
+        dp_world_size=8)
+    assert cfg.fp16.dynamic_loss_scale
+    assert cfg.fp16.initial_scale_power == 8
+
+
+def test_to_dict_roundtrip():
+    cfg = DeepSpeedConfig.from_dict({"train_batch_size": 8}, dp_world_size=8)
+    d = cfg.to_dict()
+    assert d["train_batch_size"] == 8
+    assert "_raw" not in d
