@@ -1,0 +1,99 @@
+"""Benchmark driver: GPT-2 training throughput on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": "gpt2_125m_train_tokens_per_sec_per_chip", "value": N,
+   "unit": "tokens/s/chip", "vs_baseline": R}
+
+vs_baseline is measured against REF_TOKENS_PER_SEC_PER_CHIP, a stand-in for
+the reference stack's per-accelerator training throughput on its own
+headline benchmarks (BASELINE.md: DeepSpeed's published V100-class numbers;
+no in-repo reference value exists for this exact config, BASELINE.json
+.published = {}). 50k tokens/s/chip ~= the reference's BERT-Large 272
+samples/s@seq128 fused-kernel figure normalized per chip.
+"""
+
+import json
+import sys
+import time
+
+REF_TOKENS_PER_SEC_PER_CHIP = 50_000.0
+
+SEQ = 1024
+STEPS = 5
+WARMUP = 2
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT, GPT2_PRESETS, gpt_loss_fn
+    import dataclasses
+
+    n_chips = len(jax.devices())
+    mcfg = dataclasses.replace(GPT2_PRESETS["gpt2-125m"],
+                               dtype=jnp.bfloat16, scan_layers=True,
+                               remat="none")
+
+    def loss_fn(model, params, batch, rng, train):
+        ids = batch["input_ids"]
+        logits = model.apply(params, ids, deterministic=not train)
+        return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
+
+    batch_per_chip = 4
+    global_batch = batch_per_chip * n_chips
+    config = {
+        "train_batch_size": global_batch,
+        "train_micro_batch_size_per_gpu": batch_per_chip,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 10_000,
+    }
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, mcfg.vocab_size,
+                                       size=(global_batch, SEQ), dtype=np.int32)}
+    engine, _, _, _ = ds.initialize(
+        model=GPT(mcfg), config=config, loss_fn=loss_fn,
+        sample_batch={"input_ids": batch["input_ids"][:1]},
+        rng=jax.random.PRNGKey(0))
+
+    def fetch_scalar(tree):
+        # device->host copy forces the dependency chain (block_until_ready
+        # can ack early through remote-relay backends)
+        leaf = jax.tree.leaves(tree)[0]
+        return np.asarray(leaf.reshape(-1)[0])
+
+    for _ in range(WARMUP):
+        engine.train_batch(batch)
+    fetch_scalar(engine.params)
+
+    t0 = time.time()
+    for _ in range(STEPS):
+        loss = engine.train_batch(batch)
+    _ = np.asarray(loss)
+    fetch_scalar(engine.params)
+    dt = (time.time() - t0) / STEPS
+
+    tokens_per_sec = global_batch * SEQ / dt
+    per_chip = tokens_per_sec / n_chips
+    # model flops: ~6*N per token fwd+bwd
+    n_params = mcfg.num_params()
+    tflops_per_chip = 6 * n_params * per_chip / 1e12
+
+    result = {
+        "metric": "gpt2_125m_train_tokens_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(per_chip / REF_TOKENS_PER_SEC_PER_CHIP, 3),
+    }
+    print(json.dumps(result))
+    print(f"# loss={float(loss):.3f} step={dt*1e3:.1f}ms chips={n_chips} "
+          f"model_tflops/chip={tflops_per_chip:.1f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

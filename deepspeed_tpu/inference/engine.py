@@ -1,0 +1,81 @@
+"""Inference engine.
+
+Reference: deepspeed/inference/engine.py:27 InferenceEngine — wraps a model
+for serving: dtype conversion, tensor-parallel group creation, kernel
+injection, checkpoint loading, CUDA-graph capture, input broadcast.
+
+TPU-native: the jitted decode step IS the captured graph (XLA compiles and
+caches it — the analog of CUDA-graph capture/replay, engine.py:455/:474);
+TP groups are the mesh's "model" axis; kernel injection swaps HF modules
+for our fused flax modules (module_inject/).
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import comm as dist
+from ..utils.logging import logger, log_dist
+
+
+class InferenceEngine:
+    """Serve a flax model. Construct via ``deepspeed_tpu.init_inference``.
+
+    Args (reference: init_inference kwargs, deepspeed/__init__.py:222):
+        model: flax module (our models/ or an injected HF conversion)
+        mp_size: tensor-parallel degree (mesh "model" axis size)
+        dtype: compute dtype for serving
+        replace_with_kernel_inject: swap HF layers for fused modules
+        checkpoint: checkpoint path/dict to load
+    """
+
+    def __init__(self, model, mp_size: int = 1, dtype=jnp.bfloat16,
+                 params=None, checkpoint=None,
+                 replace_with_kernel_inject: bool = False,
+                 injection_policy=None, max_tokens: int = 1024,
+                 mesh=None, **kwargs):
+        dist.init_distributed()
+        self.module = model
+        self.dtype = dtype
+        self.mp_world_size = mp_size
+        if mesh is None:
+            mesh = dist.build_mesh(dist.MeshSpec(model=mp_size))
+        self.mesh = mesh
+        self.params = params
+        self.checkpoint = checkpoint
+        self._injected = False
+        self._compiled: Dict[str, Any] = {}
+
+        if replace_with_kernel_inject and model is not None:
+            from ..module_inject.replace_module import replace_transformer_layer
+            self.module, self.params = replace_transformer_layer(
+                model, params=self.params, policy=injection_policy,
+                dtype=dtype, mesh=mesh, max_tokens=max_tokens)
+            self._injected = True
+
+        if self.params is None and checkpoint is not None:
+            self._load_checkpoint(checkpoint)
+
+    def _load_checkpoint(self, checkpoint):
+        from ..module_inject.load_checkpoint import load_model_checkpoint
+        self.params = load_model_checkpoint(self.module, checkpoint, self.mesh,
+                                            dtype=self.dtype)
+
+    def forward(self, *args, **kwargs):
+        """Jitted module forward (compiled once per shape — the XLA analog
+        of CUDA-graph replay)."""
+        if "forward" not in self._compiled:
+            module = self.module
+            self._compiled["forward"] = jax.jit(
+                lambda p, a, kw: module.apply(p, *a, **kw))
+        return self._compiled["forward"](self.params, args, kwargs)
+
+    __call__ = forward
+
+    def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
+        """Greedy/sampled generation with a preallocated KV cache
+        (reference: the KV-cache attention kernels, softmax_context)."""
+        from .generation import generate as _generate
+        return _generate(self.module, self.params, input_ids,
+                         max_new_tokens=max_new_tokens, **kwargs)

@@ -1,0 +1,162 @@
+"""BERT-family encoder (the reference's transformer-kernel showcase model:
+tests/unit/modeling.py + the fused-kernel BERT path, pipeline BASELINE #3).
+
+Post-LN or pre-LN (reference ships both: modeling.py vs modelingpreln.py).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .layers import Block, LayerNorm, activation_constraint
+from .gpt import REMAT_POLICIES
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: Optional[int] = None
+    dropout_rate: float = 0.0
+    attn_dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    ln_epsilon: float = 1e-12
+    pre_ln: bool = False          # reference default: post-LN BERT
+    scan_layers: bool = True
+    remat: str = "none"
+    attn_backend: Optional[str] = None
+
+    @property
+    def ffn_dim(self):
+        return self.d_ff or 4 * self.d_model
+
+
+BERT_PRESETS = {
+    "bert-base": BertConfig(d_model=768, n_layers=12, n_heads=12),
+    "bert-large": BertConfig(d_model=1024, n_layers=24, n_heads=16),
+}
+
+
+class BertEncoder(nn.Module):
+    """Token+pos+type embeddings -> N encoder blocks -> sequence output.
+
+    Returns (sequence_output [b,s,d], pooled_output [b,d]).
+    """
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, token_type_ids=None, attention_mask=None,
+                 deterministic=True, layer_keep_prob=None):
+        cfg = self.config
+        b, s = input_ids.shape
+
+        wte = self.param("word_embeddings", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        wpe = self.param("position_embeddings", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("pos", "embed")),
+            (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+        wtt = self.param("token_type_embeddings", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("pos", "embed")),
+            (cfg.type_vocab_size, cfg.d_model), cfg.param_dtype)
+
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        h = (jnp.take(wte, input_ids, axis=0)
+             + jnp.take(wpe, jnp.arange(s), axis=0)[None]
+             + jnp.take(wtt, token_type_ids, axis=0)).astype(cfg.dtype)
+        h = LayerNorm(epsilon=cfg.ln_epsilon, name="embeddings_ln")(h)
+        if cfg.dropout_rate > 0.0 and not deterministic:
+            h = nn.Dropout(rate=cfg.dropout_rate)(h, deterministic=False)
+        h = activation_constraint(h, ("batch", "seq", "embed"))
+
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        block_kwargs = dict(
+            n_heads=cfg.n_heads, d_model=cfg.d_model, d_ff=cfg.ffn_dim,
+            causal=False, pre_ln=cfg.pre_ln, dropout_rate=cfg.dropout_rate,
+            attn_dropout_rate=cfg.attn_dropout_rate, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, ln_epsilon=cfg.ln_epsilon,
+            attn_backend=cfg.attn_backend)
+
+        block_cls = Block
+        if cfg.remat != "none":
+            block_cls = nn.remat(Block, policy=REMAT_POLICIES.get(cfg.remat),
+                                 prevent_cse=not cfg.scan_layers,
+                                 static_argnums=(4,))
+
+        if cfg.scan_layers:
+            def body(block, carry):
+                return block(carry, mask, None, deterministic,
+                             layer_keep_prob=layer_keep_prob), None
+            h, _ = nn.scan(
+                body, variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block_cls(**block_kwargs, name="layer"), h)
+        else:
+            for i in range(cfg.n_layers):
+                h = block_cls(**block_kwargs, name=f"layer_{i}")(
+                    h, mask, None, deterministic, layer_keep_prob=layer_keep_prob)
+
+        pooled = nn.tanh(nn.DenseGeneral(
+            features=cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("embed", "embed_out")),
+            name="pooler")(h[:, 0]))
+        return h, pooled
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP heads (reference: BertForPreTraining in tests/unit/modeling.py)."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, token_type_ids=None, attention_mask=None,
+                 deterministic=True):
+        cfg = self.config
+        seq_out, pooled = BertEncoder(cfg, name="bert")(
+            input_ids, token_type_ids=token_type_ids,
+            attention_mask=attention_mask, deterministic=deterministic)
+        # MLM head: transform + tied decoder
+        h = nn.DenseGeneral(features=cfg.d_model, dtype=cfg.dtype,
+                            param_dtype=cfg.param_dtype,
+                            kernel_init=nn.with_logical_partitioning(
+                                nn.initializers.normal(0.02), ("embed", "embed_out")),
+                            name="mlm_transform")(seq_out)
+        h = jax.nn.gelu(h, approximate=True)
+        h = LayerNorm(epsilon=cfg.ln_epsilon, name="mlm_ln")(h)
+        wte = self.variables["params"]["bert"]["word_embeddings"]
+        wte_val = wte.value if hasattr(wte, "value") else wte
+        mlm_logits = jnp.einsum("bsd,vd->bsv", h, wte_val.astype(cfg.dtype))
+        nsp_logits = nn.DenseGeneral(
+            features=2, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            name="nsp_head")(pooled)
+        return mlm_logits, nsp_logits
+
+
+def bert_pretrain_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+                       ignore_index=-1):
+    """Masked-LM + next-sentence loss, fp32."""
+    mlm_logits = mlm_logits.astype(jnp.float32)
+    nsp_logits = nsp_logits.astype(jnp.float32)
+    mask = (mlm_labels != ignore_index)
+    safe_labels = jnp.where(mask, mlm_labels, 0)
+    logz = jax.nn.logsumexp(mlm_logits, axis=-1)
+    ll = jnp.take_along_axis(mlm_logits, safe_labels[..., None], axis=-1)[..., 0]
+    mlm_nll = jnp.sum((logz - ll) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    nsp_logz = jax.nn.logsumexp(nsp_logits, axis=-1)
+    nsp_ll = jnp.take_along_axis(nsp_logits, nsp_labels[..., None], axis=-1)[..., 0]
+    nsp_nll = jnp.mean(nsp_logz - nsp_ll)
+    return mlm_nll + nsp_nll

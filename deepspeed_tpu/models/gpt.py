@@ -1,0 +1,174 @@
+"""GPT-family decoder models (GPT-2 / GPT-Neo / GPT-J layouts).
+
+The flagship training model for the BASELINE configs (GPT-2 125M ZeRO-1,
+GPT-2 1.3B ZeRO-2/3). TPU-first choices:
+
+- ``scan_layers``: stack the L transformer blocks into one scanned block
+  ([L, ...] params) — compile time O(1) in depth, and gives ZeRO-3 its
+  natural per-layer all-gather granularity (the analog of the reference's
+  per-submodule fetch in partitioned_param_coordinator.py).
+- ``remat``: jax.checkpoint around each block — the analog of the
+  reference's activation checkpointing (runtime/activation_checkpointing/).
+- params carry logical axis names; the engine binds them to mesh axes.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .layers import Block, LayerNorm, activation_constraint
+
+# jax.checkpoint policies keyed by config string (reference analog: the
+# activation_checkpointing config block).
+REMAT_POLICIES = {
+    "none": None,
+    "full": jax.checkpoint_policies.nothing_saveable,
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+}
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: Optional[int] = None           # default 4*d_model
+    dropout_rate: float = 0.0
+    attn_dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16            # activation/compute dtype
+    param_dtype: Any = jnp.float32       # master param dtype
+    use_bias: bool = True
+    ln_epsilon: float = 1e-5
+    tie_embeddings: bool = True
+    rotary: bool = False                 # GPT-J/NeoX style when True
+    learned_pos: bool = True             # GPT-2 learned position embeddings
+    scan_layers: bool = True
+    remat: str = "none"                  # key into REMAT_POLICIES
+    activation: str = "gelu"
+    attn_backend: Optional[str] = None   # None=auto, "reference", "pallas"
+
+    @property
+    def ffn_dim(self):
+        return self.d_ff or 4 * self.d_model
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.n_heads
+
+    def num_params(self):
+        """Approximate param count (for capacity planning / flops)."""
+        d, f, v, l = self.d_model, self.ffn_dim, self.vocab_size, self.n_layers
+        per_layer = 4 * d * d + 2 * d * f + (9 * d + f if self.use_bias else 4 * d)
+        emb = v * d + (self.max_seq_len * d if self.learned_pos else 0)
+        return l * per_layer + emb + 2 * d
+
+
+# Presets matching the BASELINE configs (GPT-2 125M / 350M / 1.3B).
+GPT2_PRESETS = {
+    "gpt2-125m": GPTConfig(d_model=768, n_layers=12, n_heads=12),
+    "gpt2-350m": GPTConfig(d_model=1024, n_layers=24, n_heads=16),
+    "gpt2-760m": GPTConfig(d_model=1536, n_layers=24, n_heads=16),
+    "gpt2-1.3b": GPTConfig(d_model=2048, n_layers=24, n_heads=16),
+    "gpt2-2.7b": GPTConfig(d_model=2560, n_layers=32, n_heads=32),
+}
+
+
+class GPT(nn.Module):
+    """Decoder-only LM. __call__ returns logits [batch, seq, vocab]."""
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, attention_mask=None, deterministic=True,
+                 layer_keep_prob=None, positions=None):
+        cfg = self.config
+        b, s = input_ids.shape
+
+        wte = self.param(
+            "wte", nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        h = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+
+        if cfg.learned_pos:
+            wpe = self.param(
+                "wpe", nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), ("pos", "embed")),
+                (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+            if positions is None:
+                positions = jnp.arange(s)
+            h = h + jnp.take(wpe, positions, axis=0).astype(cfg.dtype)
+
+        if cfg.dropout_rate > 0.0 and not deterministic:
+            h = nn.Dropout(rate=cfg.dropout_rate)(h, deterministic=False)
+        h = activation_constraint(h, ("batch", "seq", "embed"))
+
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        block_kwargs = dict(
+            n_heads=cfg.n_heads, d_model=cfg.d_model, d_ff=cfg.ffn_dim,
+            causal=True, pre_ln=True, dropout_rate=cfg.dropout_rate,
+            attn_dropout_rate=cfg.attn_dropout_rate, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, use_bias=cfg.use_bias,
+            ln_epsilon=cfg.ln_epsilon, rotary=cfg.rotary,
+            activation=cfg.activation, attn_backend=cfg.attn_backend)
+
+        block_cls = Block
+        policy = REMAT_POLICIES.get(cfg.remat)
+        if cfg.remat != "none":
+            block_cls = nn.remat(
+                Block, policy=policy, prevent_cse=not cfg.scan_layers,
+                static_argnums=(4,))
+
+        if cfg.scan_layers:
+            def body(block, carry):
+                x = block(carry, mask, None, deterministic,
+                          layer_keep_prob=layer_keep_prob)
+                return x, None
+
+            h, _ = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block_cls(**block_kwargs, name="h"), h)
+        else:
+            for i in range(cfg.n_layers):
+                h = block_cls(**block_kwargs, name=f"h_{i}")(
+                    h, mask, None, deterministic, layer_keep_prob=layer_keep_prob)
+
+        h = LayerNorm(epsilon=cfg.ln_epsilon, name="ln_f")(h)
+
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", h, wte.astype(cfg.dtype))
+        else:
+            logits = nn.DenseGeneral(
+                features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    nn.initializers.normal(0.02), ("embed", "vocab")),
+                name="lm_head")(h)
+        return logits
+
+
+def gpt_loss_fn(logits, labels, loss_mask=None, z_loss=0.0):
+    """Next-token cross entropy in fp32 (labels already shifted by caller,
+    or pass input_ids and we shift here when shapes match)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = logz - label_logits
+    if z_loss > 0.0:
+        nll = nll + z_loss * jnp.square(logz)
+    if loss_mask is not None:
+        nll = nll * loss_mask
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+    return jnp.mean(nll)

@@ -1,0 +1,231 @@
+"""Transformer building blocks, TPU-first.
+
+Replaces the reference's fused CUDA transformer layer surface
+(deepspeed/ops/transformer/transformer.py DeepSpeedTransformerLayer +
+csrc/transformer/*) with flax modules whose params carry *logical axis
+names*; the engine maps those names to mesh axes per ZeRO stage / TP degree
+(see runtime/zero/sharding.py). XLA then inserts the collectives the
+reference implemented by hand.
+
+Logical axis vocabulary:
+  "embed"  - d_model dim            "mlp"   - ffn hidden dim
+  "qkv"    - fused attention heads  "vocab" - vocabulary dim
+  "pos"    - position-embedding dim "layers" - stacked-layer axis (nn.scan)
+  "batch"/"seq" - activation dims (constraints only, never params)
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..ops.transformer.attention import attention
+
+# Set by the engine: dict logical-name -> mesh axis (or None). Activation
+# constraints no-op when empty so models run un-meshed.
+_ACTIVATION_RULES = {}
+
+
+def set_activation_rules(rules: dict):
+    global _ACTIVATION_RULES
+    _ACTIVATION_RULES = dict(rules or {})
+
+
+def activation_constraint(x, logical_names):
+    """Apply with_sharding_constraint if the engine installed rules."""
+    if not _ACTIVATION_RULES:
+        return x
+    from jax.sharding import PartitionSpec as P
+    axes = tuple(_ACTIVATION_RULES.get(n) for n in logical_names)
+    if all(a is None for a in axes):
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*axes))
+    except Exception:
+        return x
+
+
+def dense_init(names, scale=1.0):
+    """lecun_normal-style init wrapped with logical partitioning names."""
+    init = nn.initializers.variance_scaling(scale, "fan_in", "normal")
+    return nn.with_logical_partitioning(init, names)
+
+
+class LayerNorm(nn.Module):
+    """LayerNorm with fp32 accumulation (reference: normalize_kernels.cu
+    fused layernorm; XLA fuses this chain on TPU without a custom kernel)."""
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+    use_bias: bool = True
+    use_scale: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        orig_dtype = x.dtype
+        x = x.astype(jnp.float32)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+        y = (x - mean) * jax.lax.rsqrt(var + self.epsilon)
+        if self.use_scale:
+            scale = self.param("scale", nn.with_logical_partitioning(
+                nn.initializers.ones, ("embed",)), (x.shape[-1],), jnp.float32)
+            y = y * scale
+        if self.use_bias:
+            bias = self.param("bias", nn.with_logical_partitioning(
+                nn.initializers.zeros, ("embed",)), (x.shape[-1],), jnp.float32)
+            y = y + bias
+        return y.astype(orig_dtype)
+
+
+class SelfAttention(nn.Module):
+    """Fused-QKV multi-head attention (reference: DeepSpeedSelfAttention,
+    ops/transformer/inference/transformer_inference.py:473, training kernel
+    csrc/transformer/ds_transformer_cuda.cpp)."""
+    n_heads: int
+    d_model: int
+    causal: bool = True
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    use_bias: bool = True
+    rotary: bool = False
+    rotary_dim: Optional[int] = None
+    attn_backend: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None, bias=None, deterministic=True):
+        head_dim = self.d_model // self.n_heads
+        qkv = nn.DenseGeneral(
+            features=3 * self.d_model, use_bias=self.use_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=dense_init(("embed", "qkv")),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("qkv",)),
+            name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, s = x.shape[0], x.shape[1]
+        q = q.reshape(b, s, self.n_heads, head_dim)
+        k = k.reshape(b, s, self.n_heads, head_dim)
+        v = v.reshape(b, s, self.n_heads, head_dim)
+
+        if self.rotary:
+            from ..ops.transformer.rotary import apply_rotary_pos_emb
+            rdim = self.rotary_dim or head_dim
+            q, k = apply_rotary_pos_emb(q, k, rotary_dim=rdim)
+
+        dropout_rng = None
+        if self.dropout_rate > 0.0 and not deterministic:
+            dropout_rng = self.make_rng("dropout")
+
+        out = attention(q, k, v, bias=bias, mask=mask, causal=self.causal,
+                        dropout_rate=self.dropout_rate, dropout_rng=dropout_rng,
+                        deterministic=deterministic, backend=self.attn_backend)
+        out = out.reshape(b, s, self.d_model)
+        out = activation_constraint(out, ("batch", "seq", "embed"))
+        return nn.DenseGeneral(
+            features=self.d_model, use_bias=self.use_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=dense_init(("qkv", "embed")),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+            name="out")(out)
+
+
+class MLP(nn.Module):
+    """Transformer FFN (reference: fused bias-GELU csrc/transformer/gelu_kernels.cu
+    + feed_forward.h; XLA fuses the bias+gelu epilogue into the matmul)."""
+    d_model: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    use_bias: bool = True
+    activation: str = "gelu"
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        h = nn.DenseGeneral(
+            features=self.d_ff, use_bias=self.use_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=dense_init(("embed", "mlp")),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("mlp",)),
+            name="fc_in")(x)
+        if self.activation == "gelu":
+            h = jax.nn.gelu(h, approximate=True)
+        elif self.activation == "gelu_exact":
+            h = jax.nn.gelu(h, approximate=False)
+        elif self.activation == "relu":
+            h = jax.nn.relu(h)
+        elif self.activation == "silu":
+            h = jax.nn.silu(h)
+        else:
+            raise ValueError(f"unknown activation {self.activation}")
+        h = activation_constraint(h, ("batch", "seq", "mlp"))
+        h = nn.DenseGeneral(
+            features=self.d_model, use_bias=self.use_bias, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=dense_init(("mlp", "embed")),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+            name="fc_out")(h)
+        if self.dropout_rate > 0.0 and not deterministic:
+            h = nn.Dropout(rate=self.dropout_rate)(h, deterministic=False)
+        return h
+
+
+class Block(nn.Module):
+    """One transformer layer. pre_ln=True is the GPT/modern layout; False is
+    the original BERT post-LN layout (reference supports both via the
+    pre_layer_norm flag, ds_transformer_cuda.cpp)."""
+    n_heads: int
+    d_model: int
+    d_ff: int
+    causal: bool = True
+    pre_ln: bool = True
+    dropout_rate: float = 0.0
+    attn_dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    use_bias: bool = True
+    ln_epsilon: float = 1e-5
+    rotary: bool = False
+    activation: str = "gelu"
+    mlp_factory: Optional[Callable[..., nn.Module]] = None
+    attn_backend: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None, bias=None, deterministic=True,
+                 layer_keep_prob=None):
+        attn = SelfAttention(n_heads=self.n_heads, d_model=self.d_model,
+                             causal=self.causal, dropout_rate=self.attn_dropout_rate,
+                             dtype=self.dtype, param_dtype=self.param_dtype,
+                             use_bias=self.use_bias, rotary=self.rotary,
+                             attn_backend=self.attn_backend, name="attn")
+        mlp_cls = self.mlp_factory or (lambda name: MLP(
+            d_model=self.d_model, d_ff=self.d_ff, dtype=self.dtype,
+            param_dtype=self.param_dtype, use_bias=self.use_bias,
+            activation=self.activation, dropout_rate=self.dropout_rate, name=name))
+        mlp = mlp_cls(name="mlp")
+        ln1 = LayerNorm(epsilon=self.ln_epsilon, name="ln_1")
+        ln2 = LayerNorm(epsilon=self.ln_epsilon, name="ln_2")
+
+        aux = None
+        if self.pre_ln:
+            a = attn(ln1(x), mask=mask, bias=bias, deterministic=deterministic)
+            x = x + a
+            m = mlp(ln2(x), deterministic=deterministic)
+            if isinstance(m, tuple):  # MoE returns (out, aux_loss)
+                m, aux = m
+            y = x + m
+        else:
+            a = attn(x, mask=mask, bias=bias, deterministic=deterministic)
+            x = ln1(x + a)
+            m = mlp(x, deterministic=deterministic)
+            if isinstance(m, tuple):
+                m, aux = m
+            y = ln2(x + m)
+
+        if layer_keep_prob is not None:
+            # Progressive layer drop (reference: progressive_layer_drop.py +
+            # the theta gate in the BERT kernels): residual-scale by keep prob.
+            y = x + layer_keep_prob * (y - x)
+        y = activation_constraint(y, ("batch", "seq", "embed"))
+        return (y, aux) if aux is not None else y

@@ -1,0 +1,105 @@
+"""Metrics monitor fan-out.
+
+Reference: deepspeed/monitor/monitor.py:24 MonitorMaster fans (label, value,
+step) events to TensorBoard/W&B/CSV writers per the config blocks. Straight
+port; writers import lazily so missing backends degrade to warnings.
+"""
+
+import os
+from ..utils.logging import logger
+
+
+class Monitor:
+    def __init__(self, config):
+        self.config = config
+
+    def write_events(self, event_list):
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.summary_writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            log_dir = os.path.join(cfg.output_path or "./runs", cfg.job_name)
+            self.summary_writer = SummaryWriter(log_dir=log_dir)
+        except Exception as e:
+            logger.warning(f"TensorBoard monitor disabled: {e}")
+
+    def write_events(self, event_list, flush=True):
+        if self.summary_writer is None:
+            return
+        for label, value, step in event_list:
+            self.summary_writer.add_scalar(label, value, step)
+        if flush:
+            self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.enabled = False
+        try:
+            import wandb
+            wandb.init(project=cfg.project, group=cfg.group, entity=cfg.team)
+            self._wandb = wandb
+            self.enabled = True
+        except Exception as e:
+            logger.warning(f"W&B monitor disabled: {e}")
+
+    def write_events(self, event_list):
+        if not self.enabled:
+            return
+        for label, value, step in event_list:
+            self._wandb.log({label: value}, step=step)
+
+
+class csv_monitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.log_dir = os.path.join(cfg.output_path or "./csv_logs", cfg.job_name)
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._files = {}
+
+    def write_events(self, event_list):
+        import csv
+        for label, value, step in event_list:
+            fname = os.path.join(self.log_dir,
+                                 label.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", label])
+                w.writerow([step, value])
+
+
+class MonitorMaster(Monitor):
+    """Dispatches to every enabled writer (rank 0 only)."""
+
+    def __init__(self, ds_config):
+        self.tb_monitor = None
+        self.wandb_monitor = None
+        self.csv_monitor = None
+        from .. import comm as dist
+        self._rank0 = dist.get_rank() == 0
+        if self._rank0:
+            if ds_config.tensorboard.enabled:
+                self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
+            if ds_config.wandb.enabled:
+                self.wandb_monitor = WandbMonitor(ds_config.wandb)
+            if ds_config.csv_monitor.enabled:
+                self.csv_monitor = csv_monitor(ds_config.csv_monitor)
+
+    @property
+    def enabled(self):
+        return any([self.tb_monitor, self.wandb_monitor, self.csv_monitor])
+
+    def write_events(self, event_list):
+        if not self._rank0:
+            return
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m is not None:
+                m.write_events(event_list)

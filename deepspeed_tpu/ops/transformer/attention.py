@@ -1,0 +1,97 @@
+"""Attention op with a swappable backend.
+
+The reference fuses attention inside its CUDA transformer kernel
+(csrc/transformer/softmax_kernels.cu + strided_batch_gemm, orchestrated by
+ds_transformer_cuda.cpp). Here the same surface is one function whose
+backend is either
+
+- ``reference``: pure jnp einsum path (runs everywhere; XLA already fuses
+  the softmax chain), or
+- ``pallas``: the flash-attention Pallas kernel (deepspeed_tpu.ops.pallas)
+  when running on TPU with compatible shapes.
+
+Backend selection lives here so models never care.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _reference_attention(q, k, v, bias=None, mask=None, *, causal=False,
+                         softmax_scale=None, dropout_rate=0.0,
+                         dropout_rng=None, deterministic=True):
+    """q,k,v: [batch, seq, heads, head_dim] (BSHD, the JAX-native layout)."""
+    *_, q_len, _, head_dim = q.shape
+    k_len = k.shape[-3]
+    scale = softmax_scale if softmax_scale is not None else head_dim ** -0.5
+
+    # [b, h, sq, sk] logits in fp32 for numerical stability (the reference's
+    # attn_softmax kernel also upcasts).
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((q_len, k_len), dtype=bool), k_len - q_len)
+        logits = jnp.where(causal_mask, logits, jnp.finfo(jnp.float32).min)
+    if mask is not None:
+        # mask: [batch, 1|heads, 1|sq, sk] boolean, True = attend
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and not deterministic:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("...hqk,...khd->...qhd", probs, v)
+
+
+def attention(q, k, v, bias=None, mask=None, *, causal=False,
+              softmax_scale=None, dropout_rate=0.0, dropout_rng=None,
+              deterministic=True, backend: Optional[str] = None):
+    """Multi-head attention, BSHD layout.
+
+    backend: None = auto (pallas flash kernel on TPU when eligible,
+    reference otherwise) | "reference" | "pallas".
+    """
+    if backend is None:
+        backend = _auto_backend(q, bias, mask, dropout_rate, deterministic)
+    if backend == "pallas":
+        from ..pallas import flash_attention
+        return flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+    return _reference_attention(q, k, v, bias=bias, mask=mask, causal=causal,
+                                softmax_scale=softmax_scale,
+                                dropout_rate=dropout_rate,
+                                dropout_rng=dropout_rng,
+                                deterministic=deterministic)
+
+
+@functools.lru_cache(None)
+def _on_tpu():
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+@functools.lru_cache(None)
+def _pallas_available():
+    try:
+        from ..pallas import flash_attention  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+def _auto_backend(q, bias, mask, dropout_rate, deterministic):
+    head_dim = q.shape[-1]
+    seq = q.shape[-3]
+    eligible = (_on_tpu() and _pallas_available() and bias is None
+                and mask is None and (dropout_rate == 0.0 or deterministic)
+                and head_dim in (64, 128, 256) and seq % 128 == 0)
+    return "pallas" if eligible else "reference"

@@ -1,0 +1,43 @@
+"""Rotary position embedding.
+
+Reference: csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu — a CUDA
+kernel rotating q/k pairs. On TPU this is pure VPU elementwise work that XLA
+fuses into the surrounding matmuls; no Pallas needed.
+"""
+
+import jax.numpy as jnp
+
+
+def rotary_embedding(positions, dim, base=10000.0, dtype=jnp.float32):
+    """[seq] positions -> (sin, cos) each [seq, dim/2]."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    freqs = jnp.einsum("s,d->sd", positions.astype(jnp.float32), inv_freq)
+    return jnp.sin(freqs).astype(dtype), jnp.cos(freqs).astype(dtype)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_pos_emb(q, k, rotary_dim=None, positions=None, base=10000.0):
+    """q,k: [batch, seq, heads, head_dim]; rotates the first rotary_dim dims.
+
+    GPT-NeoX style (half-split rotation), matching the reference kernel's
+    neox path (apply_rotary_pos_emb.cu rotate_half).
+    """
+    head_dim = q.shape[-1]
+    rotary_dim = rotary_dim or head_dim
+    seq = q.shape[1]
+    if positions is None:
+        positions = jnp.arange(seq)
+    sin, cos = rotary_embedding(positions, rotary_dim, base=base, dtype=q.dtype)
+    sin = jnp.concatenate([sin, sin], axis=-1)[None, :, None, :]
+    cos = jnp.concatenate([cos, cos], axis=-1)[None, :, None, :]
+
+    def rot(x):
+        x_rot, x_pass = x[..., :rotary_dim], x[..., rotary_dim:]
+        x_rot = x_rot * cos + _rotate_half(x_rot) * sin
+        return jnp.concatenate([x_rot, x_pass], axis=-1) if rotary_dim < head_dim else x_rot
+
+    return rot(q), rot(k)

@@ -1,0 +1,62 @@
+"""Curriculum learning scheduler.
+
+Reference: deepspeed/runtime/data_pipeline/curriculum_scheduler.py:8 — steps
+a difficulty value (e.g. sequence length) each iteration; the engine injects
+it into the model forward. On TPU, dynamic seqlen would trigger
+recompilation, so difficulties are bucketed to multiples of
+``difficulty_step`` (buckets each compile once, then cache).
+"""
+
+import math
+from ...utils.logging import logger
+
+
+class CurriculumScheduler:
+    def __init__(self, config):
+        self.state = {}
+        self.first_step = True
+        self.config = config
+        sched = dict(config.schedule_config or {})
+        self.schedule_type = config.schedule_type
+        self.min_difficulty = config.min_difficulty
+        self.max_difficulty = config.max_difficulty
+        self.current_difficulty = config.min_difficulty
+        if self.schedule_type == "fixed_linear":
+            self.total_curriculum_step = sched.get("total_curriculum_step", 10000)
+            self.difficulty_step = sched.get("difficulty_step", 8)
+        elif self.schedule_type == "fixed_root":
+            self.total_curriculum_step = sched.get("total_curriculum_step", 10000)
+            self.difficulty_step = sched.get("difficulty_step", 8)
+            self.root_degree = sched.get("root_degree", 2)
+        elif self.schedule_type == "fixed_discrete":
+            self.difficulties = sched.get("difficulty", [config.max_difficulty])
+            self.max_steps = sched.get("max_step", [0])
+        else:
+            raise ValueError(f"Unknown curriculum schedule {self.schedule_type}")
+
+    def get_current_difficulty(self):
+        return self.current_difficulty
+
+    def set_current_difficulty(self, difficulty):
+        self.current_difficulty = difficulty
+
+    def update_difficulty(self, global_steps):
+        if self.schedule_type == "fixed_discrete":
+            d = self.difficulties[-1]
+            for diff, until in zip(self.difficulties, self.max_steps):
+                if global_steps <= until:
+                    d = diff
+                    break
+            self.current_difficulty = d
+            return d
+        if self.schedule_type == "fixed_root":
+            frac = min(1.0, global_steps / self.total_curriculum_step)
+            frac = frac ** (1.0 / self.root_degree)
+        else:  # fixed_linear
+            frac = min(1.0, global_steps / self.total_curriculum_step)
+        d = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        # bucket to difficulty_step so XLA shape buckets stay few
+        d = int(math.floor(d / self.difficulty_step) * self.difficulty_step)
+        self.current_difficulty = max(self.min_difficulty,
+                                      min(d, self.max_difficulty))
+        return self.current_difficulty

@@ -1,0 +1,90 @@
+"""Data loading.
+
+Reference: deepspeed/runtime/dataloader.py — DeepSpeedDataLoader (:33)
+builds a DistributedSampler-based torch loader; RepeatingLoader (:10) wraps
+any iterator to repeat forever.
+
+TPU-native: single-host, one process feeds the whole mesh — the loader
+yields *global* batches of numpy arrays and the engine shards them onto the
+mesh (batch dim over the DP axes). Multi-host: each process yields its
+contiguous 1/process_count slice of every global batch (the engine
+assembles the global array via make_array_from_process_local_data).
+"""
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart when exhausted (reference: :10)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    """Batch a map-style dataset into global-batch dicts of numpy arrays.
+
+    ``dataset`` may be: a dict of arrays (column store), a sequence of
+    per-example dicts, or a torch-style Dataset with __len__/__getitem__.
+    """
+
+    def __init__(self, dataset, batch_size, collate_fn=None, shuffle=True,
+                 seed=0, drop_last=True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+        if isinstance(dataset, dict):
+            self._len = len(next(iter(dataset.values())))
+        else:
+            self._len = len(dataset)
+        self.num_batches = (self._len // batch_size if drop_last
+                            else -(-self._len // batch_size))
+
+    def __len__(self):
+        return self.num_batches
+
+    def __iter__(self):
+        order = np.arange(self._len)
+        if self.shuffle:
+            np.random.default_rng(self.seed + self._epoch).shuffle(order)
+        self._epoch += 1
+        try:
+            import jax
+            nproc, pid = jax.process_count(), jax.process_index()
+        except Exception:
+            nproc, pid = 1, 0
+        share = self.batch_size // nproc
+        for b in range(self.num_batches):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            if nproc > 1:
+                idx = idx[pid * share:(pid + 1) * share]
+            if isinstance(self.dataset, dict):
+                yield {k: np.asarray(v)[idx] for k, v in self.dataset.items()}
+            else:
+                yield self.collate_fn([self.dataset[int(i)] for i in idx])
+
+
+def _default_collate(examples):
+    if isinstance(examples[0], dict):
+        return {k: np.stack([e[k] for e in examples]) for k in examples[0]}
+    if isinstance(examples[0], (tuple, list)):
+        return tuple(np.stack([e[i] for e in examples])
+                     for i in range(len(examples[0])))
+    return np.stack(examples)

@@ -1,0 +1,78 @@
+"""Static + dynamic loss scaling.
+
+Reference: deepspeed/runtime/fp16/loss_scaler.py (LossScaler :54,
+DynamicLossScaler :77). Functional here: the scaler state is a small pytree
+of device scalars carried through the jitted train step, and the
+skip/grow/shrink decision is lax-traced (the reference checks overflow on
+the host and skips the step in Python).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray            # current loss scale (f32 scalar)
+    growth_tracker: jnp.ndarray   # consecutive non-overflow steps (i32)
+    overflows: jnp.ndarray        # total overflowed/skipped steps (i32)
+    hysteresis_left: jnp.ndarray  # overflows tolerated before next shrink (i32)
+
+
+def init_loss_scale(static_scale: float = 0.0, initial_scale_power: int = 16,
+                    hysteresis: int = 2) -> LossScaleState:
+    scale = static_scale if static_scale > 0 else 2.0 ** initial_scale_power
+    return LossScaleState(scale=jnp.asarray(scale, jnp.float32),
+                          growth_tracker=jnp.zeros((), jnp.int32),
+                          overflows=jnp.zeros((), jnp.int32),
+                          hysteresis_left=jnp.asarray(hysteresis, jnp.int32))
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    """Overflow check over a grad pytree (reference: CheckOverflow,
+    runtime/utils.py — an allreduce(MAX) over ranks; here the grads are
+    already global values inside jit so a local isfinite suffices)."""
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(g)) for g in leaves]
+    return jnp.stack(finite).all()
+
+
+def update_scale(state: LossScaleState, finite: jnp.ndarray, *,
+                 dynamic: bool = True, scale_window: int = 1000,
+                 hysteresis: int = 2, consecutive_hysteresis: bool = False,
+                 min_scale: float = 1.0,
+                 scale_factor: float = 2.0) -> LossScaleState:
+    """Dynamic policy (reference DynamicLossScaler.update_scale): an
+    overflow consumes one unit of hysteresis; the scale halves only when
+    hysteresis is exhausted. ``scale_window`` clean steps double it. With
+    ``consecutive_hysteresis=False`` (reference default) a clean step
+    refills the hysteresis budget."""
+    if not dynamic:
+        return state._replace(overflows=state.overflows + jnp.where(finite, 0, 1))
+
+    def on_overflow(s):
+        exhausted = s.hysteresis_left <= 1
+        return LossScaleState(
+            scale=jnp.where(exhausted,
+                            jnp.maximum(s.scale / scale_factor, min_scale),
+                            s.scale),
+            growth_tracker=jnp.zeros((), jnp.int32),
+            overflows=s.overflows + 1,
+            hysteresis_left=jnp.where(exhausted, jnp.int32(hysteresis),
+                                      s.hysteresis_left - 1))
+
+    def on_clean(s):
+        tracker = s.growth_tracker + 1
+        grow = tracker >= scale_window
+        hyst = (s.hysteresis_left if consecutive_hysteresis
+                else jnp.asarray(hysteresis, jnp.int32))
+        return LossScaleState(
+            scale=jnp.where(grow, s.scale * scale_factor, s.scale),
+            growth_tracker=jnp.where(grow, 0, tracker),
+            overflows=s.overflows,
+            hysteresis_left=hyst)
+
+    return jax.lax.cond(finite, on_clean, on_overflow, state)

@@ -1,0 +1,56 @@
+"""Pytree helpers for sharding-spec propagation."""
+
+import jax
+import jax.tree_util as jtu
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+
+def _is_spec(x):
+    return isinstance(x, P)
+
+
+def map_opt_state_sharding(opt_state_shapes, param_shapes, param_specs,
+                           opt_rule, mesh):
+    """Build a NamedSharding tree for an optax state.
+
+    Optax states are (nested) tuples whose fields are either param-shaped
+    pytrees (Adam moments, master copies) or scalars (count). Any subtree
+    whose structure+shapes match the param tree gets per-param specs via
+    ``opt_rule(param_spec, param_shape)``; everything else replicates.
+    """
+    param_treedef = jtu.tree_structure(param_shapes)
+    spec_leaves = jtu.tree_leaves(param_specs, is_leaf=_is_spec)
+    shape_leaves = jtu.tree_leaves(param_shapes)
+
+    def build(node):
+        try:
+            if jtu.tree_structure(node) == param_treedef:
+                node_leaves = jtu.tree_leaves(node)
+                if all(n.shape == s.shape for n, s in zip(node_leaves, shape_leaves)):
+                    flat = [NamedSharding(mesh, opt_rule(spec, s.shape))
+                            for spec, s in zip(spec_leaves, shape_leaves)]
+                    return jtu.tree_unflatten(param_treedef, flat)
+        except Exception:
+            pass
+        leaves = jtu.tree_leaves(node)
+        if len(leaves) == 0:
+            return node  # empty subtree (e.g. optax EmptyState): structure-only
+        if len(leaves) == 1 and leaves[0] is node:
+            return NamedSharding(mesh, P())  # scalar leaf (count etc.)
+        children, treedef = _flatten_one_level(node)
+        return jtu.tree_unflatten(treedef, [build(c) for c in children])
+
+    return build(opt_state_shapes)
+
+
+def _flatten_one_level(node):
+    """Flatten exactly one pytree level (children returned as subtrees)."""
+    flat = jtu.default_registry.flatten_one_level(node)
+    if flat is None:
+        raise ValueError(f"Not a pytree node: {node!r}")
+    children, _ = flat
+    children = list(children)
+    # Treedef where each direct child is a leaf: is_leaf fires on everything
+    # except the root itself.
+    treedef = jtu.tree_structure(node, is_leaf=lambda x: x is not node)
+    return children, treedef

@@ -1,0 +1,177 @@
+"""End-to-end engine tests (reference analog: tests/unit/test_zero.py,
+test_fp16.py — ZeRO correctness vs a plain-optimizer baseline).
+
+Tiny GPT on the 8-device CPU mesh; every ZeRO stage must match the
+pure-optax replicated baseline losses (same seeds, same data).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import GPT, GPTConfig, gpt_loss_fn
+
+VOCAB, SEQ = 128, 16
+MODEL_CFG = GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ, d_model=32,
+                      n_layers=2, n_heads=4, dtype=jnp.float32,
+                      scan_layers=True)
+
+
+def make_batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, VOCAB, size=(n, SEQ), dtype=np.int32)
+    return {"input_ids": ids}
+
+
+def loss_fn(model, params, batch, rng, train):
+    ids = batch["input_ids"]
+    logits = model.apply(params, ids, deterministic=not train)
+    return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
+
+
+def ds_config(stage=0, extra=None):
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 100,
+    }
+    if extra:
+        cfg.update(extra)
+    return cfg
+
+
+def baseline_losses(n_steps=3):
+    """Pure-optax replicated training, gas=2 semantics (mean of micro losses,
+    grads averaged)."""
+    model = GPT(MODEL_CFG)
+    sample = make_batch(16)
+    params0 = model.init(jax.random.PRNGKey(42), jnp.asarray(sample["input_ids"][:1]))
+    from flax.core import meta
+    params = meta.unbox(params0)
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+    losses = []
+    for step in range(n_steps):
+        batch = make_batch(16, seed=step)["input_ids"]
+        micro = batch.reshape(2, 8, SEQ)
+
+        def total_loss(p):
+            l0 = gpt_loss_fn(model.apply(p, micro[0])[:, :-1], micro[0][:, 1:])
+            l1 = gpt_loss_fn(model.apply(p, micro[1])[:, :-1], micro[1][:, 1:])
+            return 0.5 * (l0 + l1)
+        loss, grads = jax.value_and_grad(total_loss)(params)
+        updates, opt = tx.update(grads, opt, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return baseline_losses()
+
+
+def _init_kwargs_engine(stage, extra=None, mesh_cfg=None):
+    cfg = ds_config(stage, extra)
+    if mesh_cfg:
+        cfg["mesh"] = mesh_cfg
+    engine, _, _, _ = ds.initialize(
+        model=GPT(MODEL_CFG), config=cfg, loss_fn=loss_fn,
+        sample_batch=make_batch(1), rng=jax.random.PRNGKey(42))
+    return engine
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stage_matches_baseline(stage, golden):
+    engine = _init_kwargs_engine(stage)
+    losses = [float(engine.train_batch(make_batch(16, seed=s)))
+              for s in range(3)]
+    np.testing.assert_allclose(losses, golden, rtol=2e-3, atol=2e-3)
+
+
+def test_zero3_with_fsdp_axis(golden):
+    engine = _init_kwargs_engine(
+        3, extra={"zero_optimization": {"stage": 3,
+                                        "stage3_param_persistence_threshold": 0}},
+        mesh_cfg={"fsdp": 4, "data": 2})
+    # params actually sharded over fsdp
+    from jax.sharding import PartitionSpec as P
+    specs = jax.tree.leaves(engine.param_specs, is_leaf=lambda x: isinstance(x, P))
+    assert any("fsdp" in str(s) for s in specs), specs
+    losses = [float(engine.train_batch(make_batch(16, seed=s)))
+              for s in range(3)]
+    np.testing.assert_allclose(losses, golden, rtol=2e-3, atol=2e-3)
+
+
+def test_tensor_parallel_matches(golden):
+    engine = _init_kwargs_engine(
+        1, extra={"train_micro_batch_size_per_gpu": 2},
+        mesh_cfg={"model": 2, "data": 4})
+    from jax.sharding import PartitionSpec as P
+    specs = jax.tree.leaves(engine.param_specs, is_leaf=lambda x: isinstance(x, P))
+    assert any("model" in str(s) for s in specs), specs
+    losses = [float(engine.train_batch(make_batch(16, seed=s)))
+              for s in range(3)]
+    np.testing.assert_allclose(losses, golden, rtol=2e-3, atol=2e-3)
+
+
+def test_opt_state_sharded_stage1():
+    engine = _init_kwargs_engine(1)
+    shardings = jax.tree.leaves(
+        jax.tree.map(lambda x: x.sharding, engine.optimizer_state))
+    assert any("data" in str(s.spec) for s in shardings), \
+        [str(s.spec) for s in shardings]
+
+
+def test_forward_backward_step_api(golden):
+    engine = _init_kwargs_engine(0)
+    losses = []
+    for s in range(3):
+        batch = make_batch(16, seed=s)
+        micro = {k: v.reshape(2, 8, SEQ) for k, v in batch.items()}
+        step_losses = []
+        for g in range(2):
+            mb = {k: v[g] for k, v in micro.items()}
+            loss = engine.forward(mb)
+            engine.backward(loss)
+            step_losses.append(float(loss))
+        engine.step()
+        losses.append(np.mean(step_losses))
+    # fwd/bwd/step path uses per-microbatch rng folding that differs from the
+    # fused path, but with deterministic models results must match golden
+    np.testing.assert_allclose(losses, golden, rtol=2e-3, atol=2e-3)
+
+
+def test_fp16_loss_scaling_runs():
+    mc = GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ, d_model=32, n_layers=2,
+                   n_heads=4, dtype=jnp.float16, scan_layers=True)
+    cfg = ds_config(1, {"fp16": {"enabled": True, "initial_scale_power": 8}})
+    engine, _, _, _ = ds.initialize(
+        model=GPT(mc), config=cfg, loss_fn=loss_fn,
+        sample_batch=make_batch(1), rng=jax.random.PRNGKey(42))
+    l0 = float(engine.train_batch(make_batch(16, seed=0)))
+    l1 = float(engine.train_batch(make_batch(16, seed=0)))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert engine.get_loss_scale() == 2.0 ** 8
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    engine = _init_kwargs_engine(1)
+    engine.train_batch(make_batch(16, seed=0))
+    loss_before = float(engine.train_batch(make_batch(16, seed=1)))
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+
+    engine2 = _init_kwargs_engine(1)
+    path, _ = engine2.load_checkpoint(str(tmp_path), tag="t1")
+    assert path is not None
+    assert engine2.global_steps == engine.global_steps
+    p1 = jax.tree.leaves(engine.params)
+    p2 = jax.tree.leaves(engine2.params)
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))

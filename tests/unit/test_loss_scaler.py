@@ -1,0 +1,75 @@
+"""Reference analog: tests/unit/test_dynamic_loss_scale.py."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    init_loss_scale, grads_finite, update_scale)
+
+
+def test_init_dynamic():
+    s = init_loss_scale(0.0, initial_scale_power=8)
+    assert float(s.scale) == 256.0
+
+
+def test_init_static():
+    s = init_loss_scale(128.0)
+    assert float(s.scale) == 128.0
+
+
+def test_overflow_halves_after_hysteresis():
+    s = init_loss_scale(0.0, initial_scale_power=8, hysteresis=2)
+    s = update_scale(s, jnp.asarray(False), scale_window=2, hysteresis=2)
+    # first overflow consumes hysteresis, scale unchanged
+    assert float(s.scale) == 256.0
+    assert int(s.overflows) == 1
+    s = update_scale(s, jnp.asarray(False), scale_window=2, hysteresis=2)
+    # hysteresis exhausted -> halve and refill
+    assert float(s.scale) == 128.0
+    assert int(s.overflows) == 2
+    assert int(s.growth_tracker) == 0
+
+
+def test_overflow_immediate_with_hysteresis_1():
+    s = init_loss_scale(0.0, initial_scale_power=8, hysteresis=1)
+    s = update_scale(s, jnp.asarray(False), hysteresis=1)
+    assert float(s.scale) == 128.0
+
+
+def test_clean_step_refills_hysteresis():
+    s = init_loss_scale(0.0, initial_scale_power=8, hysteresis=2)
+    s = update_scale(s, jnp.asarray(False), hysteresis=2)   # consume one
+    assert int(s.hysteresis_left) == 1
+    s = update_scale(s, jnp.asarray(True), hysteresis=2)    # refill
+    assert int(s.hysteresis_left) == 2
+
+
+def test_growth_after_window():
+    s = init_loss_scale(0.0, initial_scale_power=8)
+    s = update_scale(s, jnp.asarray(True), scale_window=2)
+    assert float(s.scale) == 256.0
+    s = update_scale(s, jnp.asarray(True), scale_window=2)
+    assert float(s.scale) == 512.0  # doubled after 2 clean steps
+
+
+def test_min_scale_floor():
+    s = init_loss_scale(2.0, hysteresis=1)
+    s = update_scale(s, jnp.asarray(False), min_scale=1.0, hysteresis=1)
+    s = update_scale(s, jnp.asarray(False), min_scale=1.0, hysteresis=1)
+    assert float(s.scale) == 1.0
+
+
+def test_grads_finite():
+    good = {"a": jnp.ones(3), "b": jnp.zeros(2)}
+    bad = {"a": jnp.ones(3), "b": jnp.asarray([jnp.inf, 0.0])}
+    nan = {"a": jnp.asarray([jnp.nan])}
+    assert bool(grads_finite(good))
+    assert not bool(grads_finite(bad))
+    assert not bool(grads_finite(nan))
+
+
+def test_static_mode_counts_overflows_only():
+    s = init_loss_scale(64.0)
+    s = update_scale(s, jnp.asarray(False), dynamic=False)
+    assert float(s.scale) == 64.0
+    assert int(s.overflows) == 1

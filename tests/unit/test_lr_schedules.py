@@ -1,0 +1,54 @@
+"""Reference analog: tests/unit/test_lr_schedulers.py."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    get_lr_schedule, warmup_lr, warmup_decay_lr, one_cycle, lr_range_test)
+
+
+def test_warmup_lr():
+    s = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10,
+                  warmup_type="linear")
+    assert float(s(0)) == 0.0
+    assert abs(float(s(5)) - 0.05) < 1e-6
+    assert float(s(10)) == pytest.approx(0.1)
+    assert float(s(100)) == pytest.approx(0.1)
+
+
+def test_warmup_log_default():
+    s = warmup_lr(warmup_max_lr=0.1, warmup_num_steps=10)
+    vals = [float(s(i)) for i in range(12)]
+    assert vals[0] == 0.0
+    assert all(b >= a - 1e-9 for a, b in zip(vals, vals[1:]))
+    assert vals[-1] == pytest.approx(0.1)
+
+
+def test_warmup_decay_lr():
+    s = warmup_decay_lr(total_num_steps=100, warmup_max_lr=0.1,
+                        warmup_num_steps=10, warmup_type="linear")
+    assert float(s(10)) == pytest.approx(0.1, rel=1e-3)
+    assert float(s(55)) == pytest.approx(0.05, rel=1e-2)
+    assert float(s(100)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_one_cycle():
+    s = one_cycle(cycle_min_lr=0.01, cycle_max_lr=0.1,
+                  cycle_first_step_size=10)
+    assert float(s(0)) == pytest.approx(0.01)
+    assert float(s(10)) == pytest.approx(0.1)
+    assert float(s(20)) == pytest.approx(0.01)
+
+
+def test_lr_range_test():
+    s = lr_range_test(lr_range_test_min_lr=0.001, lr_range_test_step_size=10,
+                      lr_range_test_step_rate=1.0)
+    assert float(s(0)) == pytest.approx(0.001)
+    assert float(s(10)) > float(s(0))
+
+
+def test_registry_and_unknown():
+    s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.1})
+    assert callable(s)
+    with pytest.raises(ValueError):
+        get_lr_schedule("Bogus", {})
