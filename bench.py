@@ -41,7 +41,7 @@ def main():
         logits = model.apply(params, ids, deterministic=not train)
         return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
 
-    batch_per_chip = 4
+    batch_per_chip = 8
     global_batch = batch_per_chip * n_chips
     config = {
         "train_batch_size": global_batch,

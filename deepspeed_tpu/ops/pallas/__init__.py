@@ -1,0 +1,17 @@
+"""Pallas TPU kernels — the replacement for the reference's csrc/ CUDA tree.
+
+| reference (csrc/)                       | here                     |
+|-----------------------------------------|--------------------------|
+| transformer attention + softmax kernels | flash_attention          |
+| adam/multi_tensor_adam.cu               | fused_adam.fused_adamw   |
+| transformer/normalize_kernels.cu        | layernorm.fused_layer_norm |
+| quantization/quantizer.cu               | quantizer.quantize/dequantize |
+
+Kernels run in interpreter mode automatically off-TPU so the whole suite
+tests on the CPU mesh.
+"""
+
+from .flash_attention import flash_attention
+from .fused_adam import fused_adamw, FusedAdamState
+from .layernorm import fused_layer_norm
+from .quantizer import quantize, dequantize

@@ -1,0 +1,17 @@
+"""Shared kernel-dispatch helpers."""
+
+import jax
+
+TPU_BACKENDS = ("tpu", "axon")
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.default_backend() in TPU_BACKENDS
+    except Exception:
+        return False
+
+
+def interpret_mode() -> bool:
+    """Pallas kernels interpret off-TPU so the suite runs on the CPU mesh."""
+    return not on_tpu()

@@ -1,0 +1,174 @@
+"""Flash attention Pallas kernel (fwd + bwd).
+
+TPU-native replacement for the reference's fused CUDA attention
+(csrc/transformer/softmax_kernels.cu + strided batched gemms orchestrated in
+ds_transformer_cuda.cpp; inference variant softmax_context in
+csrc/transformer/inference/). Design:
+
+- layout: kernels run in BHSD ([batch, heads, seq, head_dim]) so block
+  shapes keep the (sublane, lane)-aligned last two dims the Mosaic lowering
+  requires; the public API takes BSHD and transposes at dispatch.
+- forward: grid (batch, heads, q_blocks); one q block [Bq, d] against the
+  full K/V [S, d] resident in VMEM (S·d·2B ≤ ~0.5 MB for S≤4096, d≤128 —
+  comfortably inside the ~16 MB VMEM budget), fp32 softmax.
+- backward: grid (batch, heads); fori_loop over q blocks *recomputing* the
+  softmax (flash-style recompute — no S×S matrix and no saved LSE),
+  accumulating dK/dV in registers/VMEM.
+- autodiff via jax.custom_vjp (the reference wires fwd/bwd kernels through
+  torch.autograd.Function the same way).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+NEG_INF = -1e30
+
+
+from ._common import interpret_mode as _interpret
+
+
+def _softmax_tile(q, k, scale, causal, q_offset):
+    """[Bq,d]x[S,d] -> probability tile [Bq,S] (fp32) and the row stats.
+
+    ``q_offset`` already includes the bottom-right causal alignment shift
+    (sk - sq), matching the reference backend's ``tril(..., k_len - q_len)``
+    so both backends agree when sk != sq (decode with KV cache)."""
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_offset
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(col <= row, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    return p, l
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
+                causal_shift):
+    q = q_ref[0, 0].astype(jnp.float32)                # [Bq, d]
+    k = k_ref[0, 0].astype(jnp.float32)                # [S, d]
+    v = v_ref[0, 0].astype(jnp.float32)
+    p, l = _softmax_tile(q, k, scale, causal,
+                         pl.program_id(2) * block_q + causal_shift)
+    o = jnp.dot(p, v, preferred_element_type=jnp.float32) / l
+    o_ref[0, 0] = o.astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    grid = (b, h, pl.cdiv(sq, block_q))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, causal_shift=sk - sq),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref,
+                dq_ref, dk_ref, dv_ref, *, scale, causal, block_q, seq_q,
+                causal_shift):
+    k = k_ref[0, 0].astype(jnp.float32)                # [S, d]
+    v = v_ref[0, 0].astype(jnp.float32)
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        qs = pl.ds(i * block_q, block_q)
+        q = q_ref[0, 0, qs, :].astype(jnp.float32)     # [Bq, d]
+        o = o_ref[0, 0, qs, :].astype(jnp.float32)
+        do = do_ref[0, 0, qs, :].astype(jnp.float32)
+
+        p_un, l = _softmax_tile(q, k, scale, causal,
+                                i * block_q + causal_shift)
+        p = p_un / l                                   # [Bq, S]
+
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+
+        dq_ref[0, 0, qs, :] = jnp.dot(
+            ds, k, preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        dv_acc = dv_acc + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    dk_acc, dv_acc = jax.lax.fori_loop(
+        0, seq_q // block_q, body,
+        (jnp.zeros_like(k), jnp.zeros_like(v)))
+    dk_ref[0, 0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_bwd(scale, causal, block_q, res, g):
+    q, k, v, o = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    # Smaller q block than fwd: bwd holds three [Bq, S] fp32 tiles
+    # (p, dp, ds) plus fp32 dK/dV accumulators in VMEM. Bound the tiles to
+    # ~6 MB: Bq*S*4B*3 <= 6MB  =>  Bq <= 2^19/S, floored to a 128 multiple.
+    cap = max(128, (2 ** 19 // max(sk, 1)) // 128 * 128)
+    # Largest block <= cap that divides sq: gcd keeps the 128-alignment
+    # whenever sq is itself a multiple of 128 (the pallas-path requirement),
+    # avoiding a degenerate halving spiral for seqs like 1280.
+    block_q = math.gcd(sq, min(block_q, sq, cap))
+    if block_q % 8 != 0:  # non-128-multiple seq: fall back to full rows
+        block_q = sq
+    full_q = pl.BlockSpec((1, 1, sq, d), lambda bi, hi: (bi, hi, 0, 0))
+    full_k = pl.BlockSpec((1, 1, sk, d), lambda bi, hi: (bi, hi, 0, 0))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_q=sq, causal_shift=sk - sq),
+        grid=(b, h),
+        in_specs=[full_q, full_k, full_k, full_q, full_q],
+        out_specs=(full_q, full_k, full_k),
+        out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        interpret=_interpret(),
+    )(q, k, v, o, g)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention_bhsd(q, k, v, scale, causal, block_q):
+    return _flash_fwd(q, k, v, scale, causal, block_q)
+
+
+def _fwd_rule(q, k, v, scale, causal, block_q):
+    o = _flash_fwd(q, k, v, scale, causal, block_q)
+    return o, (q, k, v, o)
+
+
+_flash_attention_bhsd.defvjp(_fwd_rule, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal=True, softmax_scale=None,
+                    block_q=DEFAULT_BLOCK_Q):
+    """q,k,v: [batch, seq, heads, head_dim] (BSHD). Returns like q."""
+    d = q.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
+    sq = q.shape[1]
+    bq = min(block_q, sq)
+    if sq % bq != 0:
+        raise ValueError(f"flash_attention: seq {sq} must be divisible by "
+                         f"block_q {bq}")
+    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    o = _flash_attention_bhsd(qt, kt, vt, scale, causal, bq)
+    return jnp.swapaxes(o, 1, 2)

@@ -1,0 +1,128 @@
+"""Fused AdamW Pallas kernel.
+
+TPU-native replacement for the reference's multi-tensor fused Adam
+(csrc/adam/multi_tensor_adam.cu + fused_adam_frontend.cpp exposing
+``multi_tensor_adam``). One elementwise kernel updates param, m and v in a
+single pass over HBM (4 reads + 3 writes per element instead of the
+read/write traffic of an unfused update chain); exposed as an optax
+GradientTransformation so the engine can slot it in wherever optax.adamw
+fits.
+"""
+
+import functools
+from typing import NamedTuple, Union, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 1024 * 128  # elements per grid step (flat layout)
+LANE = 128
+
+
+from ._common import interpret_mode as _interpret
+
+
+def _adam_kernel(p_ref, g_ref, m_ref, v_ref, sc_ref,
+                 new_p_ref, new_m_ref, new_v_ref, *, b1, b2, eps, wd):
+    # sc_ref (SMEM): [lr, step_size_corr1, corr2_inv_sqrt... ] precomputed
+    lr = sc_ref[0]
+    c1 = sc_ref[1]   # 1/(1-b1^t)
+    c2 = sc_ref[2]   # 1/(1-b2^t)
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    m_hat = m * c1
+    v_hat = v * c2
+    update = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+    new_p_ref[:] = (p - lr * update).astype(new_p_ref.dtype)
+    new_m_ref[:] = m
+    new_v_ref[:] = v
+
+
+def _fused_update_flat(p, g, m, v, scalars, *, b1, b2, eps, wd):
+    """p/g/m/v: [n, LANE] flat-padded arrays."""
+    n = p.shape[0]
+    rows = BLOCK // LANE
+    block_rows = min(rows, n)
+    grid = (pl.cdiv(n, block_rows),)
+    spec = pl.BlockSpec((block_rows, LANE), lambda i: (i, 0))
+    out_shape = (jax.ShapeDtypeStruct(p.shape, p.dtype),
+                 jax.ShapeDtypeStruct(m.shape, jnp.float32),
+                 jax.ShapeDtypeStruct(v.shape, jnp.float32))
+    return pl.pallas_call(
+        functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps, wd=wd),
+        grid=grid,
+        in_specs=[spec, spec, spec, spec,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=(spec, spec, spec),
+        out_shape=out_shape,
+        input_output_aliases={0: 0, 2: 1, 3: 2},
+        interpret=_interpret(),
+    )(p, g, m, v, scalars)
+
+
+class FusedAdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def fused_adamw(learning_rate: Union[float, Callable] = 1e-3,
+                b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                weight_decay: float = 0.0) -> optax.GradientTransformation:
+    """Drop-in for optax.adamw backed by the fused Pallas kernel.
+
+    Returns *updates* = new_params - params so it composes with
+    optax.apply_updates like any other transform.
+    """
+
+    def init(params):
+        return FusedAdamState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            nu=jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params))
+
+    def update(grads, state, params=None):
+        assert params is not None, "fused_adamw requires params"
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        c1 = 1.0 / (1.0 - b1 ** count.astype(jnp.float32))
+        c2 = 1.0 / (1.0 - b2 ** count.astype(jnp.float32))
+        scalars = jnp.stack([jnp.asarray(lr, jnp.float32), c1, c2])
+
+        def one(p, g, m, v):
+            shape = p.shape
+            n = max(1, int(jnp.size(p)))
+            pad = (-n) % LANE
+            def flat(x, dt):
+                f = x.reshape(-1).astype(dt)
+                if pad:
+                    f = jnp.pad(f, (0, pad))
+                return f.reshape(-1, LANE)
+            fp, fg = flat(p, p.dtype), flat(g, jnp.float32)
+            fm, fv = flat(m, jnp.float32), flat(v, jnp.float32)
+            np_, nm, nv = _fused_update_flat(fp, fg, fm, fv, scalars,
+                                             b1=b1, b2=b2, eps=eps,
+                                             wd=weight_decay)
+            unflat = lambda x, dt: x.reshape(-1)[:n].reshape(shape).astype(dt)
+            return (unflat(np_, p.dtype) - p, unflat(nm, jnp.float32),
+                    unflat(nv, jnp.float32))
+
+        # flatten-zip-unflatten: robust to tuple-containing param pytrees
+        # (is_leaf=isinstance(tuple) would fire on structural tuples)
+        p_leaves, treedef = jax.tree.flatten(params)
+        g_leaves = treedef.flatten_up_to(grads)
+        m_leaves = treedef.flatten_up_to(state.mu)
+        v_leaves = treedef.flatten_up_to(state.nu)
+        outs = [one(p, g, m, v) for p, g, m, v in
+                zip(p_leaves, g_leaves, m_leaves, v_leaves)]
+        updates = jax.tree.unflatten(treedef, [o[0] for o in outs])
+        mu = jax.tree.unflatten(treedef, [o[1] for o in outs])
+        nu = jax.tree.unflatten(treedef, [o[2] for o in outs])
+        return updates, FusedAdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)

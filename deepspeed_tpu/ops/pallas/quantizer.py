@@ -1,0 +1,150 @@
+"""Quantization Pallas kernels.
+
+Reference: csrc/quantization/quantizer.cu + pt_binding.cpp exposing
+``ds_quantize_fp32/16`` (symmetric), ``ds_sr_quantize_*`` (stochastic
+rounding), ``ds_quantize_asym_*``. Used by MoQ training-time quantization
+and by the compressed-collective path (EQuARX-style int8 all-reduce is the
+TPU analog of the reference's 1-bit NCCL backend).
+
+Group-wise int8: x is viewed as [groups, group_size]; each group gets a
+fp32 scale (and zero-point for asymmetric).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+from ._common import interpret_mode as _interpret
+
+
+def _quant_sym_kernel(x_ref, q_ref, scale_ref):
+    x = x_ref[:].astype(jnp.float32)                      # [G, N]
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    q_ref[:] = q
+    scale_ref[:] = scale
+
+
+def _quant_asym_kernel(x_ref, q_ref, scale_ref, zp_ref):
+    x = x_ref[:].astype(jnp.float32)
+    xmin = jnp.min(x, axis=-1, keepdims=True)
+    xmax = jnp.max(x, axis=-1, keepdims=True)
+    scale = jnp.maximum(xmax - xmin, 1e-8) / 255.0
+    zp = xmin
+    # Mosaic has no f32->uint8 cast: emit the code offset by -128 as int8;
+    # dispatch rebiases to uint8 outside the kernel.
+    q = jnp.clip(jnp.round((x - zp) / scale) - 128.0, -128, 127).astype(jnp.int8)
+    q_ref[:] = q
+    scale_ref[:] = scale
+    zp_ref[:] = zp
+
+
+def _quant_sr_kernel(x_ref, seed_ref, q_ref, scale_ref):
+    pltpu.prng_seed(seed_ref[0])
+    x = x_ref[:].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    scaled = x / scale
+    floor = jnp.floor(scaled)
+    frac = scaled - floor
+    # prng_random_bits yields int32 — bitcast to uint32 so the shift is
+    # logical (arithmetic shift sign-extends and biases u negative), then
+    # back to int32 for the f32 cast (Mosaic lacks uint32->f32); the top-24
+    # value is < 2^24 so the int32 reinterpretation is exact and positive.
+    bits = pltpu.bitcast(pltpu.prng_random_bits(scaled.shape), jnp.uint32)
+    top24 = pltpu.bitcast(bits >> 8, jnp.int32)
+    u = top24.astype(jnp.float32) / float(1 << 24)  # uniform [0,1)
+    q = jnp.clip(floor + (u < frac).astype(jnp.float32), -127, 127)
+    q_ref[:] = q.astype(jnp.int8)
+    scale_ref[:] = scale
+
+
+def quantize(x, groups: int = 1, *, asymmetric: bool = False,
+             stochastic: bool = False, seed: int = 0):
+    """Quantize to int8 (sym, [-127,127]) or uint8 (asym). Returns
+    (q, scales[, zero_points]) with q shaped like x."""
+    shape = x.shape
+    n = x.size
+    assert n % groups == 0, f"{n} elements not divisible into {groups} groups"
+    gs = n // groups
+    x2d = x.reshape(groups, gs)
+
+    # Block over groups so a multi-GB tensor never lands in VMEM whole:
+    # each program handles G_BLK complete groups ([G_BLK, gs] slab).
+    g_blk = _group_block(groups, gs)
+
+    def call(kernel, out_shapes, extra_in=(), extra_in_specs=()):
+        grid = (pl.cdiv(groups, g_blk),)
+        in_specs = [pl.BlockSpec((g_blk, gs), lambda i: (i, 0))]
+        in_specs += list(extra_in_specs)
+        out_specs = []
+        for os in out_shapes:
+            if os.shape == (groups, 1):  # per-group scalars, kept 2D for tiling
+                out_specs.append(pl.BlockSpec((g_blk, 1), lambda i: (i, 0)))
+            else:
+                out_specs.append(pl.BlockSpec((g_blk, gs), lambda i: (i, 0)))
+        outs = pl.pallas_call(
+            kernel, grid=grid, in_specs=in_specs,
+            out_specs=tuple(out_specs), out_shape=tuple(out_shapes),
+            interpret=_interpret(),
+        )(x2d, *extra_in)
+        return tuple(o[:, 0] if o.shape == (groups, 1) else o for o in outs)
+
+    if asymmetric:
+        q, scale, zp = call(
+            _quant_asym_kernel,
+            (jax.ShapeDtypeStruct((groups, gs), jnp.int8),
+             jax.ShapeDtypeStruct((groups, 1), jnp.float32),
+             jax.ShapeDtypeStruct((groups, 1), jnp.float32)))
+        q = (q.astype(jnp.int16) + 128).astype(jnp.uint8)
+        return q.reshape(shape), scale, zp
+    if stochastic:
+        if _interpret():
+            # pltpu.prng_* has no CPU-interpret lowering; equivalent jax path
+            absmax = jnp.max(jnp.abs(x2d), axis=-1, keepdims=True)
+            scale2 = jnp.maximum(absmax, 1e-8) / 127.0
+            scaled = x2d / scale2
+            floor = jnp.floor(scaled)
+            u = jax.random.uniform(jax.random.PRNGKey(seed), scaled.shape)
+            q = jnp.clip(floor + (u < (scaled - floor)), -127, 127)
+            return q.astype(jnp.int8).reshape(shape), scale2[:, 0]
+        q, scale = call(
+            _quant_sr_kernel,
+            (jax.ShapeDtypeStruct((groups, gs), jnp.int8),
+             jax.ShapeDtypeStruct((groups, 1), jnp.float32)),
+            extra_in=(jnp.asarray([seed], jnp.int32),),
+            extra_in_specs=(pl.BlockSpec(memory_space=pltpu.SMEM),))
+        return q.reshape(shape), scale
+    q, scale = call(
+        _quant_sym_kernel,
+        (jax.ShapeDtypeStruct((groups, gs), jnp.int8),
+         jax.ShapeDtypeStruct((groups, 1), jnp.float32)))
+    return q.reshape(shape), scale
+
+
+def _group_block(groups, gs):
+    """Groups per program: slab bounded to ~4 MB fp32, sublane-friendly."""
+    max_groups = max(1, (4 * 2 ** 20) // max(4 * gs, 1))
+    g_blk = min(groups, max_groups)
+    if g_blk >= 8:
+        g_blk = g_blk // 8 * 8
+    while groups % g_blk != 0:
+        g_blk -= 1
+    return g_blk
+
+
+def dequantize(q, scales, zero_points=None, dtype=jnp.float32):
+    """Inverse of quantize (group-wise)."""
+    groups = scales.shape[0]
+    shape = q.shape
+    q2d = q.reshape(groups, -1).astype(jnp.float32)
+    if zero_points is not None:
+        out = q2d * scales[:, None] + zero_points[:, None]
+    else:
+        out = q2d * scales[:, None]
+    return out.reshape(shape).astype(dtype)

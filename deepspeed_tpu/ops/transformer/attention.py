@@ -71,12 +71,9 @@ def attention(q, k, v, bias=None, mask=None, *, causal=False,
                                 deterministic=deterministic)
 
 
-@functools.lru_cache(None)
 def _on_tpu():
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:
-        return False
+    from ..pallas._common import on_tpu
+    return on_tpu()
 
 
 @functools.lru_cache(None)

@@ -62,7 +62,7 @@ def build_optimizer(opt_type: str, params: dict,
     if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
         if name == FUSED_ADAM and use_pallas:
             try:
-                from ..ops.adam.fused_adam import fused_adamw
+                from ..ops.pallas.fused_adam import fused_adamw
                 return fused_adamw(lr, weight_decay=wd, **_adam_args(params))
             except Exception as e:  # pragma: no cover
                 logger.warning(f"Pallas fused adam unavailable ({e}); using optax")

@@ -1,0 +1,137 @@
+"""Kernel-vs-reference numerical equivalence (reference analog:
+tests/unit/test_cuda_forward.py / test_cuda_backward.py, which sweep the
+fused CUDA transformer kernel against a PyTorch baseline with tolerances).
+
+Kernels run in Pallas interpreter mode on CPU.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas import (flash_attention, fused_adamw,
+                                      fused_layer_norm, quantize, dequantize)
+from deepspeed_tpu.ops.transformer.attention import _reference_attention
+
+
+def rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_forward(causal):
+    b, s, h, d = 2, 256, 4, 64
+    q, k, v = rand(0, (b, s, h, d)), rand(1, (b, s, h, d)), rand(2, (b, s, h, d))
+    out = flash_attention(q, k, v, causal=causal, block_q=128)
+    ref = _reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flash_attention_backward():
+    b, s, h, d = 1, 128, 2, 64
+    q, k, v = rand(0, (b, s, h, d)), rand(1, (b, s, h, d)), rand(2, (b, s, h, d))
+
+    def f_kernel(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=64) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_reference_attention(q, k, v, causal=True) ** 2)
+
+    gk = jax.grad(f_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_flash_attention_bf16():
+    b, s, h, d = 1, 128, 2, 64
+    q = rand(0, (b, s, h, d), jnp.bfloat16)
+    k = rand(1, (b, s, h, d), jnp.bfloat16)
+    v = rand(2, (b, s, h, d), jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, block_q=128)
+    ref = _reference_attention(q, k, v, causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), rtol=3e-2, atol=3e-2)
+
+
+def test_flash_attention_rejects_ragged_seq():
+    q = rand(0, (1, 100, 2, 64))
+    with pytest.raises(ValueError):
+        flash_attention(q, q, q, block_q=64)
+
+
+def test_fused_adamw_matches_optax():
+    import optax
+    params = {"w": rand(0, (37, 50)), "b": rand(1, (7,))}
+    grads = {"w": rand(2, (37, 50)), "b": rand(3, (7,))}
+
+    fused = fused_adamw(1e-2, weight_decay=0.01)
+    ref = optax.adamw(1e-2, weight_decay=0.01)
+    fs, rs = fused.init(params), ref.init(params)
+    p_f, p_r = params, params
+    for step in range(3):
+        uf, fs = fused.update(grads, fs, p_f)
+        p_f = optax.apply_updates(p_f, uf)
+        ur, rs = ref.update(grads, rs, p_r)
+        p_r = optax.apply_updates(p_r, ur)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p_f[k]), np.asarray(p_r[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fused_layer_norm_fwd_bwd():
+    x = rand(0, (4, 33, 256))
+    gamma = 1.0 + 0.1 * rand(1, (256,))
+    beta = 0.1 * rand(2, (256,))
+
+    out = fused_layer_norm(x, gamma, beta)
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    ref = (x - mean) * jax.lax.rsqrt(var + 1e-5) * gamma + beta
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+    def lk(x, g, b):
+        return jnp.sum(fused_layer_norm(x, g, b) ** 2)
+
+    def lr(x, g, b):
+        mean = x.mean(-1, keepdims=True)
+        var = ((x - mean) ** 2).mean(-1, keepdims=True)
+        return jnp.sum(((x - mean) * jax.lax.rsqrt(var + 1e-5) * g + b) ** 2)
+
+    gk = jax.grad(lk, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b_ in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_quantize_sym_roundtrip():
+    x = rand(0, (16, 128))
+    q, scale = quantize(x, groups=16)
+    assert q.dtype == jnp.int8
+    x2 = dequantize(q, scale)
+    err = np.abs(np.asarray(x) - np.asarray(x2)).max()
+    granularity = float(np.asarray(scale).max())
+    assert err <= granularity  # max error is one quantization step
+
+
+def test_quantize_asym_roundtrip():
+    x = jnp.abs(rand(0, (8, 64))) + 3.0  # shifted distribution
+    q, scale, zp = quantize(x, groups=8, asymmetric=True)
+    assert q.dtype == jnp.uint8
+    x2 = dequantize(q, scale, zp)
+    err = np.abs(np.asarray(x) - np.asarray(x2)).max()
+    assert err <= float(np.asarray(scale).max())
+
+
+def test_quantize_stochastic_unbiased():
+    x = jnp.full((1, 1024), 0.3)
+    q, scale = quantize(x, groups=1, stochastic=True, seed=7)
+    x2 = dequantize(q, scale)
+    # stochastic rounding is unbiased in expectation
+    assert abs(float(x2.mean()) - 0.3) < 0.02
