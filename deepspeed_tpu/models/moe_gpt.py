@@ -1,0 +1,103 @@
+"""MoE GPT (BASELINE config #4: MoE GPT, expert-parallel all-to-all).
+
+Reference pattern: DeepSpeed-MoE NLG — a GPT where every other layer's FFN
+is a top-k gated expert layer (docs/_posts/2021-12-09-deepspeed-moe-nlg.md);
+the MoE layers' experts shard over the expert mesh axis.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .gpt import GPTConfig, gpt_loss_fn
+from .layers import Block, LayerNorm, activation_constraint
+from ..moe.layer import MoE
+
+
+@dataclass(frozen=True)
+class MoEGPTConfig:
+    base: GPTConfig = field(default_factory=GPTConfig)
+    num_experts: int = 8
+    ep_size: int = 1
+    k: int = 1
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    moe_interval: int = 2          # every Nth layer is MoE (reference NLG: 2)
+    aux_loss_coef: float = 0.01
+    noisy_gate_policy: Optional[str] = None
+
+
+class _MoEAdapter(nn.Module):
+    """Adapts MoE's (out, l_aux, counts) to the Block mlp contract
+    (out, aux)."""
+    cfg: MoEGPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        c = self.cfg
+        out, l_aux, _counts = MoE(
+            hidden_size=c.base.d_model, num_experts=c.num_experts,
+            ep_size=c.ep_size, k=c.k, capacity_factor=c.capacity_factor,
+            eval_capacity_factor=c.eval_capacity_factor,
+            min_capacity=c.min_capacity,
+            noisy_gate_policy=c.noisy_gate_policy,
+            dtype=c.base.dtype, param_dtype=c.base.param_dtype,
+            name="moe")(x, deterministic=deterministic)
+        return out, l_aux
+
+
+class MoEGPT(nn.Module):
+    """Returns (logits, total_aux_loss)."""
+    config: MoEGPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic=True):
+        cfg = self.config.base
+        mcfg = self.config
+        b, s = input_ids.shape
+
+        wte = self.param("wte", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        wpe = self.param("wpe", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("pos", "embed")),
+            (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+        h = (jnp.take(wte, input_ids, axis=0)
+             + jnp.take(wpe, jnp.arange(s), axis=0)[None]).astype(cfg.dtype)
+        h = activation_constraint(h, ("batch", "seq", "embed"))
+
+        total_aux = jnp.float32(0.0)
+        for i in range(cfg.n_layers):
+            is_moe = (i + 1) % mcfg.moe_interval == 0
+            block_kwargs = dict(
+                n_heads=cfg.n_heads, d_model=cfg.d_model, d_ff=cfg.ffn_dim,
+                causal=True, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                ln_epsilon=cfg.ln_epsilon, activation=cfg.activation,
+                attn_backend=cfg.attn_backend)
+            if is_moe:
+                block_kwargs["mlp_factory"] = (
+                    lambda name, _mcfg=mcfg: _MoEAdapter(_mcfg, name=name))
+            out = Block(**block_kwargs, name=f"h_{i}")(
+                h, None, None, deterministic)
+            if isinstance(out, tuple):
+                h, aux = out
+                total_aux = total_aux + aux
+            else:
+                h = out
+
+        h = LayerNorm(epsilon=cfg.ln_epsilon, name="ln_f")(h)
+        logits = jnp.einsum("bsd,vd->bsv", h, wte.astype(cfg.dtype))
+        return logits, total_aux
+
+
+def moe_gpt_loss_fn(model, params, batch, rng, train, aux_loss_coef=0.01):
+    """Cross entropy + load-balancing aux (engine-compatible signature)."""
+    ids = batch["input_ids"]
+    logits, aux = model.apply(params, ids, deterministic=not train,
+                              rngs={"gating": rng} if train else None)
+    ce = gpt_loss_fn(logits[:, :-1], ids[:, 1:])
+    return ce + aux_loss_coef * aux

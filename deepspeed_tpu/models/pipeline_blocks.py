@@ -1,0 +1,88 @@
+"""Prologue/epilogue modules for pipeline-parallel models.
+
+Reference analog: the first/last entries of the LayerSpec list in the
+reference's pipeline examples (embedding layer, final norm + lm head).
+"""
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .layers import LayerNorm
+from .gpt import GPTConfig
+
+
+class GPTEmbed(nn.Module):
+    """Token + position embeddings (pipeline stage-0 prologue)."""
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        b, s = input_ids.shape
+        wte = self.param("wte", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        h = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
+        if cfg.learned_pos:
+            wpe = self.param("wpe", nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("pos", "embed")),
+                (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+            h = h + jnp.take(wpe, jnp.arange(s), axis=0).astype(cfg.dtype)
+        return h
+
+
+class GPTHead(nn.Module):
+    """Final LN + LM head (pipeline last-stage epilogue)."""
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.config
+        h = LayerNorm(epsilon=cfg.ln_epsilon, name="ln_f")(h)
+        return nn.DenseGeneral(
+            features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("embed", "vocab")),
+            name="lm_head")(h)
+
+
+class BertEmbed(nn.Module):
+    """BERT embeddings prologue (BASELINE config #3: BERT-large 4-stage)."""
+    config: Any
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        b, s = input_ids.shape
+        wte = self.param("word_embeddings", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("vocab", "embed")),
+            (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
+        wpe = self.param("position_embeddings", nn.with_logical_partitioning(
+            nn.initializers.normal(0.02), ("pos", "embed")),
+            (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+        h = (jnp.take(wte, input_ids, axis=0)
+             + jnp.take(wpe, jnp.arange(s), axis=0)[None]).astype(cfg.dtype)
+        return LayerNorm(epsilon=cfg.ln_epsilon, name="embeddings_ln")(h)
+
+
+class BertMLMHead(nn.Module):
+    """Masked-LM head epilogue."""
+    config: Any
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.config
+        h = nn.DenseGeneral(features=cfg.d_model, dtype=cfg.dtype,
+                            param_dtype=cfg.param_dtype, name="transform")(h)
+        h = jax.nn.gelu(h, approximate=True)
+        h = LayerNorm(epsilon=cfg.ln_epsilon, name="ln")(h)
+        return nn.DenseGeneral(
+            features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(0.02), ("embed", "vocab")),
+            name="decoder")(h)

@@ -1,0 +1,112 @@
+"""MoE layer facade.
+
+Reference: deepspeed/moe/layer.py:15 ``MoE`` — wraps TopKGate + Experts +
+MOELayer, exposing (output, l_aux, exp_counts). Same surface here as a flax
+module; ``ep_size`` is validated against the mesh's expert axis instead of
+creating process groups (deepspeed/utils/groups.py).
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..comm.mesh import get_global_mesh
+from ..utils.logging import logger
+from .sharded_moe import MOELayer
+
+
+class ExpertMLP(nn.Module):
+    """Default expert: the standard FFN (reference: a torch nn.Module the
+    user passes; this is the common case)."""
+    d_model: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    activation: str = "gelu"
+
+    @nn.compact
+    def __call__(self, x):
+        import jax
+        h = nn.DenseGeneral(features=self.d_ff, dtype=self.dtype,
+                            param_dtype=self.param_dtype,
+                            kernel_init=nn.with_logical_partitioning(
+                                nn.initializers.variance_scaling(
+                                    1.0, "fan_in", "normal"),
+                                ("embed", "mlp")),
+                            bias_init=nn.with_logical_partitioning(
+                                nn.initializers.zeros, ("mlp",)),
+                            name="fc_in")(x)
+        h = jax.nn.gelu(h, approximate=True) if self.activation == "gelu" \
+            else jax.nn.relu(h)
+        return nn.DenseGeneral(features=self.d_model, dtype=self.dtype,
+                               param_dtype=self.param_dtype,
+                               kernel_init=nn.with_logical_partitioning(
+                                   nn.initializers.variance_scaling(
+                                       1.0, "fan_in", "normal"),
+                                   ("mlp", "embed")),
+                               bias_init=nn.with_logical_partitioning(
+                                   nn.initializers.zeros, ("embed",)),
+                               name="fc_out")(h)
+
+
+class MoE(nn.Module):
+    """reference: deepspeed/moe/layer.py:15.
+
+    __call__(x) -> (output, l_aux, exp_counts)."""
+    hidden_size: int
+    num_experts: int = 1
+    ep_size: int = 1
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    d_ff: Optional[int] = None
+    expert: Optional[Callable] = None    # factory(name=...) -> nn.Module
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+    def setup(self):
+        if self.num_experts % max(self.ep_size, 1) != 0:
+            raise ValueError(
+                f"num_experts={self.num_experts} must be divisible by "
+                f"ep_size={self.ep_size}")
+        factory = self.expert or (lambda name: ExpertMLP(
+            d_model=self.hidden_size, d_ff=self.d_ff or 4 * self.hidden_size,
+            dtype=self.dtype, param_dtype=self.param_dtype, name=name))
+        self.moe_layer = MOELayer(
+            d_model=self.hidden_size, num_experts=self.num_experts,
+            expert_factory=factory, k=self.k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity,
+            noisy_gate_policy=self.noisy_gate_policy,
+            drop_tokens=self.drop_tokens, use_rts=self.use_rts,
+            name="deepspeed_moe")
+
+    def __call__(self, x, deterministic=True):
+        try:
+            ep_axis = get_global_mesh().shape.get("expert", 1)
+            if ep_axis > 1 and self.num_experts % ep_axis != 0:
+                logger.warning(
+                    f"num_experts={self.num_experts} not divisible by mesh "
+                    f"expert axis {ep_axis}; experts will replicate")
+        except Exception:
+            pass
+        return self.moe_layer(x, deterministic=deterministic)
+
+
+def split_params_into_different_moe_groups_for_optimizer(param_groups):
+    """API parity with deepspeed/moe/utils.py:61. In the TPU build the
+    optimizer shards expert vs dense params differently via the sharding
+    rules (zero/sharding.py), so there is nothing to split — returned
+    unchanged."""
+    return param_groups
+
+
+def is_moe_param(name_tuple) -> bool:
+    """A param is an expert param iff its logical names carry "experts"."""
+    return name_tuple is not None and "experts" in name_tuple

@@ -1,0 +1,232 @@
+"""Expert-parallel MoE core: gating + dispatch.
+
+Reference: deepspeed/moe/sharded_moe.py — top1gating (:175), top2gating
+(:276) with capacity + load-balancing aux loss + random token selection;
+MOELayer.forward (:489): gate -> _AllToAll (:87) -> local experts ->
+_AllToAll back -> combine.
+
+TPU-native: dispatch/combine are einsums with sharding constraints over the
+"expert" mesh axis — the XLA SPMD partitioner lowers the resharding to the
+same all-to-all the reference issues by hand over its expert process group
+(created in deepspeed/utils/groups.py:107). Gating math is kept identical.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..comm.mesh import get_global_mesh
+
+
+def _expert_constraint(x, spec_axes):
+    """with_sharding_constraint over the expert axis, no-op off-mesh."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        mesh = get_global_mesh()
+        if mesh.shape.get("expert", 1) == 1:
+            return x
+        return jax.lax.with_sharding_constraint(x, P(*spec_axes))
+    except Exception:
+        return x
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    """reference: sharded_moe.py _capacity — ceil(T/E * factor), floored at
+    min_capacity. Static under jit (token count is a trace-time constant)."""
+    cap = math.ceil(num_tokens / num_experts * capacity_factor)
+    return max(cap, min_capacity)
+
+
+def _one_hot(idx, n):
+    return jax.nn.one_hot(idx, n, dtype=jnp.float32)
+
+
+def top1gating(logits, capacity_factor: float, min_capacity: int = 4,
+               noisy_gate_policy: Optional[str] = None,
+               drop_tokens: bool = True, use_rts: bool = True,
+               rng: Optional[jax.Array] = None):
+    """Switch-style top-1 gating (reference :175).
+
+    logits: [T, E] fp32. Returns (l_aux, combine [T,E,C], dispatch [T,E,C],
+    exp_counts [E])."""
+    T, E = logits.shape
+    if drop_tokens:
+        capacity = _capacity(T, E, capacity_factor, min_capacity)
+    else:
+        # no-drop needs worst-case capacity T (static shapes under jit);
+        # the [T,E,T] dispatch tensors explode quadratically, so refuse
+        # beyond a sane budget (reference shrinks dynamically, which XLA
+        # static shapes cannot express).
+        if T * T * E > 2 ** 26:
+            raise ValueError(
+                f"drop_tokens=False needs [T,E,T] dispatch tensors; "
+                f"T={T}, E={E} exceeds the budget — enable drop_tokens or "
+                f"reduce tokens per step")
+        capacity = T
+
+    if noisy_gate_policy == "RSample" and rng is not None:
+        logits_w_noise = logits + jax.random.gumbel(rng, logits.shape)
+    else:
+        logits_w_noise = logits
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    indices1 = jnp.argmax(logits_w_noise, axis=-1)            # [T]
+    mask1 = _one_hot(indices1, E)                             # [T, E]
+    exp_counts = jnp.sum(mask1, axis=0)
+
+    # load-balancing loss (reference: l_aux = E * sum(me*ce))
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    if use_rts and rng is not None:
+        # random token selection: prioritize by uniform noise so truncation
+        # under capacity is unbiased (reference :221)
+        rts = jax.random.uniform(jax.random.fold_in(rng, 1), (T, E))
+        priority = mask1 * rts
+    else:
+        priority = mask1 * (T - jnp.arange(T, dtype=jnp.float32))[:, None]
+    # rank tokens per expert by priority; position = rank in expert queue
+    # cumsum of mask ordered by arrival is the reference's default
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1            # [T, E]
+    if use_rts and rng is not None:
+        order = jnp.argsort(-priority, axis=0)                # [T, E]
+        ranks = jnp.argsort(order, axis=0).astype(jnp.float32)
+        locations1 = jnp.where(mask1 > 0, ranks, locations1)
+
+    pos_in_expert = jnp.sum(locations1 * mask1, axis=-1)      # [T]
+    keep = (pos_in_expert < capacity) & (jnp.sum(mask1, axis=-1) > 0)
+    mask1 = mask1 * keep[:, None].astype(mask1.dtype)
+
+    gates1 = jnp.sum(gates * mask1, axis=-1)                  # [T]
+    loc_oh = _one_hot(jnp.clip(pos_in_expert, 0, capacity - 1).astype(jnp.int32),
+                      capacity)                               # [T, C]
+    combine = gates1[:, None, None] * mask1[:, :, None] * loc_oh[:, None, :]
+    dispatch = (combine > 0).astype(logits.dtype)
+    return l_aux, combine.astype(logits.dtype), dispatch, exp_counts
+
+
+def top2gating(logits, capacity_factor: float, min_capacity: int = 4,
+               rng: Optional[jax.Array] = None):
+    """GShard-style top-2 gating (reference :276)."""
+    T, E = logits.shape
+    capacity = _capacity(T, E, capacity_factor * 2, min_capacity)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    indices1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(indices1, E)
+    logits_except1 = jnp.where(mask1 > 0, -jnp.inf, logits)
+    indices2 = jnp.argmax(logits_except1, axis=-1)
+    mask2 = _one_hot(indices2, E)
+
+    # aux loss on first choice only (reference :300)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1
+    locations2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0,
+                                                             keepdims=True)
+    pos1 = jnp.sum(locations1 * mask1, axis=-1)
+    pos2 = jnp.sum(locations2 * mask2, axis=-1)
+    mask1 = mask1 * (pos1 < capacity)[:, None].astype(mask1.dtype)
+    mask2 = mask2 * (pos2 < capacity)[:, None].astype(mask2.dtype)
+
+    gates1 = jnp.sum(gates * mask1, axis=-1)
+    gates2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.clip(gates1 + gates2, 1e-9, None)
+    gates1, gates2 = gates1 / denom, gates2 / denom
+
+    loc1 = _one_hot(jnp.clip(pos1, 0, capacity - 1).astype(jnp.int32), capacity)
+    loc2 = _one_hot(jnp.clip(pos2, 0, capacity - 1).astype(jnp.int32), capacity)
+    combine = (gates1[:, None, None] * mask1[:, :, None] * loc1[:, None, :]
+               + gates2[:, None, None] * mask2[:, :, None] * loc2[:, None, :])
+    dispatch = (combine > 0).astype(logits.dtype)
+    exp_counts = jnp.sum(mask1 + mask2, axis=0)
+    return l_aux, combine.astype(logits.dtype), dispatch, exp_counts
+
+
+class TopKGate(nn.Module):
+    """Gating network (reference: TopKGate, sharded_moe.py:374)."""
+    d_model: int
+    num_experts: int
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        # gate weights kept fp32 (reference keeps wg in fp32)
+        logits = nn.DenseGeneral(
+            features=self.num_experts, use_bias=False, dtype=jnp.float32,
+            param_dtype=jnp.float32, name="wg")(x.astype(jnp.float32))
+        rng = None
+        if not deterministic and (self.use_rts or self.noisy_gate_policy):
+            rng = self.make_rng("gating")
+        factor = (self.capacity_factor if not deterministic
+                  else self.eval_capacity_factor)
+        if self.k == 1:
+            return top1gating(logits, factor, self.min_capacity,
+                              self.noisy_gate_policy if not deterministic else None,
+                              self.drop_tokens, self.use_rts, rng)
+        if self.k == 2:
+            return top2gating(logits, factor, self.min_capacity, rng)
+        raise ValueError("only k=1 and k=2 are supported (reference parity)")
+
+
+class MOELayer(nn.Module):
+    """Gate -> dispatch -> experts -> combine (reference MOELayer :432).
+
+    ``expert_factory(name)`` builds one expert module; experts are stacked
+    with nn.vmap and their params carry the "experts" logical axis, which
+    the sharding rules map onto the "expert" mesh axis. The dispatch/combine
+    einsums carry sharding constraints so GSPMD emits the all-to-all."""
+    d_model: int
+    num_experts: int
+    expert_factory: any
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        b, s, d = x.shape
+        tokens = x.reshape(b * s, d)
+
+        gate = TopKGate(d_model=self.d_model, num_experts=self.num_experts,
+                        k=self.k, capacity_factor=self.capacity_factor,
+                        eval_capacity_factor=self.eval_capacity_factor,
+                        min_capacity=self.min_capacity,
+                        noisy_gate_policy=self.noisy_gate_policy,
+                        drop_tokens=self.drop_tokens, use_rts=self.use_rts,
+                        name="gate")
+        l_aux, combine, dispatch, exp_counts = gate(tokens, deterministic)
+
+        # dispatch: [T,E,C] x [T,d] -> [E,C,d]; the constraint shards E over
+        # the expert axis => GSPMD all-to-all (reference _AllToAll :87)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), tokens)
+        expert_in = _expert_constraint(expert_in, ("expert", None, None))
+
+        experts = nn.vmap(
+            lambda m, xi: m(xi),
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=0, out_axes=0,
+            metadata_params={nn.PARTITION_NAME: "experts"},
+        )(self.expert_factory(name="experts"), expert_in)
+        experts = _expert_constraint(experts, ("expert", None, None))
+
+        out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), experts)
+        return out.reshape(b, s, d), l_aux, exp_counts

@@ -1,0 +1,231 @@
+"""Pipeline module description.
+
+Reference: deepspeed/runtime/pipe/module.py — PipelineModule (:85) takes a
+list of LayerSpec (:23) / TiedLayerSpec (:71) and partitions them across
+stages (_partition_layers :361, methods uniform / parameters / type:regex).
+
+TPU-native: the hot path executes the pipeline as ONE SPMD program over the
+mesh's "stage" axis (pipe/engine.py), which requires the repeated trunk to
+be homogeneous — exactly the transformer case. A PipelineModule therefore
+describes three sections:
+
+  embed  - first-stage-only prologue (token/pos embeddings)
+  block  - ONE flax module repeated ``n_blocks`` times; its stacked params
+           [n_blocks, ...] shard over the "stage" axis
+  head   - last-stage epilogue (final LN + LM head) + loss_fn
+
+A generic LayerSpec list is still accepted and partitioned with the
+reference's methods (used for bookkeeping, checkpoint layout, and the
+host-driven fallback); homogeneous specs are auto-collapsed into the
+block form.
+"""
+
+import re
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class LayerSpec:
+    """Deferred layer construction (reference :23)."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not issubclass(typename, object):
+            raise RuntimeError("LayerSpec typename must be a class")
+
+    def build(self, log=False):
+        if log:
+            logger.info(f"building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({self.typename.__name__})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared across stages by ``key``
+    (reference :71). In the functional engine, tying is free: the tied
+    params appear once in the pytree and autodiff sums their gradients —
+    the reference's tied-weight allreduce (module.py:417) is implicit."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="weight", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+def partition_balanced(weights, num_parts):
+    """Split ``weights`` into ``num_parts`` contiguous chunks minimizing the
+    max chunk weight (reference: deepspeed/runtime/utils.py
+    partition_balanced / prefix-sum + binary search)."""
+    weights = list(weights)
+    n = len(weights)
+    if num_parts > n:
+        raise ValueError(f"cannot split {n} layers into {num_parts} parts")
+    prefix = np.concatenate([[0], np.cumsum(weights)])
+
+    def can_split(limit):
+        parts, start = 0, 0
+        for i in range(1, n + 1):
+            if prefix[i] - prefix[start] > limit:
+                parts += 1
+                start = i - 1
+                if prefix[i] - prefix[start] > limit:
+                    return None
+        parts += 1
+        return parts <= num_parts
+
+    lo = max(weights) if weights else 0
+    hi = prefix[-1]
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if can_split(mid):
+            hi = mid
+        else:
+            lo = mid + 1
+
+    # build boundaries greedily under limit lo, then pad to num_parts
+    bounds = [0]
+    start = 0
+    for i in range(1, n + 1):
+        if prefix[i] - prefix[start] > lo:
+            bounds.append(i - 1)
+            start = i - 1
+    bounds.append(n)
+    while len(bounds) < num_parts + 1:
+        # split the largest remaining part
+        sizes = [(bounds[j + 1] - bounds[j], j) for j in range(len(bounds) - 1)]
+        _, j = max(sizes)
+        mid = (bounds[j] + bounds[j + 1]) // 2
+        bounds.insert(j + 1, mid)
+        bounds = sorted(set(bounds))
+    return bounds[:num_parts + 1]
+
+
+class PipelineModule:
+    """Pipeline-parallel model description (reference :85)."""
+
+    def __init__(self, layers=None, num_stages=None, topology=None,
+                 loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0,
+                 seed_layers=False, base_seed=1234,
+                 *, embed=None, block=None, n_blocks: Optional[int] = None,
+                 head=None):
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.topology = topology
+        self.num_stages = num_stages or (topology.get_dim("pipe")
+                                         if topology else 1)
+        self.embed = embed
+        self.block = block
+        self.n_blocks = n_blocks
+        self.head = head
+        self._layer_specs = list(layers) if layers is not None else None
+        self.parts = None
+
+        if self._layer_specs is not None:
+            self._partition_layers()
+            if self.block is None:
+                self._try_collapse_homogeneous()
+
+        if self.block is None:
+            raise ValueError(
+                "PipelineModule needs a homogeneous trunk: pass "
+                "embed=/block=/n_blocks=/head=, or a LayerSpec list whose "
+                "middle section repeats one layer type")
+        if self.n_blocks % self.num_stages != 0:
+            raise ValueError(
+                f"n_blocks={self.n_blocks} must divide evenly over "
+                f"{self.num_stages} stages")
+
+    # -- reference-parity partition bookkeeping ------------------------
+
+    def _layer_weights(self):
+        method = self.partition_method.lower()
+        specs = self._layer_specs
+        if method == "uniform":
+            return [1] * len(specs)
+        if method == "parameters":
+            weights = []
+            for spec in specs:
+                n = 1
+                kw = spec.module_kwargs if isinstance(spec, LayerSpec) else {}
+                cfg = kw.get("config") or (spec.module_args[0]
+                                           if isinstance(spec, LayerSpec)
+                                           and spec.module_args else None)
+                if hasattr(cfg, "num_params"):
+                    n = cfg.num_params()
+                elif hasattr(spec, "num_params"):
+                    n = spec.num_params
+                weights.append(max(int(n), 1))
+            return weights
+        if method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            return [1 if re.search(pattern, spec.typename.__name__,
+                                   re.IGNORECASE) else 0
+                    for spec in self._layer_specs]
+        raise NotImplementedError(f"partition method {self.partition_method}")
+
+    def _partition_layers(self):
+        weights = self._layer_weights()
+        self.parts = partition_balanced(weights, self.num_stages)
+        logger.info(f"pipeline partition boundaries: {self.parts}")
+
+    def _try_collapse_homogeneous(self):
+        """Detect [embed?] + N*Block + [head...] shape in a LayerSpec list."""
+        specs = self._layer_specs
+        types = [s.typename for s in specs]
+        # longest run of one repeated type
+        best_start, best_len = 0, 0
+        i = 0
+        while i < len(types):
+            j = i
+            while j < len(types) and types[j] is types[i]:
+                j += 1
+            if j - i > best_len:
+                best_start, best_len = i, j - i
+            i = j
+        if best_len < self.num_stages:
+            return
+        self.n_blocks = best_len
+        self.block = specs[best_start].build()
+        pre = [s.build() for s in specs[:best_start]]
+        post = [s.build() for s in specs[best_start + best_len:]]
+        self.embed = _Sequential(pre) if pre else None
+        self.head = _Sequential(post) if post else None
+
+    def stage_of_layer(self, layer_idx: int) -> int:
+        if self.parts is None:
+            per = self.n_blocks // self.num_stages
+            return min(layer_idx // per, self.num_stages - 1)
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    def ckpt_prefix(self, checkpoint_engine_tag, layer_idx):
+        """Layer-file naming parity (reference module.py:529)."""
+        return f"layer_{layer_idx:02d}-model_states.pt"
+
+
+class _Sequential:
+    """Minimal callable chain for pre/post sections built from specs."""
+
+    def __init__(self, modules):
+        self.modules = modules
+
+    def __call__(self, *args, **kwargs):
+        out = args
+        for m in self.modules:
+            out = m(*out) if isinstance(out, tuple) else m(out)
+            out = (out,)
+        return out[0]

@@ -1,0 +1,148 @@
+"""Process topology bookkeeping.
+
+Reference: deepspeed/runtime/pipe/topology.py — ProcessTopology (:9) maps
+ranks <-> (axis, coord) tuples; PipeDataParallelTopology /
+PipeModelDataParallelTopology (:243) fix the axis order;
+PipelineParallelGrid (:249) builds the torch process groups.
+
+Here ranks are *mesh coordinates*: the same coordinate algebra is kept
+(tests and checkpoint naming depend on it) but "building groups" is free —
+groups are mesh axes.
+"""
+
+from collections import namedtuple
+from itertools import product
+
+
+class ProcessTopology:
+    """Cartesian product topology over named axes (reference :9)."""
+
+    def __init__(self, axes, dims):
+        self.axes = axes
+        self.dims = dims
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        ranges = [range(d) for d in dims]
+        for global_rank, coord in enumerate(product(*ranges)):
+            key = {axis: coord[self.axes.index(axis)] for axis in self.axes}
+            key = self.ProcessCoord(**key)
+            self.mapping[key] = global_rank
+
+    def get_rank(self, **coord_kwargs):
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() needs all axes {self.axes}")
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"coord {key} not in topology"
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data",), inner_sep="_",
+                      outer_sep="-"):
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.get_axis_names() if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis):
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis):
+        """Lists of ranks that vary only along ``axis`` (reference group
+        construction)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for coord in product(*ranges):
+            other = dict(zip(other_axes, coord))
+            ranks = [self.get_rank(**{axis: i}, **other)
+                     for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        def criteria(x):
+            return all(getattr(x, k) == v for k, v in filter_kwargs.items())
+        return [self.mapping[c] for c in sorted(self.mapping.keys(),
+                                                key=lambda c: self.mapping[c])
+                if criteria(c)]
+
+    def get_axis_list(self, axis, idx):
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self):
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """axes = [pipe, data] (reference :229)."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """axes = [pipe, data, model] (reference :243)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Axis-degree accessors (reference :249). Group handles are mesh axis
+    names instead of torch process groups."""
+
+    def __init__(self, topology=None, mesh=None):
+        if topology is None and mesh is not None:
+            topology = PipeModelDataParallelTopology(
+                num_pp=mesh.shape.get("stage", 1),
+                num_mp=mesh.shape.get("model", 1),
+                num_dp=int(mesh.size // (mesh.shape.get("stage", 1)
+                                         * mesh.shape.get("model", 1))))
+        self._topo = topology
+        self.data_parallel_size = max(topology.get_dim("data"), 1)
+        self.pipe_parallel_size = max(topology.get_dim("pipe"), 1)
+        self.model_parallel_size = max(topology.get_dim("model"), 1)
+
+    def get_pipe_parallel_world_size(self):
+        return self.pipe_parallel_size
+
+    def get_data_parallel_world_size(self):
+        return self.data_parallel_size
+
+    def get_slice_parallel_world_size(self):
+        return self.model_parallel_size
+
+    def get_model_parallel_world_size(self):
+        return self.model_parallel_size
+
+    @property
+    def topology(self):
+        return self._topo
+
+    def get_stage_group(self):
+        return "stage"
+
+    def get_data_parallel_group(self):
+        return ("data", "fsdp", "expert")
+
+    def get_model_parallel_group(self):
+        return "model"

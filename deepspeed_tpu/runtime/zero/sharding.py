@@ -47,7 +47,7 @@ TP_RULES = {
     "mlp": "model",       # column-parallel FFN hidden
     "vocab": "model",     # vocab-parallel embedding / lm head
     "heads": "model",
-    "experts": None,      # expert axis handled by MoE layer itself
+    "experts": "expert",  # stacked-expert dim -> expert-parallel axis
     "embed": None,
     "embed_out": None,
     "pos": None,
@@ -59,13 +59,20 @@ TP_RULES = {
 FSDP_AXIS = "fsdp"
 
 
-def make_param_rules(stage: int, persistence_threshold: int = 0):
-    """Return fn(names, shape, mesh) -> PartitionSpec for a parameter."""
+def make_param_rules(stage: int, persistence_threshold: int = 0,
+                     layers_axis=None):
+    """Return fn(names, shape, mesh) -> PartitionSpec for a parameter.
+
+    ``layers_axis``: mesh axis for the "layers" logical dim — None for
+    scan-over-layers models, "stage" for pipeline-parallel stacks."""
+    table = dict(TP_RULES)
+    if layers_axis is not None:
+        table["layers"] = layers_axis
 
     def rules(names, shape, mesh):
         if names is None:
             names = (None,) * len(shape)
-        axes = [TP_RULES.get(n) if n is not None else None for n in names]
+        axes = [table.get(n) if n is not None else None for n in names]
         axes = [a if _divisible(shape, i, a, mesh) else None
                 for i, a in enumerate(axes)]
 
@@ -93,12 +100,24 @@ def make_opt_state_rules(stage: int, mesh):
     stage 0: follow the param. stage >= 1: additionally shard over the
     data(+expert) axes on the largest free dim — the ZeRO-1 partition.
     """
-    shard_axes = tuple(a for a in ("data", "expert") if mesh.shape.get(a, 1) > 1)
+    base_axes = tuple(a for a in ("data", "expert") if mesh.shape.get(a, 1) > 1)
 
     def rules(param_spec: P, shape):
-        if stage < 1 or not shard_axes or not shape:
+        if stage < 1 or not base_axes or not shape:
             return param_spec
         axes = list(param_spec) + [None] * (len(shape) - len(param_spec))
+        # Never reuse an axis the param itself is sharded over (e.g. expert
+        # params already claim "expert" on their stacked dim — their opt
+        # state shards over the remaining DP axes only, mirroring the
+        # reference's separate expert DP groups, groups.py:107).
+        used = set()
+        for a in axes:
+            for x in (a if isinstance(a, (tuple, list)) else (a,)):
+                if x is not None:
+                    used.add(x)
+        shard_axes = tuple(a for a in base_axes if a not in used)
+        if not shard_axes:
+            return P(*axes)
         free = sorted((i for i, a in enumerate(axes) if a is None),
                       key=lambda i: -shape[i])
         for i in free:

@@ -22,8 +22,19 @@ def _shard_map_fn_and_kw():
     return fn, "check_rep"
 
 
-def shard_map(f, mesh, in_specs, out_specs, check=False):
+def shard_map(f, mesh, in_specs, out_specs, check=False, axis_names=None):
     """shard_map with replication checking off by default (our collectives
-    handle replication explicitly, as the reference's NCCL calls did)."""
+    handle replication explicitly, as the reference's NCCL calls did).
+
+    ``axis_names``: map over only these mesh axes; the rest stay under
+    automatic GSPMD partitioning (used by the pipeline engine to permute
+    over "stage" while data/model axes shard transparently)."""
     fn, kw = _shard_map_fn_and_kw()
-    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **{kw: check})
+    kwargs = {kw: check}
+    if axis_names is not None:
+        if "axis_names" not in inspect.signature(fn).parameters:
+            raise NotImplementedError(
+                "this jax version's shard_map lacks axis_names (partial "
+                "manual axes); upgrade jax for pipeline parallelism")
+        kwargs["axis_names"] = set(axis_names)
+    return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

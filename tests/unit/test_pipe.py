@@ -1,0 +1,193 @@
+"""Pipeline tests.
+
+Reference analogs: tests/unit/test_topology.py (coords/ranks/comm lists),
+test_pipe_schedule.py (instruction streams), test_pipe.py (end-to-end
+pipeline training convergence).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.comm import MeshSpec, build_mesh
+from deepspeed_tpu.models import GPTConfig, gpt_loss_fn
+from deepspeed_tpu.models.layers import Block
+from deepspeed_tpu.models.pipeline_blocks import GPTEmbed, GPTHead
+from deepspeed_tpu.runtime.pipe.module import (PipelineModule, LayerSpec,
+                                               partition_balanced)
+from deepspeed_tpu.runtime.pipe.schedule import (TrainSchedule,
+                                                 InferenceSchedule,
+                                                 ForwardPass, BackwardPass,
+                                                 OptimizerStep)
+from deepspeed_tpu.runtime.pipe.topology import (ProcessTopology,
+                                                 PipeModelDataParallelTopology)
+
+
+# ---------------------------------------------------------------- topology
+
+def test_topology_ranks():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+    assert topo.world_size() == 8
+    assert topo.get_rank(pipe=0, data=0) == 0
+    assert topo.get_rank(pipe=1, data=3) == 7
+    assert topo.get_coord(5).pipe == 1
+    assert topo.get_coord(5).data == 1
+
+
+def test_topology_comm_lists():
+    topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 2])
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert sorted(map(tuple, pipe_lists)) == [(0, 2), (1, 3)]
+    data_lists = topo.get_axis_comm_lists("data")
+    assert sorted(map(tuple, data_lists)) == [(0, 1), (2, 3)]
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.world_size() == 8
+    ranks = topo.filter_match(pipe=0)
+    assert len(ranks) == 4
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert "pipe_00" in topo.get_rank_repr(rank=0)
+
+
+# ---------------------------------------------------------------- schedule
+
+def test_train_schedule_structure():
+    sched = TrainSchedule(micro_batches=4, stages=2, stage_id=0)
+    steps = list(sched.steps())
+    # 2*(4+2-1) = 10 ticks; last tick carries the optimizer step
+    assert len(steps) == 10
+    assert any(isinstance(c, OptimizerStep) for c in steps[-1])
+    fwd = sum(isinstance(c, ForwardPass) for cmds in steps for c in cmds)
+    bwd = sum(isinstance(c, BackwardPass) for cmds in steps for c in cmds)
+    assert fwd == 4 and bwd == 4
+
+
+def test_train_schedule_fwd_before_bwd_per_micro():
+    for stage_id in range(2):
+        sched = TrainSchedule(micro_batches=4, stages=2, stage_id=stage_id)
+        seen_fwd = set()
+        for cmds in sched.steps():
+            for c in cmds:
+                if isinstance(c, ForwardPass):
+                    seen_fwd.add(c.buffer_id)
+                if isinstance(c, BackwardPass):
+                    assert c.buffer_id in seen_fwd
+
+
+def test_inference_schedule():
+    sched = InferenceSchedule(micro_batches=3, stages=2, stage_id=1)
+    steps = list(sched.steps())
+    assert len(steps) == 4  # micro + stages - 1
+    fwd = sum(isinstance(c, ForwardPass) for cmds in steps for c in cmds)
+    assert fwd == 3
+
+
+def test_partition_balanced():
+    assert partition_balanced([1, 1, 1, 1], 2) == [0, 2, 4]
+    bounds = partition_balanced([1, 1, 10, 1], 2)
+    assert bounds[0] == 0 and bounds[-1] == 4
+    # heavy layer gets its own part
+    sizes = [bounds[i + 1] - bounds[i] for i in range(2)]
+    assert min(sizes) >= 1
+
+
+# ------------------------------------------------------------- end-to-end
+
+VOCAB, SEQ, D = 128, 16, 32
+MCFG = GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ, d_model=D, n_layers=4,
+                 n_heads=4, dtype=jnp.float32, tie_embeddings=False)
+
+
+def pipe_loss_fn(logits, batch):
+    ids = batch["input_ids"]
+    return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
+
+
+def make_pipe_engine(stages=4, n_micro=2):
+    block_kwargs = dict(n_heads=MCFG.n_heads, d_model=MCFG.d_model,
+                        d_ff=MCFG.ffn_dim, causal=True, dtype=jnp.float32)
+    module = PipelineModule(
+        embed=GPTEmbed(MCFG), block=Block(**block_kwargs),
+        n_blocks=MCFG.n_layers, head=GPTHead(MCFG),
+        num_stages=stages, loss_fn=pipe_loss_fn)
+    mesh = build_mesh(MeshSpec(stage=stages, data=8 // stages))
+    config = {
+        "train_batch_size": 8 * n_micro // stages * stages,
+        "gradient_accumulation_steps": n_micro,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+        "mesh": {"stage": stages},
+    }
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, VOCAB, size=(config["train_batch_size"], SEQ), dtype=np.int32)}
+    engine, _, _, _ = ds.initialize(
+        model=module, config=config, loss_fn=pipe_loss_fn,
+        sample_batch={"input_ids": batch["input_ids"][:1]},
+        rng=jax.random.PRNGKey(7), mesh=mesh)
+    return engine, batch
+
+
+def test_pipeline_matches_sequential():
+    """The pipelined trunk must equal running the same blocks sequentially
+    with the same params (the strongest correctness check)."""
+    engine, batch = make_pipe_engine(stages=4, n_micro=2)
+    params = engine.params
+    module = engine.pipe
+
+    def sequential_loss(params, batch):
+        ids = jnp.asarray(batch["input_ids"])
+        h = module.embed.apply(params["embed"], ids)
+
+        def body(h, p):
+            out = module.block.apply(p, h, deterministic=True)
+            return out, None
+        h, _ = jax.lax.scan(body, h, params["blocks"])
+        logits = module.head.apply(params["head"], h)
+        return pipe_loss_fn(logits, batch)
+
+    pipe_loss = float(engine.eval_batch(batch))
+    seq_loss = float(jax.jit(sequential_loss)(params, batch))
+    np.testing.assert_allclose(pipe_loss, seq_loss, rtol=1e-5)
+
+
+def test_pipeline_trains():
+    engine, batch = make_pipe_engine(stages=4, n_micro=2)
+    losses = [float(engine.train_batch(batch)) for _ in range(15)]
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_pipeline_with_dp_axis():
+    engine, batch = make_pipe_engine(stages=2, n_micro=2)
+    assert engine.dp_world_size == 4
+    l0 = float(engine.train_batch(batch))
+    l1 = float(engine.train_batch(batch))
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+
+def test_blocks_sharded_over_stage():
+    engine, _ = make_pipe_engine(stages=4, n_micro=2)
+    from jax.sharding import PartitionSpec as P
+    specs = jax.tree.leaves(engine.param_specs["blocks"],
+                            is_leaf=lambda x: isinstance(x, P))
+    assert all(s and s[0] == "stage" for s in specs), specs
+
+
+def test_pipeline_module_layer_spec_collapse():
+    block_kwargs = dict(n_heads=4, d_model=D, d_ff=4 * D, causal=True,
+                        dtype=jnp.float32)
+    specs = [LayerSpec(GPTEmbed, MCFG)] + \
+        [LayerSpec(Block, **block_kwargs) for _ in range(4)] + \
+        [LayerSpec(GPTHead, MCFG)]
+    module = PipelineModule(layers=specs, num_stages=2, loss_fn=pipe_loss_fn)
+    assert module.n_blocks == 4
+    assert module.embed is not None and module.head is not None
+    assert module.stage_of_layer(0) == 0
+    assert module.stage_of_layer(3) == 1
