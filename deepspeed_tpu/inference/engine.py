@@ -64,12 +64,18 @@ class InferenceEngine:
 
     def forward(self, *args, **kwargs):
         """Jitted module forward (compiled once per shape — the XLA analog
-        of CUDA-graph replay)."""
-        if "forward" not in self._compiled:
+        of CUDA-graph replay). Non-array kwargs (decode, deterministic, ...)
+        are compile-time constants: each combination gets its own cached
+        specialization."""
+        static = {k: v for k, v in kwargs.items()
+                  if not hasattr(v, "shape") and not isinstance(v, (list, dict))}
+        arrays = {k: v for k, v in kwargs.items() if k not in static}
+        key = ("forward", tuple(sorted(static.items())))
+        if key not in self._compiled:
             module = self.module
-            self._compiled["forward"] = jax.jit(
-                lambda p, a, kw: module.apply(p, *a, **kw))
-        return self._compiled["forward"](self.params, args, kwargs)
+            self._compiled[key] = jax.jit(
+                lambda p, a, kw: module.apply({"params": p}, *a, **kw, **static))
+        return self._compiled[key](self.params, args, arrays)
 
     __call__ = forward
 

@@ -1,0 +1,124 @@
+"""Autoregressive generation with a preallocated KV cache.
+
+Reference: the KV-cache attention path in DeepSpeedTransformerInference
+(ops/transformer/inference/transformer_inference.py:732 — `layer_past`
+handling) backed by the `softmax_context` CUDA kernel
+(csrc/transformer/inference/csrc/pt_binding.cpp). The CUDA-graph
+capture/replay of InferenceEngine (inference/engine.py:455/:474) maps to
+one jitted decode step re-used across tokens.
+
+TPU-first mechanics:
+- the cache is preallocated at [batch, max_len, heads, head_dim] (stacked
+  [L, ...] under nn.scan) and updated in place with
+  ``lax.dynamic_update_slice`` — static shapes, one compile;
+- the token loop is ``lax.scan`` over decode steps, entirely on device;
+- prefill (the whole prompt in one forward) and decode (one token) are two
+  cached jit specializations.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache(module, params, batch_size: int, max_len: int):
+    """Allocate the KV cache by shape-only init (no FLOPs burned)."""
+    ids = jnp.zeros((batch_size, max_len), jnp.int32)
+
+    def mk(p):
+        variables = module.init(jax.random.PRNGKey(0), ids, decode=True)
+        return variables["cache"]
+    cache_shape = jax.eval_shape(mk, params)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shape)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _prefill(module, params, cache, input_ids, positions):
+    logits, vars_out = module.apply(
+        {"params": params, "cache": cache}, input_ids, decode=True,
+        positions=positions, mutable=["cache"])
+    return logits, vars_out["cache"]
+
+
+def _sample(logits, rng, temperature, top_k, top_p):
+    """logits: [batch, vocab] -> [batch] token ids."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set with cumulative prob >= top_p: keep logits >= cutoff
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+@partial(jax.jit, static_argnums=(0, 5, 6, 7, 8))
+def _decode_loop(module, params, cache, last_token, start_pos,
+                 num_steps: int, temperature: float, top_k, top_p, rng):
+    """Scan num_steps single-token forwards; returns [batch, num_steps]."""
+
+    def step(carry, i):
+        cache, token, pos = carry
+        logits, vars_out = module.apply(
+            {"params": params, "cache": cache}, token[:, None], decode=True,
+            positions=pos[None], mutable=["cache"])
+        nxt = _sample(logits[:, -1, :], jax.random.fold_in(rng, i),
+                      temperature, top_k, top_p)
+        return (vars_out["cache"], nxt, pos + 1), nxt
+
+    (cache, _, _), tokens = jax.lax.scan(
+        step, (cache, last_token, start_pos), jnp.arange(num_steps))
+    return jnp.transpose(tokens), cache
+
+
+def generate(module, params, input_ids, *, max_new_tokens: int = 32,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None, rng: Optional[jax.Array] = None,
+             eos_token_id: Optional[int] = None, max_len: Optional[int] = None):
+    """Generate continuations for a batch of equal-length prompts.
+
+    Returns [batch, prompt_len + max_new_tokens] token ids. ``eos_token_id``
+    tokens past the first EOS are replaced by EOS (the loop itself runs the
+    full static length — XLA-friendly; the reference's python `while` loop
+    would retrace per length).
+    """
+    input_ids = jnp.asarray(input_ids)
+    if input_ids.ndim == 1:
+        input_ids = input_ids[None]
+    b, prompt_len = input_ids.shape
+    total = max_len or (prompt_len + max_new_tokens)
+    if total < prompt_len + max_new_tokens:
+        raise ValueError("max_len too small for prompt + max_new_tokens")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    cache = init_cache(module, params, b, total)
+    logits, cache = _prefill(module, params, cache, input_ids,
+                             jnp.arange(prompt_len))
+    first = _sample(logits[:, -1, :], rng, temperature, top_k, top_p)
+
+    if max_new_tokens > 1:
+        rest, cache = _decode_loop(
+            module, params, cache, first, jnp.int32(prompt_len),
+            max_new_tokens - 1, temperature, top_k, top_p,
+            jax.random.fold_in(rng, 2**31))
+        out = jnp.concatenate([input_ids, first[:, None], rest], axis=1)
+    else:
+        out = jnp.concatenate([input_ids, first[:, None]], axis=1)
+
+    if eos_token_id is not None:
+        gen = out[:, prompt_len:]
+        seen = jnp.cumsum(jnp.asarray(gen == eos_token_id, jnp.int32),
+                          axis=1) - jnp.asarray(gen == eos_token_id, jnp.int32)
+        gen = jnp.where(seen > 0, eos_token_id, gen)
+        out = jnp.concatenate([out[:, :prompt_len], gen], axis=1)
+    return out

@@ -33,6 +33,7 @@ class BertConfig:
     scan_layers: bool = True
     remat: str = "none"
     attn_backend: Optional[str] = None
+    activation: str = "gelu_exact"  # HF BERT uses exact GELU
 
     @property
     def ffn_dim(self):
@@ -87,7 +88,7 @@ class BertEncoder(nn.Module):
             causal=False, pre_ln=cfg.pre_ln, dropout_rate=cfg.dropout_rate,
             attn_dropout_rate=cfg.attn_dropout_rate, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, ln_epsilon=cfg.ln_epsilon,
-            attn_backend=cfg.attn_backend)
+            attn_backend=cfg.attn_backend, activation=cfg.activation)
 
         block_cls = Block
         if cfg.remat != "none":

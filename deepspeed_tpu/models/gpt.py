@@ -47,11 +47,18 @@ class GPTConfig:
     ln_epsilon: float = 1e-5
     tie_embeddings: bool = True
     rotary: bool = False                 # GPT-J/NeoX style when True
+    rotary_dim: Optional[int] = None     # GPT-J: 64; None = full head_dim
     learned_pos: bool = True             # GPT-2 learned position embeddings
     scan_layers: bool = True
     remat: str = "none"                  # key into REMAT_POLICIES
     activation: str = "gelu"
     attn_backend: Optional[str] = None   # None=auto, "reference", "pallas"
+    parallel_residual: bool = False      # GPT-J / GPT-NeoX layout
+    shared_parallel_ln: bool = False     # GPT-J (one LN), NeoX uses two
+    attn_use_bias: Optional[bool] = None  # GPT-J: False (mlp keeps bias)
+    alibi: bool = False                  # BLOOM positioning
+    embed_ln: bool = False               # BLOOM word_embeddings_layernorm
+    lm_head_bias: bool = False           # GPT-J untied head carries a bias
 
     @property
     def ffn_dim(self):
@@ -85,7 +92,7 @@ class GPT(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, *, attention_mask=None, deterministic=True,
-                 layer_keep_prob=None, positions=None):
+                 layer_keep_prob=None, positions=None, decode=False):
         cfg = self.config
         b, s = input_ids.shape
 
@@ -95,14 +102,17 @@ class GPT(nn.Module):
             (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
         h = jnp.take(wte, input_ids, axis=0).astype(cfg.dtype)
 
+        if positions is None:
+            positions = jnp.arange(s)
         if cfg.learned_pos:
             wpe = self.param(
                 "wpe", nn.with_logical_partitioning(
                     nn.initializers.normal(0.02), ("pos", "embed")),
                 (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
-            if positions is None:
-                positions = jnp.arange(s)
             h = h + jnp.take(wpe, positions, axis=0).astype(cfg.dtype)
+
+        if cfg.embed_ln:
+            h = LayerNorm(epsilon=cfg.ln_epsilon, name="emb_ln")(h)
 
         if cfg.dropout_rate > 0.0 and not deterministic:
             h = nn.Dropout(rate=cfg.dropout_rate)(h, deterministic=False)
@@ -112,13 +122,18 @@ class GPT(nn.Module):
         if attention_mask is not None:
             mask = attention_mask[:, None, None, :].astype(bool)
 
+        bias = None
         block_kwargs = dict(
             n_heads=cfg.n_heads, d_model=cfg.d_model, d_ff=cfg.ffn_dim,
             causal=True, pre_ln=True, dropout_rate=cfg.dropout_rate,
             attn_dropout_rate=cfg.attn_dropout_rate, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, use_bias=cfg.use_bias,
             ln_epsilon=cfg.ln_epsilon, rotary=cfg.rotary,
-            activation=cfg.activation, attn_backend=cfg.attn_backend)
+            rotary_dim=cfg.rotary_dim, activation=cfg.activation,
+            attn_backend=cfg.attn_backend,
+            parallel_residual=cfg.parallel_residual,
+            shared_parallel_ln=cfg.shared_parallel_ln,
+            attn_use_bias=cfg.attn_use_bias, alibi=cfg.alibi)
 
         block_cls = Block
         policy = REMAT_POLICIES.get(cfg.remat)
@@ -129,13 +144,14 @@ class GPT(nn.Module):
 
         if cfg.scan_layers:
             def body(block, carry):
-                x = block(carry, mask, None, deterministic,
-                          layer_keep_prob=layer_keep_prob)
+                x = block(carry, mask, bias, deterministic,
+                          layer_keep_prob=layer_keep_prob, decode=decode,
+                          positions=positions)
                 return x, None
 
             h, _ = nn.scan(
                 body,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
@@ -143,7 +159,8 @@ class GPT(nn.Module):
         else:
             for i in range(cfg.n_layers):
                 h = block_cls(**block_kwargs, name=f"h_{i}")(
-                    h, mask, None, deterministic, layer_keep_prob=layer_keep_prob)
+                    h, mask, bias, deterministic, layer_keep_prob=layer_keep_prob,
+                    decode=decode, positions=positions)
 
         h = LayerNorm(epsilon=cfg.ln_epsilon, name="ln_f")(h)
 
@@ -151,10 +168,12 @@ class GPT(nn.Module):
             logits = jnp.einsum("bsd,vd->bsv", h, wte.astype(cfg.dtype))
         else:
             logits = nn.DenseGeneral(
-                features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
-                param_dtype=cfg.param_dtype,
+                features=cfg.vocab_size, use_bias=cfg.lm_head_bias,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                 kernel_init=nn.with_logical_partitioning(
                     nn.initializers.normal(0.02), ("embed", "vocab")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros, ("vocab",)),
                 name="lm_head")(h)
         return logits
 

@@ -81,7 +81,15 @@ class LayerNorm(nn.Module):
 class SelfAttention(nn.Module):
     """Fused-QKV multi-head attention (reference: DeepSpeedSelfAttention,
     ops/transformer/inference/transformer_inference.py:473, training kernel
-    csrc/transformer/ds_transformer_cuda.cpp)."""
+    csrc/transformer/ds_transformer_cuda.cpp).
+
+    ``decode=True`` enables the preallocated KV cache (reference: the
+    softmax_context KV-cache kernel, csrc/transformer/inference): cache
+    variables live in the "cache" collection; prefill writes the whole
+    prompt at index 0, each decode step appends one token with
+    ``lax.dynamic_update_slice``. Initialize the cache by applying the
+    model once on a [batch, max_len] input with ``mutable=["cache"]``.
+    """
     n_heads: int
     d_model: int
     causal: bool = True
@@ -92,9 +100,11 @@ class SelfAttention(nn.Module):
     rotary: bool = False
     rotary_dim: Optional[int] = None
     attn_backend: Optional[str] = None
+    alibi: bool = False
 
     @nn.compact
-    def __call__(self, x, mask=None, bias=None, deterministic=True):
+    def __call__(self, x, mask=None, bias=None, deterministic=True,
+                 decode=False, positions=None):
         head_dim = self.d_model // self.n_heads
         qkv = nn.DenseGeneral(
             features=3 * self.d_model, use_bias=self.use_bias, dtype=self.dtype,
@@ -111,13 +121,57 @@ class SelfAttention(nn.Module):
         if self.rotary:
             from ..ops.transformer.rotary import apply_rotary_pos_emb
             rdim = self.rotary_dim or head_dim
-            q, k = apply_rotary_pos_emb(q, k, rotary_dim=rdim)
+            q, k = apply_rotary_pos_emb(q, k, rotary_dim=rdim,
+                                        positions=positions)
+
+        causal = self.causal
+        if decode:
+            cached_key = self.variable("cache", "cached_key", jnp.zeros,
+                                       k.shape, k.dtype)
+            cached_value = self.variable("cache", "cached_value", jnp.zeros,
+                                         v.shape, v.dtype)
+            cache_index = self.variable("cache", "cache_index",
+                                        lambda: jnp.zeros((), jnp.int32))
+            if self.is_initializing():
+                max_len = s
+            else:
+                max_len = cached_key.value.shape[1]
+                idx = cache_index.value
+                k = jax.lax.dynamic_update_slice(cached_key.value, k,
+                                                 (0, idx, 0, 0))
+                v = jax.lax.dynamic_update_slice(cached_value.value, v,
+                                                 (0, idx, 0, 0))
+                cached_key.value = k
+                cached_value.value = v
+                cache_index.value = idx + s
+                # validity+causality in one mask: query row i (global pos
+                # idx+i) may attend to cache slots j <= idx+i.
+                rows = idx + jnp.arange(s)[:, None]
+                cols = jnp.arange(max_len)[None, :]
+                cache_mask = (cols <= rows)[None, None, :, :]
+                if mask is not None and mask.shape[-1] != max_len:
+                    # caller's mask covers only the current chunk: scatter it
+                    # into cache key space at the write offset.
+                    full = jnp.ones(mask.shape[:-1] + (max_len,), bool)
+                    mask = jax.lax.dynamic_update_slice(
+                        full, mask.astype(bool), (0,) * (mask.ndim - 1) + (idx,))
+                mask = cache_mask if mask is None else jnp.logical_and(
+                    mask, cache_mask)
+                causal = False
+
+        if self.alibi:
+            # computed HERE (not in the model) because only the attention op
+            # knows the true key length once the KV cache is spliced in.
+            q_pos = positions if positions is not None else jnp.arange(s)
+            ab = alibi_bias(self.n_heads, jnp.broadcast_to(q_pos, (s,)),
+                            jnp.arange(k.shape[1]), dtype=jnp.float32)
+            bias = ab if bias is None else bias + ab
 
         dropout_rng = None
         if self.dropout_rate > 0.0 and not deterministic:
             dropout_rng = self.make_rng("dropout")
 
-        out = attention(q, k, v, bias=bias, mask=mask, causal=self.causal,
+        out = attention(q, k, v, bias=bias, mask=mask, causal=causal,
                         dropout_rate=self.dropout_rate, dropout_rng=dropout_rng,
                         deterministic=deterministic, backend=self.attn_backend)
         out = out.reshape(b, s, self.d_model)
@@ -174,7 +228,8 @@ class MLP(nn.Module):
 class Block(nn.Module):
     """One transformer layer. pre_ln=True is the GPT/modern layout; False is
     the original BERT post-LN layout (reference supports both via the
-    pre_layer_norm flag, ds_transformer_cuda.cpp)."""
+    pre_layer_norm flag, ds_transformer_cuda.cpp). parallel_residual=True is
+    the GPT-J/NeoX layout: y = x + attn(ln1(x)) + mlp(ln_parallel(x))."""
     n_heads: int
     d_model: int
     d_ff: int
@@ -187,36 +242,59 @@ class Block(nn.Module):
     use_bias: bool = True
     ln_epsilon: float = 1e-5
     rotary: bool = False
+    rotary_dim: Optional[int] = None
     activation: str = "gelu"
     mlp_factory: Optional[Callable[..., nn.Module]] = None
     attn_backend: Optional[str] = None
+    parallel_residual: bool = False
+    shared_parallel_ln: bool = False     # GPT-J: one LN feeds attn AND mlp
+    attn_use_bias: Optional[bool] = None  # None -> use_bias (GPT-J: False)
+    alibi: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None, bias=None, deterministic=True,
-                 layer_keep_prob=None):
+                 layer_keep_prob=None, decode=False, positions=None):
+        attn_bias = self.use_bias if self.attn_use_bias is None else self.attn_use_bias
         attn = SelfAttention(n_heads=self.n_heads, d_model=self.d_model,
                              causal=self.causal, dropout_rate=self.attn_dropout_rate,
                              dtype=self.dtype, param_dtype=self.param_dtype,
-                             use_bias=self.use_bias, rotary=self.rotary,
-                             attn_backend=self.attn_backend, name="attn")
+                             use_bias=attn_bias, rotary=self.rotary,
+                             rotary_dim=self.rotary_dim,
+                             attn_backend=self.attn_backend,
+                             alibi=self.alibi, name="attn")
         mlp_cls = self.mlp_factory or (lambda name: MLP(
             d_model=self.d_model, d_ff=self.d_ff, dtype=self.dtype,
             param_dtype=self.param_dtype, use_bias=self.use_bias,
             activation=self.activation, dropout_rate=self.dropout_rate, name=name))
         mlp = mlp_cls(name="mlp")
         ln1 = LayerNorm(epsilon=self.ln_epsilon, name="ln_1")
-        ln2 = LayerNorm(epsilon=self.ln_epsilon, name="ln_2")
 
         aux = None
-        if self.pre_ln:
-            a = attn(ln1(x), mask=mask, bias=bias, deterministic=deterministic)
+        if self.parallel_residual:
+            h1 = ln1(x)
+            if self.shared_parallel_ln:
+                h2 = h1
+            else:
+                h2 = LayerNorm(epsilon=self.ln_epsilon, name="ln_2")(x)
+            a = attn(h1, mask=mask, bias=bias, deterministic=deterministic,
+                     decode=decode, positions=positions)
+            m = mlp(h2, deterministic=deterministic)
+            if isinstance(m, tuple):
+                m, aux = m
+            y = x + a + m
+        elif self.pre_ln:
+            ln2 = LayerNorm(epsilon=self.ln_epsilon, name="ln_2")
+            a = attn(ln1(x), mask=mask, bias=bias, deterministic=deterministic,
+                     decode=decode, positions=positions)
             x = x + a
             m = mlp(ln2(x), deterministic=deterministic)
             if isinstance(m, tuple):  # MoE returns (out, aux_loss)
                 m, aux = m
             y = x + m
         else:
-            a = attn(x, mask=mask, bias=bias, deterministic=deterministic)
+            ln2 = LayerNorm(epsilon=self.ln_epsilon, name="ln_2")
+            a = attn(x, mask=mask, bias=bias, deterministic=deterministic,
+                     decode=decode, positions=positions)
             x = ln1(x + a)
             m = mlp(x, deterministic=deterministic)
             if isinstance(m, tuple):
@@ -229,3 +307,28 @@ class Block(nn.Module):
             y = x + layer_keep_prob * (y - x)
         y = activation_constraint(y, ("batch", "seq", "embed"))
         return (y, aux) if aux is not None else y
+
+
+def alibi_slopes(n_heads: int):
+    """ALiBi per-head slopes (BLOOM; reference analog: the alibi tensor fed
+    to the inference softmax kernel, csrc/transformer/inference softmax.cu
+    handles an `alibi` operand)."""
+    import math
+    closest = 2 ** math.floor(math.log2(n_heads))
+    base = [2 ** (-(2 ** -(math.log2(closest) - 3)) * (i + 1))
+            for i in range(closest)]
+    if closest != n_heads:
+        extra = [2 ** (-(2 ** -(math.log2(2 * closest) - 3)) * (i + 1))
+                 for i in range(0, 2 * (n_heads - closest), 2)]
+        base += extra
+    return jnp.asarray(base, jnp.float32)
+
+
+def alibi_bias(n_heads: int, q_positions, k_positions, dtype=jnp.float32):
+    """[1, heads, q, k] additive attention bias: slope * (k_pos - q_pos),
+    clamped to <=0 on the causal side (standard ALiBi: bias depends only on
+    key distance)."""
+    slopes = alibi_slopes(n_heads)
+    rel = (k_positions[None, :] - q_positions[:, None]).astype(jnp.float32)
+    bias = slopes[:, None, None] * rel[None, :, :]
+    return bias[None].astype(dtype)

@@ -1,0 +1,7 @@
+"""Kernel injection (reference: deepspeed/module_inject/)."""
+
+from .replace_module import replace_transformer_layer  # noqa: F401
+from .replace_policy import (  # noqa: F401
+    InjectionPolicy, HFGPT2LayerPolicy, HFGPTNEOLayerPolicy,
+    HFGPTJLayerPolicy, GPTNEOXLayerPolicy, BLOOMLayerPolicy,
+    HFBertLayerPolicy, replace_policies, POLICY_REGISTRY)

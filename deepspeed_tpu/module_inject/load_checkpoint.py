@@ -1,0 +1,121 @@
+"""Checkpoint loading for inference.
+
+Reference: deepspeed/module_inject/load_checkpoint.py (direct sharded load
+into injected modules) + deepspeed/runtime/state_dict_factory.py:17
+SDLoaderFactory (versioned Megatron/HF loaders with TP merge/split).
+
+Supported sources:
+- a directory with HF ``pytorch_model.bin`` / sharded
+  ``pytorch_model-*-of-*.bin`` files (torch pickles, loaded on host),
+- a single torch checkpoint file,
+- a dict of numpy arrays (already a state dict),
+- one of our orbax engine checkpoints (module params saved by
+  runtime/checkpointing.py).
+
+TP resharding on load is free: params are placed with NamedSharding, so a
+checkpoint saved at any TP degree loads at any other (the reference needs
+explicit merge/split logic, state_dict_factory.py:252/:320).
+"""
+
+import json
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+def load_state_dict_from_checkpoint(checkpoint) -> Dict[str, np.ndarray]:
+    """Resolve `checkpoint` (path/dict/json descriptor) to a numpy state dict."""
+    if isinstance(checkpoint, dict) and all(
+            isinstance(v, np.ndarray) for v in checkpoint.values()):
+        return checkpoint
+    if isinstance(checkpoint, dict) and "checkpoints" in checkpoint:
+        # reference: sharded-checkpoint json descriptor
+        # (inference/engine.py:240 _get_all_ckpt_names path)
+        base = checkpoint.get("base_dir", "")
+        files = [os.path.join(base, f) for f in checkpoint["checkpoints"]]
+        sd = {}
+        for f in files:
+            sd.update(_load_torch_file(f))
+        return sd
+    if isinstance(checkpoint, str):
+        if os.path.isdir(checkpoint):
+            return _load_hf_dir(checkpoint)
+        return _load_torch_file(checkpoint)
+    raise ValueError(f"unsupported checkpoint spec: {type(checkpoint)}")
+
+
+def _load_hf_dir(path: str) -> Dict[str, np.ndarray]:
+    index = os.path.join(path, "pytorch_model.bin.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            shard_files = sorted(set(json.load(f)["weight_map"].values()))
+        sd = {}
+        for fname in shard_files:
+            sd.update(_load_torch_file(os.path.join(path, fname)))
+        return sd
+    single = os.path.join(path, "pytorch_model.bin")
+    if os.path.exists(single):
+        return _load_torch_file(single)
+    # safetensors fallback
+    st = [f for f in os.listdir(path) if f.endswith(".safetensors")]
+    if st:
+        return _load_safetensors([os.path.join(path, f) for f in sorted(st)])
+    raise FileNotFoundError(f"no checkpoint files under {path}")
+
+
+def _load_torch_file(path: str) -> Dict[str, np.ndarray]:
+    import torch
+    logger.info(f"loading torch checkpoint {path}")
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if "module" in sd and isinstance(sd["module"], dict):
+        sd = sd["module"]  # reference engine checkpoints nest under 'module'
+    out = {}
+    for k, v in sd.items():
+        if hasattr(v, "detach"):
+            v = v.detach().cpu()
+            v = v.float() if v.is_floating_point() else v
+            out[k] = v.numpy()
+    return out
+
+
+def _load_safetensors(paths) -> Dict[str, np.ndarray]:
+    from safetensors import safe_open
+    out = {}
+    for p in paths:
+        with safe_open(p, framework="np") as f:
+            for k in f.keys():
+                out[k] = np.asarray(f.get_tensor(k))
+    return out
+
+
+def load_model_checkpoint(module, checkpoint, mesh, dtype=None, policy=None,
+                          hf_config=None):
+    """Load + convert + shard params for `module` from `checkpoint`.
+
+    For a raw HF checkpoint the architecture config is needed to drive the
+    policy: pass ``hf_config``, or point ``checkpoint`` at a directory
+    containing ``config.json`` (loaded via transformers AutoConfig)."""
+    if isinstance(checkpoint, str) and os.path.isdir(checkpoint) and \
+            os.path.exists(os.path.join(checkpoint, "latest")):
+        # one of our engine checkpoints: params stored as orbax tree
+        from ..runtime.checkpointing import load_module_params
+        return load_module_params(checkpoint, mesh)
+    sd = load_state_dict_from_checkpoint(checkpoint)
+    if hf_config is None:
+        if isinstance(checkpoint, str) and os.path.isdir(checkpoint) and \
+                os.path.exists(os.path.join(checkpoint, "config.json")):
+            from transformers import AutoConfig
+            hf_config = AutoConfig.from_pretrained(checkpoint)
+        else:
+            raise ValueError(
+                "loading a raw HF state dict needs the architecture config: "
+                "pass hf_config=, or a checkpoint dir with config.json "
+                "(or construct via replace_transformer_layer)")
+    from .replace_module import _resolve_policy, shard_params_for_inference
+    pol = _resolve_policy(hf_config, policy)
+    cfg = pol.build_config(hf_config, dtype)
+    params = pol.convert(sd, cfg)
+    return shard_params_for_inference(module, params, mesh, cfg)

@@ -1,0 +1,104 @@
+"""Kernel injection: HF model -> fused TPU-native flax model.
+
+Reference: deepspeed/module_inject/replace_module.py:120
+``replace_transformer_layer`` — walks a torch model, swaps each HF
+transformer layer for the fused-CUDA ``DeepSpeedTransformerInference``
+module, slicing weights across tensor-parallel ranks
+(``ReplaceWithTensorSlicing``, :16).
+
+TPU-native: instead of in-place module surgery, the whole HF model is
+re-expressed as one of our scan-stacked flax models and the HF weights are
+converted by an architecture policy (replace_policy.py here). TP "slicing"
+is a no-op at conversion time: placing the full array with a
+``NamedSharding`` whose spec puts qkv/mlp/vocab dims on the "model" mesh
+axis makes each device materialize only its slice — XLA's runtime does the
+strided copy the reference hand-codes in qkv_copy/strided_copy.
+"""
+
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+from ..runtime.zero.sharding import extract_logical_names, param_shardings
+from ..utils.logging import logger
+from .replace_policy import POLICY_REGISTRY
+
+
+def _resolve_policy(hf_config, policy=None):
+    if policy is not None:
+        return policy
+    mt = getattr(hf_config, "model_type", None)
+    if mt in POLICY_REGISTRY:
+        return POLICY_REGISTRY[mt]
+    raise ValueError(
+        f"no injection policy for model_type={mt!r}; available: "
+        f"{sorted(POLICY_REGISTRY)} (pass policy= explicitly for custom "
+        f"architectures, reference: injection_policy kwarg of init_inference)")
+
+
+def _state_dict_numpy(model) -> dict:
+    """torch state dict -> plain numpy dict (fp32 host copies)."""
+    out = {}
+    for k, v in model.state_dict().items():
+        arr = v.detach().cpu()
+        out[k] = np.asarray(arr.float().numpy() if arr.is_floating_point()
+                            else arr.numpy())
+    return out
+
+
+def replace_transformer_layer(model, params=None, policy=None,
+                              dtype=jnp.bfloat16, mesh=None,
+                              max_tokens: int = 1024, checkpoint=None):
+    """Convert a HF model (torch module or HF config) to (flax_module,
+    sharded_params).
+
+    Args:
+        model: a transformers PreTrainedModel (weights converted), or a HF
+            config object (random/checkpoint weights), or one of our flax
+            modules (returned unchanged).
+        params: pre-converted params to reuse (skips weight conversion).
+        policy: InjectionPolicy subclass override.
+        mesh: jax Mesh; TP = its "model" axis.
+    """
+    import flax.linen as nn
+    if isinstance(model, nn.Module):
+        return model, params
+
+    hf_config = getattr(model, "config", model)
+    pol = _resolve_policy(hf_config, policy)
+    cfg = pol.build_config(hf_config, dtype)
+    module = pol.model_class(cfg)
+
+    if params is None:
+        sd = None
+        if hasattr(model, "state_dict"):
+            sd = _state_dict_numpy(model)
+        elif checkpoint is not None:
+            from .load_checkpoint import load_state_dict_from_checkpoint
+            sd = load_state_dict_from_checkpoint(checkpoint)
+        if sd is not None:
+            params = pol.convert(sd, cfg)
+            logger.info(f"injected {pol.__name__}: {cfg.n_layers} layers "
+                        f"d_model={cfg.d_model} heads={cfg.n_heads}")
+
+    if params is not None and mesh is not None:
+        params = shard_params_for_inference(module, params, mesh, cfg)
+    return module, params
+
+
+def shard_params_for_inference(module, params, mesh, cfg):
+    """Place converted params onto the mesh with TP sharding (the analog of
+    ReplaceWithTensorSlicing: each device gets its qkv/mlp/vocab slice)."""
+    sample = jnp.zeros((1, 8), jnp.int32)
+    abstract = jax.eval_shape(
+        lambda: module.init(jax.random.PRNGKey(0), sample))
+    values_abs, names = extract_logical_names(abstract["params"])
+    shapes = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                          values_abs)
+    shardings = param_shardings(names, shapes, mesh, stage=0)
+    dtype_tree = jax.tree.map(lambda x: x.dtype, values_abs)
+    params = jax.tree.map(lambda x, dt: jnp.asarray(x, dt), params, dtype_tree)
+    return jax.device_put(params, shardings)

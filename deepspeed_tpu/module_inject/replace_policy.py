@@ -1,0 +1,440 @@
+"""Per-architecture injection policies.
+
+Reference: deepspeed/module_inject/replace_policy.py — each policy knows how
+to pull (qkv, attn-out, mlp, layernorm) weights out of a HuggingFace layer
+so replace_module can drop in the fused kernel module.
+
+TPU-native: a policy maps a HF *state dict* (numpy arrays) onto the param
+pytree of our fused flax models (models/gpt.py GPT, models/bert.py
+BertEncoder), stacking the per-layer weights along the scan axis. Tensor
+slicing for TP (the reference's ReplaceWithTensorSlicing,
+replace_module.py:16) is NOT done here — sharded ``jax.device_put`` against
+the mesh performs the slicing at placement time (replace_module.py in this
+package).
+
+Weight-layout notes, encoded per policy below:
+- HF Conv1D (GPT-2) stores [in, out] — no transpose. torch Linear stores
+  [out, in] — transpose.
+- GPT-NeoX / BLOOM fuse qkv per-head as [heads, 3, head_dim] on the out
+  dim; our fused layout is [3, heads, head_dim] (split in thirds) — rows
+  are permuted accordingly.
+- GPT-J applies *interleaved* rotary (pairs (2i, 2i+1)); our kernel uses
+  the NeoX half-split layout (pairs (i, i + r/2)). Permuting the q/k
+  projection rows with [0,2,...,r-2, 1,3,...,r-1] converts one to the
+  other exactly (attention scores are invariant because q and k get the
+  same permutation).
+"""
+
+from typing import Any, Dict
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..models.gpt import GPT, GPTConfig
+from ..models.bert import BertEncoder, BertConfig
+
+
+def _t(w):
+    return np.ascontiguousarray(w.T)
+
+
+# HF activation string -> ours. HF "gelu" is the *exact* erf GELU;
+# "gelu_new"/"gelu_pytorch_tanh" are the tanh approximation (= our "gelu").
+_ACT_MAP = {"gelu": "gelu_exact", "gelu_new": "gelu",
+            "gelu_pytorch_tanh": "gelu", "gelu_fast": "gelu",
+            "relu": "relu", "silu": "silu", "swish": "silu"}
+
+
+def _act(hf, *fields, default="gelu_new"):
+    for f in fields:
+        v = getattr(hf, f, None)
+        if v:
+            return _ACT_MAP.get(v, "gelu")
+    return _ACT_MAP[default]
+
+
+def _ln(sd, prefix):
+    return {"scale": np.asarray(sd[prefix + ".weight"], np.float32),
+            "bias": np.asarray(sd[prefix + ".bias"], np.float32)}
+
+
+def _stack(dicts):
+    """list of per-layer param dicts -> one dict stacked on axis 0."""
+    out = {}
+    for key in dicts[0]:
+        if isinstance(dicts[0][key], dict):
+            out[key] = _stack([d[key] for d in dicts])
+        else:
+            out[key] = np.stack([d[key] for d in dicts])
+    return out
+
+
+def _dense(kernel, bias=None):
+    d = {"kernel": np.asarray(kernel, np.float32)}
+    if bias is not None:
+        d["bias"] = np.asarray(bias, np.float32)
+    return d
+
+
+def _headfirst_qkv_to_split(w, n_heads):
+    """[.., 3*d] out-dim laid out [heads, 3, hd] -> [3, heads, hd] (ours).
+
+    w: already [in, 3d] (post-transpose)."""
+    d_in, d3 = w.shape
+    hd = d3 // (3 * n_heads)
+    w = w.reshape(d_in, n_heads, 3, hd)
+    return np.ascontiguousarray(
+        w.transpose(0, 2, 1, 3).reshape(d_in, d3))
+
+
+def _headfirst_qkv_bias_to_split(b, n_heads):
+    hd = b.shape[0] // (3 * n_heads)
+    return np.ascontiguousarray(
+        b.reshape(n_heads, 3, hd).transpose(1, 0, 2).reshape(-1))
+
+
+def _rotary_halfsplit_perm(rotary_dim, head_dim):
+    """Row permutation converting interleaved-rotary weights to half-split."""
+    perm = np.arange(head_dim)
+    perm[:rotary_dim] = np.concatenate(
+        [np.arange(0, rotary_dim, 2), np.arange(1, rotary_dim, 2)])
+    return perm
+
+
+class InjectionPolicy:
+    """Base: subclasses set ``model_type`` (HF config.model_type) and
+    implement build_config / convert (reference: DSPolicy ABC,
+    replace_policy.py:17)."""
+    model_type: str = ""
+    model_class = GPT
+
+    @classmethod
+    def build_config(cls, hf, dtype):
+        raise NotImplementedError
+
+    @classmethod
+    def convert(cls, sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+class HFGPT2LayerPolicy(InjectionPolicy):
+    """GPT-2 (reference: HFGPT2LayerPolicy, replace_policy.py:283)."""
+    model_type = "gpt2"
+
+    @classmethod
+    def build_config(cls, hf, dtype):
+        return GPTConfig(
+            vocab_size=hf.vocab_size, max_seq_len=hf.n_positions,
+            d_model=hf.n_embd, n_layers=hf.n_layer, n_heads=hf.n_head,
+            d_ff=hf.n_inner or 4 * hf.n_embd, dtype=dtype,
+            ln_epsilon=hf.layer_norm_epsilon, tie_embeddings=True,
+            learned_pos=True, scan_layers=True,
+            activation=_act(hf, "activation_function"))
+
+    @classmethod
+    def convert(cls, sd, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        layers = []
+        for i in range(cfg.n_layers):
+            lp = f"{pfx}h.{i}."
+            layers.append({
+                "ln_1": _ln(sd, lp + "ln_1"),
+                "ln_2": _ln(sd, lp + "ln_2"),
+                "attn": {
+                    "qkv": _dense(sd[lp + "attn.c_attn.weight"],
+                                  sd[lp + "attn.c_attn.bias"]),
+                    "out": _dense(sd[lp + "attn.c_proj.weight"],
+                                  sd[lp + "attn.c_proj.bias"]),
+                },
+                "mlp": {
+                    "fc_in": _dense(sd[lp + "mlp.c_fc.weight"],
+                                    sd[lp + "mlp.c_fc.bias"]),
+                    "fc_out": _dense(sd[lp + "mlp.c_proj.weight"],
+                                     sd[lp + "mlp.c_proj.bias"]),
+                },
+            })
+        return {
+            "wte": np.asarray(sd[pfx + "wte.weight"], np.float32),
+            "wpe": np.asarray(sd[pfx + "wpe.weight"], np.float32),
+            "h": _stack(layers),
+            "ln_f": _ln(sd, pfx + "ln_f"),
+        }
+
+
+class HFGPTNEOLayerPolicy(InjectionPolicy):
+    """GPT-Neo (reference: HFGPTNEOLayerPolicy, replace_policy.py:113).
+
+    Note: local (windowed) attention layers are treated as global — exact
+    for seq_len <= window (256)."""
+    model_type = "gpt_neo"
+
+    @classmethod
+    def build_config(cls, hf, dtype):
+        return GPTConfig(
+            vocab_size=hf.vocab_size, max_seq_len=hf.max_position_embeddings,
+            d_model=hf.hidden_size, n_layers=hf.num_layers,
+            n_heads=hf.num_heads,
+            d_ff=hf.intermediate_size or 4 * hf.hidden_size, dtype=dtype,
+            ln_epsilon=hf.layer_norm_epsilon, tie_embeddings=True,
+            learned_pos=True, scan_layers=True,
+            activation=_act(hf, "activation_function"))
+
+    @classmethod
+    def convert(cls, sd, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        d = cfg.d_model
+        # HF GPT-Neo attention is UNSCALED (no 1/sqrt(head_dim)); our kernel
+        # always scales, so pre-multiply q by sqrt(head_dim) to compensate.
+        qscale = float(cfg.head_dim) ** 0.5
+        layers = []
+        for i in range(cfg.n_layers):
+            lp = f"{pfx}h.{i}."
+            qkv_w = np.concatenate(
+                [qscale * _t(sd[lp + "attn.attention.q_proj.weight"]),
+                 _t(sd[lp + "attn.attention.k_proj.weight"]),
+                 _t(sd[lp + "attn.attention.v_proj.weight"])], axis=1)
+            qkv_b = np.zeros(3 * d, np.float32)  # HF GPT-Neo qkv has no bias
+            layers.append({
+                "ln_1": _ln(sd, lp + "ln_1"),
+                "ln_2": _ln(sd, lp + "ln_2"),
+                "attn": {
+                    "qkv": _dense(qkv_w, qkv_b),
+                    "out": _dense(_t(sd[lp + "attn.attention.out_proj.weight"]),
+                                  sd[lp + "attn.attention.out_proj.bias"]),
+                },
+                "mlp": {
+                    "fc_in": _dense(_t(sd[lp + "mlp.c_fc.weight"]),
+                                    sd[lp + "mlp.c_fc.bias"]),
+                    "fc_out": _dense(_t(sd[lp + "mlp.c_proj.weight"]),
+                                     sd[lp + "mlp.c_proj.bias"]),
+                },
+            })
+        return {
+            "wte": np.asarray(sd[pfx + "wte.weight"], np.float32),
+            "wpe": np.asarray(sd[pfx + "wpe.weight"], np.float32),
+            "h": _stack(layers),
+            "ln_f": _ln(sd, pfx + "ln_f"),
+        }
+
+
+class HFGPTJLayerPolicy(InjectionPolicy):
+    """GPT-J (reference: HFGPTJLayerPolicy, replace_policy.py:158)."""
+    model_type = "gptj"
+
+    @classmethod
+    def build_config(cls, hf, dtype):
+        return GPTConfig(
+            vocab_size=hf.vocab_size, max_seq_len=hf.n_positions,
+            d_model=hf.n_embd, n_layers=hf.n_layer, n_heads=hf.n_head,
+            d_ff=hf.n_inner or 4 * hf.n_embd, dtype=dtype,
+            ln_epsilon=hf.layer_norm_epsilon, tie_embeddings=False,
+            learned_pos=False, rotary=True, rotary_dim=hf.rotary_dim,
+            parallel_residual=True, shared_parallel_ln=True,
+            attn_use_bias=False, lm_head_bias=True, scan_layers=True,
+            activation=_act(hf, "activation_function"))
+
+    @classmethod
+    def convert(cls, sd, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        hd = cfg.head_dim
+        perm = _rotary_halfsplit_perm(cfg.rotary_dim or hd, hd)
+
+        def permute_rows(w_t):  # w_t: [in, d] out-dim is axis 1
+            w = w_t.reshape(w_t.shape[0], cfg.n_heads, hd)
+            return np.ascontiguousarray(
+                w[:, :, perm].reshape(w_t.shape[0], -1))
+
+        layers = []
+        for i in range(cfg.n_layers):
+            lp = f"{pfx}h.{i}."
+            qkv_w = np.concatenate(
+                [permute_rows(_t(sd[lp + "attn.q_proj.weight"])),
+                 permute_rows(_t(sd[lp + "attn.k_proj.weight"])),
+                 _t(sd[lp + "attn.v_proj.weight"])], axis=1)
+            layers.append({
+                "ln_1": _ln(sd, lp + "ln_1"),
+                "attn": {
+                    "qkv": _dense(qkv_w),
+                    "out": _dense(_t(sd[lp + "attn.out_proj.weight"])),
+                },
+                "mlp": {
+                    "fc_in": _dense(_t(sd[lp + "mlp.fc_in.weight"]),
+                                    sd[lp + "mlp.fc_in.bias"]),
+                    "fc_out": _dense(_t(sd[lp + "mlp.fc_out.weight"]),
+                                     sd[lp + "mlp.fc_out.bias"]),
+                },
+            })
+        return {
+            "wte": np.asarray(sd[pfx + "wte.weight"], np.float32),
+            "h": _stack(layers),
+            "ln_f": _ln(sd, pfx + "ln_f"),
+            "lm_head": _dense(_t(sd["lm_head.weight"]), sd["lm_head.bias"]),
+        }
+
+
+class GPTNEOXLayerPolicy(InjectionPolicy):
+    """GPT-NeoX / Pythia (reference: GPTNEOXLayerPolicy, replace_policy.py:362)."""
+    model_type = "gpt_neox"
+
+    @classmethod
+    def build_config(cls, hf, dtype):
+        head_dim = hf.hidden_size // hf.num_attention_heads
+        return GPTConfig(
+            vocab_size=hf.vocab_size, max_seq_len=hf.max_position_embeddings,
+            d_model=hf.hidden_size, n_layers=hf.num_hidden_layers,
+            n_heads=hf.num_attention_heads,
+            d_ff=hf.intermediate_size, dtype=dtype,
+            ln_epsilon=hf.layer_norm_eps, tie_embeddings=False,
+            learned_pos=False, rotary=True,
+            rotary_dim=int(head_dim * hf.rotary_pct),
+            parallel_residual=getattr(hf, "use_parallel_residual", True),
+            scan_layers=True,
+            activation=_act(hf, "hidden_act", default="gelu"))
+
+    @classmethod
+    def convert(cls, sd, cfg):
+        pfx = "gpt_neox." if any(k.startswith("gpt_neox.") for k in sd) else ""
+        nh = cfg.n_heads
+        layers = []
+        for i in range(cfg.n_layers):
+            lp = f"{pfx}layers.{i}."
+            qkv_w = _headfirst_qkv_to_split(
+                _t(sd[lp + "attention.query_key_value.weight"]), nh)
+            qkv_b = _headfirst_qkv_bias_to_split(
+                np.asarray(sd[lp + "attention.query_key_value.bias"]), nh)
+            layers.append({
+                "ln_1": _ln(sd, lp + "input_layernorm"),
+                "ln_2": _ln(sd, lp + "post_attention_layernorm"),
+                "attn": {
+                    "qkv": _dense(qkv_w, qkv_b),
+                    "out": _dense(_t(sd[lp + "attention.dense.weight"]),
+                                  sd[lp + "attention.dense.bias"]),
+                },
+                "mlp": {
+                    "fc_in": _dense(_t(sd[lp + "mlp.dense_h_to_4h.weight"]),
+                                    sd[lp + "mlp.dense_h_to_4h.bias"]),
+                    "fc_out": _dense(_t(sd[lp + "mlp.dense_4h_to_h.weight"]),
+                                     sd[lp + "mlp.dense_4h_to_h.bias"]),
+                },
+            })
+        return {
+            "wte": np.asarray(sd[pfx + "embed_in.weight"], np.float32),
+            "h": _stack(layers),
+            "ln_f": _ln(sd, pfx + "final_layer_norm"),
+            "lm_head": _dense(_t(sd["embed_out.weight"])),
+        }
+
+
+class BLOOMLayerPolicy(InjectionPolicy):
+    """BLOOM (reference: BLOOMLayerPolicy, replace_policy.py:323) — the
+    BASELINE config #5 inference family."""
+    model_type = "bloom"
+
+    @classmethod
+    def build_config(cls, hf, dtype):
+        return GPTConfig(
+            vocab_size=hf.vocab_size, max_seq_len=2048,
+            d_model=hf.hidden_size, n_layers=hf.n_layer, n_heads=hf.n_head,
+            d_ff=4 * hf.hidden_size, dtype=dtype,
+            ln_epsilon=hf.layer_norm_epsilon, tie_embeddings=True,
+            learned_pos=False, alibi=True, embed_ln=True,
+            scan_layers=True,
+            activation=_act(hf, "hidden_act", default="gelu"))
+
+    @classmethod
+    def convert(cls, sd, cfg):
+        pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+        nh = cfg.n_heads
+        layers = []
+        for i in range(cfg.n_layers):
+            lp = f"{pfx}h.{i}."
+            qkv_w = _headfirst_qkv_to_split(
+                _t(sd[lp + "self_attention.query_key_value.weight"]), nh)
+            qkv_b = _headfirst_qkv_bias_to_split(
+                np.asarray(sd[lp + "self_attention.query_key_value.bias"]), nh)
+            layers.append({
+                "ln_1": _ln(sd, lp + "input_layernorm"),
+                "ln_2": _ln(sd, lp + "post_attention_layernorm"),
+                "attn": {
+                    "qkv": _dense(qkv_w, qkv_b),
+                    "out": _dense(_t(sd[lp + "self_attention.dense.weight"]),
+                                  sd[lp + "self_attention.dense.bias"]),
+                },
+                "mlp": {
+                    "fc_in": _dense(_t(sd[lp + "mlp.dense_h_to_4h.weight"]),
+                                    sd[lp + "mlp.dense_h_to_4h.bias"]),
+                    "fc_out": _dense(_t(sd[lp + "mlp.dense_4h_to_h.weight"]),
+                                     sd[lp + "mlp.dense_4h_to_h.bias"]),
+                },
+            })
+        return {
+            "wte": np.asarray(sd[pfx + "word_embeddings.weight"], np.float32),
+            "emb_ln": _ln(sd, pfx + "word_embeddings_layernorm"),
+            "h": _stack(layers),
+            "ln_f": _ln(sd, pfx + "ln_f"),
+        }
+
+
+class HFBertLayerPolicy(InjectionPolicy):
+    """BERT encoder (reference: HFBertLayerPolicy, replace_policy.py:50)."""
+    model_type = "bert"
+    model_class = BertEncoder
+
+    @classmethod
+    def build_config(cls, hf, dtype):
+        return BertConfig(
+            vocab_size=hf.vocab_size, max_seq_len=hf.max_position_embeddings,
+            type_vocab_size=hf.type_vocab_size, d_model=hf.hidden_size,
+            n_layers=hf.num_hidden_layers, n_heads=hf.num_attention_heads,
+            d_ff=hf.intermediate_size, dtype=dtype,
+            ln_epsilon=hf.layer_norm_eps, pre_ln=False, scan_layers=True)
+
+    @classmethod
+    def convert(cls, sd, cfg):
+        pfx = "bert." if any(k.startswith("bert.") for k in sd) else ""
+        layers = []
+        for i in range(cfg.n_layers):
+            lp = f"{pfx}encoder.layer.{i}."
+            qkv_w = np.concatenate(
+                [_t(sd[lp + "attention.self.query.weight"]),
+                 _t(sd[lp + "attention.self.key.weight"]),
+                 _t(sd[lp + "attention.self.value.weight"])], axis=1)
+            qkv_b = np.concatenate(
+                [sd[lp + "attention.self.query.bias"],
+                 sd[lp + "attention.self.key.bias"],
+                 sd[lp + "attention.self.value.bias"]])
+            layers.append({
+                "ln_1": _ln(sd, lp + "attention.output.LayerNorm"),
+                "ln_2": _ln(sd, lp + "output.LayerNorm"),
+                "attn": {
+                    "qkv": _dense(qkv_w, qkv_b),
+                    "out": _dense(_t(sd[lp + "attention.output.dense.weight"]),
+                                  sd[lp + "attention.output.dense.bias"]),
+                },
+                "mlp": {
+                    "fc_in": _dense(_t(sd[lp + "intermediate.dense.weight"]),
+                                    sd[lp + "intermediate.dense.bias"]),
+                    "fc_out": _dense(_t(sd[lp + "output.dense.weight"]),
+                                     sd[lp + "output.dense.bias"]),
+                },
+            })
+        out = {
+            "word_embeddings": np.asarray(
+                sd[pfx + "embeddings.word_embeddings.weight"], np.float32),
+            "position_embeddings": np.asarray(
+                sd[pfx + "embeddings.position_embeddings.weight"], np.float32),
+            "token_type_embeddings": np.asarray(
+                sd[pfx + "embeddings.token_type_embeddings.weight"], np.float32),
+            "embeddings_ln": _ln(sd, pfx + "embeddings.LayerNorm"),
+            "layer": _stack(layers),
+        }
+        if pfx + "pooler.dense.weight" in sd:
+            out["pooler"] = _dense(_t(sd[pfx + "pooler.dense.weight"]),
+                                   sd[pfx + "pooler.dense.bias"])
+        return out
+
+
+# model_type -> policy (reference: replace_policies list, replace_policy.py)
+replace_policies = [HFGPT2LayerPolicy, HFGPTNEOLayerPolicy, HFGPTJLayerPolicy,
+                    GPTNEOXLayerPolicy, BLOOMLayerPolicy, HFBertLayerPolicy]
+POLICY_REGISTRY = {p.model_type: p for p in replace_policies}

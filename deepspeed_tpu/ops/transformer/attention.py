@@ -61,6 +61,14 @@ def attention(q, k, v, bias=None, mask=None, *, causal=False,
     """
     if backend is None:
         backend = _auto_backend(q, bias, mask, dropout_rate, deterministic)
+    elif backend == "pallas" and (
+            bias is not None or mask is not None
+            or (dropout_rate > 0.0 and not deterministic)):
+        # the flash kernel takes no bias/mask/dropout operands — honor the
+        # semantics over the explicit backend request (e.g. alibi or
+        # KV-cache masks with attn_backend="pallas").
+        _warn_pallas_fallback()
+        backend = "reference"
     if backend == "pallas":
         from ..pallas import flash_attention
         return flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
@@ -69,6 +77,13 @@ def attention(q, k, v, bias=None, mask=None, *, causal=False,
                                 dropout_rate=dropout_rate,
                                 dropout_rng=dropout_rng,
                                 deterministic=deterministic)
+
+
+@functools.lru_cache(None)
+def _warn_pallas_fallback():
+    import warnings
+    warnings.warn("attn_backend='pallas' requested but bias/mask/dropout "
+                  "operands require the reference path; falling back")
 
 
 def _on_tpu():

@@ -162,7 +162,7 @@ def param_shardings(variables_or_names, shapes, mesh, stage,
     """names_tree+shapes_tree -> NamedSharding tree for params."""
     rules = make_param_rules(stage, persistence_threshold)
     return jax.tree.map(
-        lambda n, s: NamedSharding(mesh, rules(n, s, mesh)),
+        lambda n, s: NamedSharding(mesh, rules(n, getattr(s, "shape", s), mesh)),
         variables_or_names, shapes,
         is_leaf=lambda x: x is None or (isinstance(x, tuple) and all(
             isinstance(e, (str, type(None))) for e in x)))
