@@ -1,0 +1,107 @@
+// Host-side vectorized Adam/AdamW for ZeRO-Offload.
+//
+// TPU-native analog of the reference's csrc/adam/cpu_adam.cpp (AVX256/512
+// SIMD via csrc/includes/simd.h, OpenMP over parameter chunks): optimizer
+// state lives in host RAM as fp32; each step consumes the device-reduced
+// gradient shard and produces updated master weights plus a downcast
+// bf16/fp32 copy for the device. Exposed as a plain C ABI consumed via
+// ctypes (no pybind11 in this image).
+//
+// Build: g++ -O3 -march=native -fopenmp -fPIC -shared
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <mutex>
+
+namespace {
+
+struct AdamState {
+  float lr, beta1, beta2, eps, weight_decay;
+  bool adamw;  // decoupled weight decay (AdamW) vs L2-into-grad (Adam)
+};
+
+std::unordered_map<int, AdamState> g_states;
+std::mutex g_mu;
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round-to-nearest-even, matching XLA's f32->bf16 conversion
+  uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+}  // namespace
+
+extern "C" {
+
+int ds_adam_create(int id, float lr, float beta1, float beta2, float eps,
+                   float weight_decay, int adamw) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_states[id] = AdamState{lr, beta1, beta2, eps, weight_decay, adamw != 0};
+  return 0;
+}
+
+int ds_adam_destroy(int id) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_states.erase(id);
+  return 0;
+}
+
+// One fused step over a flat shard. params/m/v fp32 (updated in place),
+// grads fp32. ``step`` is the 1-based optimizer step for bias correction —
+// caller-owned so every leaf/shard of one optimizer step shares it. If
+// params_out_bf16 != nullptr, also writes a bf16 copy of the updated
+// weights (the buffer handed back to the device). lr < 0 keeps the lr set
+// at create time.
+int ds_adam_update(int id, int64_t step, float lr, const float* grads,
+                   float* params, float* exp_avg, float* exp_avg_sq,
+                   int64_t n, uint16_t* params_out_bf16) {
+  AdamState* st;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = g_states.find(id);
+    if (it == g_states.end()) return -1;
+    st = &it->second;
+  }
+  if (step < 1) return -2;
+  const float step_lr = lr >= 0.f ? lr : st->lr;
+  const float b1 = st->beta1, b2 = st->beta2, eps = st->eps;
+  const float wd = st->weight_decay;
+  const bool adamw = st->adamw;
+  const float bc1 = 1.f - std::pow(b1, static_cast<float>(step));
+  const float bc2 = 1.f - std::pow(b2, static_cast<float>(step));
+  const float step_size = step_lr / bc1;
+  const float inv_sqrt_bc2 = 1.f / std::sqrt(bc2);
+  const float decay_factor = adamw ? (1.f - step_lr * wd) : 1.f;
+
+  // The loop auto-vectorizes under -O3 -march=native (vsqrtps/vfmadd on
+  // AVX2 hosts — same effect as the reference's hand-written simd.h).
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    float p = params[i];
+    if (!adamw && wd != 0.f) g += wd * p;  // classic Adam L2
+    float m = b1 * exp_avg[i] + (1.f - b1) * g;
+    float v = b2 * exp_avg_sq[i] + (1.f - b2) * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    p = p * decay_factor - step_size * m / (std::sqrt(v) * inv_sqrt_bc2 + eps);
+    params[i] = p;
+    if (params_out_bf16) params_out_bf16[i] = f32_to_bf16(p);
+  }
+  return 0;
+}
+
+// Capability probe for ds_report: 2 = AVX2 build, 1 = scalar, 0 = n/a.
+int ds_adam_simd_level(void) {
+#if defined(__AVX2__)
+  return 2;
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

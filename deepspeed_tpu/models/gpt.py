@@ -59,6 +59,7 @@ class GPTConfig:
     alibi: bool = False                  # BLOOM positioning
     embed_ln: bool = False               # BLOOM word_embeddings_layernorm
     lm_head_bias: bool = False           # GPT-J untied head carries a bias
+    seq_parallel: Optional[str] = None   # None=auto, "ulysses", "ring", "none"
 
     @property
     def ffn_dim(self):
@@ -133,7 +134,8 @@ class GPT(nn.Module):
             attn_backend=cfg.attn_backend,
             parallel_residual=cfg.parallel_residual,
             shared_parallel_ln=cfg.shared_parallel_ln,
-            attn_use_bias=cfg.attn_use_bias, alibi=cfg.alibi)
+            attn_use_bias=cfg.attn_use_bias, alibi=cfg.alibi,
+            seq_parallel=cfg.seq_parallel)
 
         block_cls = Block
         policy = REMAT_POLICIES.get(cfg.remat)

@@ -101,6 +101,7 @@ class SelfAttention(nn.Module):
     rotary_dim: Optional[int] = None
     attn_backend: Optional[str] = None
     alibi: bool = False
+    seq_parallel: Optional[str] = None   # None=auto, "ulysses", "ring", "none"
 
     @nn.compact
     def __call__(self, x, mask=None, bias=None, deterministic=True,
@@ -173,7 +174,8 @@ class SelfAttention(nn.Module):
 
         out = attention(q, k, v, bias=bias, mask=mask, causal=causal,
                         dropout_rate=self.dropout_rate, dropout_rng=dropout_rng,
-                        deterministic=deterministic, backend=self.attn_backend)
+                        deterministic=deterministic, backend=self.attn_backend,
+                        seq_parallel=self.seq_parallel)
         out = out.reshape(b, s, self.d_model)
         out = activation_constraint(out, ("batch", "seq", "embed"))
         return nn.DenseGeneral(
@@ -250,6 +252,7 @@ class Block(nn.Module):
     shared_parallel_ln: bool = False     # GPT-J: one LN feeds attn AND mlp
     attn_use_bias: Optional[bool] = None  # None -> use_bias (GPT-J: False)
     alibi: bool = False
+    seq_parallel: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None, bias=None, deterministic=True,
@@ -261,7 +264,8 @@ class Block(nn.Module):
                              use_bias=attn_bias, rotary=self.rotary,
                              rotary_dim=self.rotary_dim,
                              attn_backend=self.attn_backend,
-                             alibi=self.alibi, name="attn")
+                             alibi=self.alibi, seq_parallel=self.seq_parallel,
+                             name="attn")
         mlp_cls = self.mlp_factory or (lambda name: MLP(
             d_model=self.d_model, d_ff=self.d_ff, dtype=self.dtype,
             param_dtype=self.param_dtype, use_bias=self.use_bias,

@@ -1,0 +1,3 @@
+from .cpu_adam import DeepSpeedCPUAdam
+
+__all__ = ["DeepSpeedCPUAdam"]

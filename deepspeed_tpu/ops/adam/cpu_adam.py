@@ -1,0 +1,86 @@
+"""Host-side fused Adam/AdamW over numpy shards (ZeRO-Offload inner
+optimizer).
+
+Reference: DeepSpeedCPUAdam (deepspeed/ops/adam/cpu_adam.py:12) backed by
+csrc/adam/cpu_adam.cpp. Here the native kernel is csrc/cpu_adam.cpp
+(OpenMP + auto-vectorized), loaded via ctypes; state tensors are numpy
+fp32 arrays living in host RAM, stepped on the gradient shard the device
+reduce-scattered. ``step`` optionally emits a bf16 weight copy in the
+same call (the reference's adam_update_copy fused variant).
+"""
+
+import itertools
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ..op_builder import CPUAdamBuilder
+
+_ids = itertools.count()
+
+
+def _f32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True):
+        self.lib = CPUAdamBuilder.load()
+        self.opt_id = next(_ids)
+        self._step = 0
+        self.defaults = dict(lr=lr, betas=betas, eps=eps,
+                             weight_decay=weight_decay, adamw_mode=adamw_mode)
+        rc = self.lib.ds_adam_create(self.opt_id, lr, betas[0], betas[1],
+                                     eps, weight_decay, int(adamw_mode))
+        if rc != 0:
+            raise RuntimeError("ds_adam_create failed")
+
+    def step(self, params: np.ndarray, grads: np.ndarray,
+             exp_avg: np.ndarray, exp_avg_sq: np.ndarray,
+             lr: Optional[float] = None,
+             out_bf16: Optional[np.ndarray] = None,
+             global_step: Optional[int] = None):
+        """One fused step over a flat fp32 shard, in place.
+
+        ``global_step``: 1-based optimizer step for bias correction. When a
+        model's leaves/shards are stepped by separate calls, the caller MUST
+        pass the shared step (one counter per optimizer step, not per call);
+        None auto-increments the internal counter (single-tensor use)."""
+        for name, a in (("params", params), ("grads", grads),
+                        ("exp_avg", exp_avg), ("exp_avg_sq", exp_avg_sq)):
+            if a.dtype != np.float32 or not a.flags.c_contiguous:
+                raise ValueError(f"{name} must be contiguous float32")
+        n = params.size
+        if not (grads.size == exp_avg.size == exp_avg_sq.size == n):
+            raise ValueError("size mismatch")
+        out_ptr = None
+        if out_bf16 is not None:
+            if out_bf16.dtype != np.uint16 or out_bf16.size != n:
+                raise ValueError("out_bf16 must be uint16 (bf16 bits) of same size")
+            out_ptr = out_bf16.ctypes.data_as(ctypes.c_void_p)
+        if global_step is None:
+            self._step += 1
+            global_step = self._step
+        else:
+            self._step = int(global_step)
+        rc = self.lib.ds_adam_update(
+            self.opt_id, int(global_step),
+            -1.0 if lr is None else float(lr), _f32ptr(grads),
+            _f32ptr(params), _f32ptr(exp_avg), _f32ptr(exp_avg_sq), n, out_ptr)
+        if rc != 0:
+            raise RuntimeError("ds_adam_update failed")
+
+    @property
+    def steps(self) -> int:
+        return self._step
+
+    def set_steps(self, step: int):
+        self._step = int(step)
+
+    def __del__(self):
+        try:
+            self.lib.ds_adam_destroy(self.opt_id)
+        except Exception:
+            pass

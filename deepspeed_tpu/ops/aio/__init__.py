@@ -1,0 +1,62 @@
+"""Async NVMe I/O handle (ZeRO-Infinity swap backend).
+
+Reference: AsyncIOBuilder().load() aio handle over csrc/aio/
+(deepspeed_py_aio_handle.h). Here csrc/aio.cpp — a C++ worker-thread pool
+doing positional pread/pwrite — via ctypes. Buffers are numpy arrays;
+submissions return tickets, ``wait``/``wait_all`` join them.
+"""
+
+import ctypes
+import os
+
+import numpy as np
+
+from ..op_builder import AsyncIOBuilder
+
+
+class AsyncIOHandle:
+    def __init__(self, n_threads: int = 4):
+        self.lib = AsyncIOBuilder.load()
+        self._h = self.lib.ds_aio_new(n_threads)
+        self._pinned = {}  # ticket -> buffer keep-alive
+
+    def pread(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        t = self.lib.ds_aio_pread(self._h, os.fsencode(path),
+                                  buf.ctypes.data_as(ctypes.c_void_p),
+                                  buf.nbytes, offset)
+        if t < 0:
+            raise RuntimeError("aio pread submit failed")
+        self._pinned[t] = buf
+        return t
+
+    def pwrite(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
+        t = self.lib.ds_aio_pwrite(self._h, os.fsencode(path),
+                                   buf.ctypes.data_as(ctypes.c_void_p),
+                                   buf.nbytes, offset)
+        if t < 0:
+            raise RuntimeError("aio pwrite submit failed")
+        self._pinned[t] = buf
+        return t
+
+    def wait(self, ticket: int):
+        err = self.lib.ds_aio_wait(self._h, ticket)
+        self._pinned.pop(ticket, None)
+        if err != 0:
+            raise OSError(err, os.strerror(err))
+
+    def wait_all(self):
+        err = self.lib.ds_aio_wait_all(self._h)
+        self._pinned.clear()
+        if err != 0:
+            raise OSError(err, os.strerror(err))
+
+    def close(self):
+        if self._h is not None:
+            self.lib.ds_aio_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,153 @@
+"""JIT builder/loader for the native host-side ops.
+
+TPU-native analog of the reference's op_builder/ (builder.py:1 OpBuilder,
+JIT-load via torch cpp_extension): here the native ops are host C++ only
+(device math is Pallas), compiled on first use with g++ into a per-user
+cache dir and loaded via ctypes — pybind11/torch are deliberately not in
+the loop. Each builder reports ``is_compatible()`` so ds_report-style
+tooling can print the op support matrix.
+"""
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+from typing import List, Optional
+
+from ...utils.logging import logger
+
+_CSRC = os.path.normpath(os.path.join(os.path.dirname(__file__),
+                                      "..", "..", "..", "csrc"))
+
+
+def _cache_dir() -> str:
+    root = os.environ.get("DS_TPU_BUILD_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "deepspeed_tpu", "ops")
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+class OpBuilder:
+    """Compile sources from csrc/ into a shared lib, load with ctypes."""
+
+    NAME: str = ""
+    SOURCES: List[str] = []
+    EXTRA_FLAGS: List[str] = []
+
+    _loaded: Optional[ctypes.CDLL] = None
+
+    @classmethod
+    def absolute_sources(cls):
+        return [os.path.join(_CSRC, s) for s in cls.SOURCES]
+
+    @classmethod
+    def is_compatible(cls) -> bool:
+        if shutil.which("g++") is None:
+            return False
+        return all(os.path.exists(s) for s in cls.absolute_sources())
+
+    @classmethod
+    def compat_reason(cls) -> str:
+        if shutil.which("g++") is None:
+            return "g++ not found"
+        missing = [s for s in cls.absolute_sources() if not os.path.exists(s)]
+        if missing:
+            return f"missing sources {missing}"
+        return "ok"
+
+    @classmethod
+    def _signature(cls) -> str:
+        h = hashlib.sha256()
+        for src in cls.absolute_sources():
+            with open(src, "rb") as f:
+                h.update(f.read())
+        h.update(" ".join(cls.EXTRA_FLAGS).encode())
+        return h.hexdigest()[:16]
+
+    @classmethod
+    def load(cls) -> ctypes.CDLL:
+        if cls._loaded is not None:
+            return cls._loaded
+        if not cls.is_compatible():
+            raise RuntimeError(
+                f"op '{cls.NAME}' is not buildable here: {cls.compat_reason()}")
+        lib_path = os.path.join(_cache_dir(),
+                                f"{cls.NAME}_{cls._signature()}.so")
+        if not os.path.exists(lib_path):
+            cls._build(lib_path)
+        cls._loaded = ctypes.CDLL(lib_path)
+        return cls._loaded
+
+    @classmethod
+    def _build(cls, lib_path: str):
+        cmd = (["g++", "-O3", "-fPIC", "-shared", "-std=c++17"]
+               + cls.EXTRA_FLAGS + cls.absolute_sources()
+               + ["-o", lib_path + ".tmp"])
+        logger.info(f"building native op {cls.NAME}: {' '.join(cmd)}")
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build of '{cls.NAME}' failed:\n{e.stderr}") from e
+        os.replace(lib_path + ".tmp", lib_path)
+
+
+class CPUAdamBuilder(OpBuilder):
+    """Reference: op_builder/cpu_adam.py + csrc/adam/cpu_adam.cpp."""
+    NAME = "cpu_adam"
+    SOURCES = ["cpu_adam.cpp"]
+    EXTRA_FLAGS = ["-march=native", "-fopenmp"]
+
+    @classmethod
+    def load(cls):
+        lib = super().load()
+        lib.ds_adam_create.argtypes = [
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int]
+        lib.ds_adam_update.argtypes = [
+            ctypes.c_int, ctypes.c_int64, ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_void_p]
+        lib.ds_adam_destroy.argtypes = [ctypes.c_int]
+        return lib
+
+
+class AsyncIOBuilder(OpBuilder):
+    """Reference: op_builder/async_io.py + csrc/aio/."""
+    NAME = "async_io"
+    SOURCES = ["aio.cpp"]
+    EXTRA_FLAGS = ["-pthread"]
+
+    @classmethod
+    def load(cls):
+        lib = super().load()
+        lib.ds_aio_new.restype = ctypes.c_void_p
+        lib.ds_aio_new.argtypes = [ctypes.c_int]
+        lib.ds_aio_free.argtypes = [ctypes.c_void_p]
+        lib.ds_aio_pread.restype = ctypes.c_int64
+        lib.ds_aio_pread.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.c_int64]
+        lib.ds_aio_pwrite.restype = ctypes.c_int64
+        lib.ds_aio_pwrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_int64]
+        lib.ds_aio_wait.restype = ctypes.c_int
+        lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ds_aio_wait_all.restype = ctypes.c_int
+        lib.ds_aio_wait_all.argtypes = [ctypes.c_void_p]
+        return lib
+
+
+ALL_OPS = {b.NAME: b for b in (CPUAdamBuilder, AsyncIOBuilder)}
+
+
+def op_report():
+    """ds_report-style (reference deepspeed/env_report.py:23) build matrix."""
+    rows = []
+    for name, b in ALL_OPS.items():
+        rows.append((name, b.is_compatible(), b.compat_reason()))
+    return rows

@@ -53,12 +53,29 @@ def _reference_attention(q, k, v, bias=None, mask=None, *, causal=False,
 
 def attention(q, k, v, bias=None, mask=None, *, causal=False,
               softmax_scale=None, dropout_rate=0.0, dropout_rng=None,
-              deterministic=True, backend: Optional[str] = None):
+              deterministic=True, backend: Optional[str] = None,
+              seq_parallel: Optional[str] = None):
     """Multi-head attention, BSHD layout.
 
     backend: None = auto (pallas flash kernel on TPU when eligible,
     reference otherwise) | "reference" | "pallas".
+    seq_parallel: None = auto (ulysses when the mesh's ``seq`` axis > 1)
+    | "ulysses" | "ring" | "none". Sequence-parallel paths require
+    bias/mask-free attention (causal flag is fine) and no dropout.
     """
+    sp_mode = _resolve_seq_parallel(seq_parallel, q, bias, mask,
+                                    dropout_rate, deterministic)
+    if sp_mode == "ulysses":
+        from ...sequence_parallel import ulysses_attention
+        inner = functools.partial(attention, backend=backend,
+                                  seq_parallel="none")
+        return ulysses_attention(q, k, v, causal=causal,
+                                 softmax_scale=softmax_scale, attn_fn=inner)
+    if sp_mode == "ring":
+        from ...sequence_parallel import ring_attention
+        return ring_attention(q, k, v, causal=causal,
+                              softmax_scale=softmax_scale)
+
     if backend is None:
         backend = _auto_backend(q, bias, mask, dropout_rate, deterministic)
     elif backend == "pallas" and (
@@ -77,6 +94,53 @@ def attention(q, k, v, bias=None, mask=None, *, causal=False,
                                 dropout_rate=dropout_rate,
                                 dropout_rng=dropout_rng,
                                 deterministic=deterministic)
+
+
+def _resolve_seq_parallel(seq_parallel, q, bias, mask, dropout_rate,
+                          deterministic):
+    """Pick the sequence-parallel mode; "none" when inapplicable."""
+    if seq_parallel == "none":
+        return "none"
+    from ...comm.mesh import get_global_mesh, _GLOBAL_MESH
+    if seq_parallel is None and _GLOBAL_MESH is None:
+        return "none"  # auto never forces a mesh into existence
+    sp = get_global_mesh().shape.get("seq", 1)
+    if sp == 1:
+        if seq_parallel in ("ulysses", "ring"):
+            _warn_sp_no_axis()  # explicit request, but no seq axis to use
+        return "none"
+    # decode-time q (seq=1 chunks) and masked/biased attention fall back to
+    # the replicated path — XLA all-gathers the seq shards transparently.
+    eligible = (q.ndim == 4 and q.shape[1] % sp == 0 and bias is None
+                and mask is None and (dropout_rate == 0.0 or deterministic))
+    if not eligible:
+        if seq_parallel is not None:
+            _warn_sp_fallback()
+        return "none"
+    if seq_parallel is None:
+        # auto mode must degrade, never raise: ulysses additionally needs
+        # heads/tp divisible by sp — fall back to ring (no head constraint)
+        tp = get_global_mesh().shape.get("model", 1)
+        if (q.shape[2] // max(tp, 1)) % sp != 0:
+            return "ring"
+        return "ulysses"
+    return seq_parallel
+
+
+@functools.lru_cache(None)
+def _warn_sp_no_axis():
+    import warnings
+    warnings.warn("seq_parallel requested but the active mesh has no 'seq' "
+                  "axis (size 1) — running fully replicated. Build the mesh "
+                  "with MeshSpec(seq=N) to enable it.")
+
+
+@functools.lru_cache(None)
+def _warn_sp_fallback():
+    import warnings
+    warnings.warn("sequence-parallel attention requested but bias/mask/"
+                  "dropout/shape constraints require the replicated path; "
+                  "falling back")
 
 
 @functools.lru_cache(None)

@@ -12,6 +12,7 @@ import json
 import os
 from typing import Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -31,14 +32,20 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
     path = os.path.abspath(os.path.join(save_dir, str(tag)))
     os.makedirs(path, exist_ok=True)
 
-    state = {
-        "params": engine.params,
-        "optimizer_state": engine.optimizer_state,
-    }
+    state = {"params": engine.params}
+    if getattr(engine, "native_offload", None) is None:
+        state["optimizer_state"] = engine.optimizer_state
     if engine.fp16_enabled and engine.loss_scale_state is not None:
         state["loss_scale"] = dict(engine.loss_scale_state._asdict())
     ckptr = _checkpointer()
     ckptr.save(os.path.join(path, "state"), state, force=True)
+
+    if getattr(engine, "native_offload", None) is not None:
+        # per-process host-state shard files (reference: the per-rank
+        # *_zero_pp_rank_N_optim_states.pt files, engine.py:2402)
+        np.savez(os.path.join(
+            path, f"native_opt_proc{jax.process_index()}.npz"),
+            **engine.native_offload.state_dict())
 
     meta = {
         "global_steps": engine.global_steps,
@@ -87,7 +94,8 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
         template["loss_scale"] = {
             k: jax.ShapeDtypeStruct(v.shape, v.dtype)
             for k, v in engine.loss_scale_state._asdict().items()}
-    if load_optimizer_states and not load_module_only:
+    native = getattr(engine, "native_offload", None)
+    if load_optimizer_states and not load_module_only and native is None:
         opt_shapes = jax.eval_shape(engine.optimizer.init, engine._param_shapes)
         template["optimizer_state"] = jax.tree.map(
             lambda sds, sh: jax.ShapeDtypeStruct(sds.shape, sds.dtype, sharding=sh),
@@ -103,6 +111,20 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
     engine.params = restored["params"]
     if load_optimizer_states and not load_module_only and "optimizer_state" in restored:
         engine.optimizer_state = restored["optimizer_state"]
+    if native is not None:
+        # masters must track the restored weights in EVERY load mode, else
+        # the next step reverts the model to its construction-time values
+        shard_file = os.path.join(
+            path, f"native_opt_proc{jax.process_index()}.npz")
+        will_load = (load_optimizer_states and not load_module_only
+                     and os.path.exists(shard_file))
+        native.reset_from_params(engine.params, skip_moments=will_load)
+        if will_load:
+            with np.load(shard_file) as z:
+                native.load_state_dict({k: z[k] for k in z.files})
+        elif load_optimizer_states and not load_module_only:
+            logger.warning(f"no native offload state at {shard_file}; "
+                           "optimizer moments reset (world-size change?)")
     if engine.fp16_enabled and "loss_scale" in restored:
         from .fp16.loss_scaler import LossScaleState
         ls = restored["loss_scale"]

@@ -70,6 +70,13 @@ class OffloadOptimizerConfig:
     pipeline_read: bool = False
     pipeline_write: bool = False
     fast_init: bool = False
+    # NEW (TPU): route the optimizer step through the native C++ cpu_adam
+    # kernel (csrc/cpu_adam.cpp) with state in host numpy — the reference's
+    # actual ZeRO-Offload dataflow. False = XLA pinned_host offload (the
+    # declarative path). device=nvme with native=True swaps Adam moments
+    # to local SSD between steps via the aio op (ZeRO-Infinity).
+    native: bool = False
+    aio_threads: int = 4
 
 
 @dataclass

@@ -222,6 +222,23 @@ class DeepSpeedEngine:
             self.optimizer = optax.chain(
                 optax.clip_by_global_norm(cfg.gradient_clipping), self.optimizer)
 
+        # Native ZeRO-Offload: the C++ cpu_adam kernel owns the step and
+        # the optimizer state lives in host numpy (reference dataflow).
+        self.native_offload = None
+        off = cfg.zero_optimization.offload_optimizer
+        opt_type = (cfg.optimizer.type if cfg.optimizer else "Adam")
+        if (off is not None and getattr(off, "native", False)
+                and off.device in ("cpu", "nvme")):
+            if client_optimizer is not None:
+                raise DeepSpeedConfigError(
+                    "offload_optimizer.native is incompatible with a client "
+                    "optimizer — configure optimizer via the config dict")
+            if opt_type.lower() not in ("adam", "adamw"):
+                raise DeepSpeedConfigError(
+                    f"offload_optimizer.native supports Adam/AdamW, got {opt_type}")
+            self._configure_native_offload(off, opt_type)
+            return
+
         # optimizer state: eval shape, shard per ZeRO stage, init sharded
         opt_shapes = jax.eval_shape(self.optimizer.init, self._param_shapes)
         opt_rule = make_opt_state_rules(self.zero_stage, self.mesh)
@@ -232,6 +249,29 @@ class DeepSpeedEngine:
             self.opt_shardings = _with_host_memory(self.opt_shardings)
         self.optimizer_state = jax.jit(
             self.optimizer.init, out_shardings=self.opt_shardings)(self.params)
+
+    def _configure_native_offload(self, off, opt_type):
+        """Grad shardings = the ZeRO partition, landing in pinned host
+        memory; host state built from the current params."""
+        from .zero.offload_optimizer import CPUAdamOffloadOptimizer
+        opt_rule = make_opt_state_rules(max(self.zero_stage, 1), self.mesh)
+        grad_specs = jax.tree.map(
+            lambda spec, s: opt_rule(spec, s.shape),
+            self.param_specs, self._param_shapes,
+            is_leaf=lambda x: isinstance(x, P))
+        self.grad_shardings = _with_host_memory(jax.tree.map(
+            lambda spec: NamedSharding(self.mesh, spec), grad_specs,
+            is_leaf=lambda x: isinstance(x, P)))
+        opt_params = dict(self.config.optimizer.params) if self.config.optimizer else {}
+        self.native_offload = CPUAdamOffloadOptimizer(
+            self.params, self.grad_shardings, self.param_shardings,
+            opt_params, adamw=(opt_type.lower() == "adamw"),
+            nvme_swap_dir=(off.nvme_path if off.device == "nvme" else None),
+            aio_threads=off.aio_threads)
+        self.optimizer_state = ()
+        self.opt_shardings = ()
+        log_dist(f"native ZeRO-Offload enabled (device={off.device}, "
+                 f"kernel=cpu_adam)", ranks=[0])
 
     # ------------------------------------------------------------------
     # the fused train step
@@ -257,19 +297,21 @@ class DeepSpeedEngine:
             lambda x, sh: jax.make_array_from_process_local_data(sh, np.asarray(x)),
             batch, shardings)
 
-    def _make_train_step(self):
-        cfg = self.config
-        gas = cfg.gradient_accumulation_steps
+    def _make_accumulate_fn(self):
+        """The shared microbatch-scan gradient accumulation: returns
+        fn(params, scaler, batch, rng) -> (unscaled grads, mean_loss,
+        gnorm). Used by BOTH the fused train step and the native-offload
+        grad step so the accumulation/unscale semantics cannot drift."""
+        gas = self.config.gradient_accumulation_steps
         fp16 = self.fp16_enabled
         model = self.module
         loss_fn = self._loss_fn
-        optimizer = self.optimizer
 
         def microbatch_loss(params, batch, rng, scale):
             loss = loss_fn(model, params, batch, rng, True)
             return loss * scale / gas, loss
 
-        def train_step(params, opt_state, scaler, batch, rng):
+        def accumulate(params, scaler, batch, rng):
             scale = scaler.scale if fp16 else jnp.float32(1.0)
 
             def micro(carry, xs):
@@ -293,9 +335,20 @@ class DeepSpeedEngine:
             # overflow; XLA reduces in fp32 here, so it is unnecessary.
             if fp16:
                 grads = jax.tree.map(lambda g: g * (1.0 / scale), grads)
-
             gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
                                  for g in jax.tree.leaves(grads)))
+            return grads, mean_loss, gnorm
+
+        return accumulate
+
+    def _make_train_step(self):
+        cfg = self.config
+        fp16 = self.fp16_enabled
+        optimizer = self.optimizer
+        accumulate = self._make_accumulate_fn()
+
+        def train_step(params, opt_state, scaler, batch, rng):
+            grads, mean_loss, gnorm = accumulate(params, scaler, batch, rng)
 
             def apply(operand):
                 params_, opt_state_, grads_ = operand
@@ -335,6 +388,56 @@ class DeepSpeedEngine:
             out_shardings=(self.param_shardings, self.opt_shardings, scaler_sh, None),
         )
 
+    def _make_grad_step(self):
+        """Native-offload variant: jit computes the accumulated, unscaled
+        gradient partition (into pinned host memory) + metrics; the C++
+        cpu_adam step happens host-side in train_batch."""
+        cfg = self.config
+        fp16 = self.fp16_enabled
+        accumulate = self._make_accumulate_fn()
+
+        def grad_step(params, scaler, batch, rng):
+            grads, mean_loss, gnorm = accumulate(params, scaler, batch, rng)
+            if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+                # same formula as optax.clip_by_global_norm (the default
+                # path's chained transform)
+                clip = jnp.minimum(1.0, cfg.gradient_clipping / gnorm)
+                grads = jax.tree.map(lambda g: g * clip, grads)
+            if fp16:
+                finite = grads_finite(grads)
+                new_scaler = update_scale(
+                    scaler, finite, dynamic=cfg.fp16.dynamic_loss_scale,
+                    scale_window=cfg.fp16.loss_scale_window,
+                    hysteresis=cfg.fp16.hysteresis,
+                    min_scale=cfg.fp16.min_loss_scale)
+            else:
+                finite = jnp.bool_(True)
+                new_scaler = scaler
+            metrics = {"loss": mean_loss, "grad_norm": gnorm,
+                       "finite": finite,
+                       "loss_scale": scaler.scale if fp16 else jnp.float32(1.0)}
+            return grads, new_scaler, metrics
+
+        dummy_scaler = self.loss_scale_state or init_loss_scale(1.0)
+        rep = NamedSharding(self.mesh, P())
+        scaler_sh = jax.tree.map(lambda _: rep, dummy_scaler)
+        return jax.jit(grad_step,
+                       out_shardings=(self.grad_shardings, scaler_sh, None))
+
+    def _native_offload_batch(self, batch, scaler, rng):
+        if "grad_step" not in self._compiled:
+            self._compiled["grad_step"] = self._make_grad_step()
+        grads, new_scaler, metrics = self._compiled["grad_step"](
+            self.params, scaler, batch, rng)
+        finite = bool(metrics["finite"])
+        lr = float(self.lr_schedule(self.global_steps)) if callable(
+            self.lr_schedule) else float(self.lr_schedule)
+        new_params = self.native_offload.step(grads, lr=lr, finite=finite)
+        if new_params is not None:
+            self.params = new_params
+        metrics["skipped"] = jnp.int32(0 if finite else 1)
+        return new_scaler, metrics
+
     def train_batch(self, batch: Dict[str, Any]):
         """One full optimizer step over a global batch
         [train_batch_size, ...] (reference: PipelineEngine.train_batch
@@ -356,15 +459,17 @@ class DeepSpeedEngine:
         batch = jax.tree.map(to_micro, batch)
         batch = self._place_batch(batch, with_gas_dim=True)
 
-        if "train_step" not in self._compiled:
-            self._compiled["train_step"] = self._make_train_step()
-        step_fn = self._compiled["train_step"]
-
         self.tput_timer.start()
         scaler = self.loss_scale_state or init_loss_scale(1.0)
         rng = jax.random.fold_in(self.rng, self.global_steps + 1)
-        self.params, self.optimizer_state, new_scaler, metrics = step_fn(
-            self.params, self.optimizer_state, scaler, batch, rng)
+        if self.native_offload is not None:
+            new_scaler, metrics = self._native_offload_batch(batch, scaler, rng)
+        else:
+            if "train_step" not in self._compiled:
+                self._compiled["train_step"] = self._make_train_step()
+            step_fn = self._compiled["train_step"]
+            self.params, self.optimizer_state, new_scaler, metrics = step_fn(
+                self.params, self.optimizer_state, scaler, batch, rng)
         if self.fp16_enabled:
             self.loss_scale_state = new_scaler
             self.skipped_steps += int(metrics["skipped"])
@@ -578,6 +683,11 @@ def _with_host_memory(shardings):
     """Move a sharding tree to pinned host memory (ZeRO-Offload analog:
     optimizer shards live in host RAM, reference: cpu_adam +
     stage_1_and_2.py cpu_offload)."""
+    if jax.default_backend() == "cpu":
+        # CPU "device" memory already is host RAM, and the CPU SPMD
+        # compiler rejects mixed memory-kind outputs — nothing to move.
+        return shardings
+
     def to_host(s):
         try:
             return s.with_memory_kind("pinned_host")

@@ -1,0 +1,3 @@
+from .swapper import AsyncTensorSwapper, OptimizerStateSwapper
+
+__all__ = ["AsyncTensorSwapper", "OptimizerStateSwapper"]

@@ -1,0 +1,236 @@
+"""ZeRO-Offload / ZeRO-Infinity optimizer with the native cpu_adam kernel.
+
+Reference dataflow (stage_1_and_2.py cpu_offload + csrc/adam/cpu_adam.cpp,
+swap via runtime/swap_tensor/): the device reduce-scatters gradients, the
+host steps Adam on its fp32 master shard in C++, and the updated weights
+are gathered back to the device. Here:
+
+- the jitted grad-step emits gradients already sharded over the DP axes
+  (the ZeRO partition) into host-pinned memory,
+- each process steps the native kernel over its addressable shards
+  (numpy masters + moments in host RAM),
+- updated shards are placed back per-device and the param sharding's
+  all-gather happens on the subsequent ``device_put`` reshard.
+
+With ``device="nvme"``, the Adam moments live on local SSD between steps
+(aio op), prefetched one leaf ahead of the update loop — the pipelined
+read/write overlap of the reference's PipelinedOptimizerSwapper.
+"""
+
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding
+
+from ...utils.logging import logger, log_dist
+
+
+def _leaf_names(tree):
+    flat, _ = jax.tree.flatten_with_path(tree)
+    return [jax.tree_util.keystr(p) for p, _ in flat]
+
+
+class CPUAdamOffloadOptimizer:
+    """Host-side Adam over the ZeRO partition of every parameter."""
+
+    def __init__(self, params, grad_shardings, param_shardings,
+                 opt_params: Dict[str, Any], adamw: bool = True,
+                 nvme_swap_dir: Optional[str] = None, aio_threads: int = 4):
+        from ...ops.adam import DeepSpeedCPUAdam
+
+        betas = tuple(opt_params.get("betas", (0.9, 0.999)))
+        self.adam = DeepSpeedCPUAdam(
+            lr=opt_params.get("lr", 1e-3), betas=betas,
+            eps=opt_params.get("eps", 1e-8),
+            weight_decay=opt_params.get("weight_decay", 0.0),
+            adamw_mode=adamw)
+        self.param_shardings = param_shardings
+        self.grad_shardings = grad_shardings
+
+        self.swapper = None
+        if nvme_swap_dir is not None:
+            from ..swap_tensor import AsyncTensorSwapper
+            self.swapper = AsyncTensorSwapper(
+                os.path.join(nvme_swap_dir, f"proc{jax.process_index()}"),
+                n_threads=aio_threads)
+
+        # Host state per leaf: {index_key: [master, m, v, devices]}
+        flat_params, self._treedef = jax.tree.flatten(params)
+        flat_gsh = jax.tree.leaves(grad_shardings)
+        self._names = _leaf_names(params)
+        self._shapes = [p.shape for p in flat_params]
+        self._dtypes = [p.dtype for p in flat_params]
+        self._state: List[Dict[Any, list]] = []
+        for leaf, gsh in zip(flat_params, flat_gsh):
+            # view the param through the gradient (ZeRO-partition) sharding
+            shard_view = jax.device_put(leaf, _device_memory(gsh))
+            per_leaf: Dict[Any, list] = {}
+            for shard in shard_view.addressable_shards:
+                key = _index_key(shard.index)
+                if key in per_leaf:
+                    per_leaf[key][3].append(shard.device)
+                else:
+                    # np.array (not asarray): shard.data views the jax
+                    # buffer zero-copy on CPU and arrives read-only
+                    master = np.array(shard.data, dtype=np.float32)
+                    per_leaf[key] = [master, np.zeros_like(master),
+                                     np.zeros_like(master), [shard.device],
+                                     shard.index]
+            self._state.append(per_leaf)
+        self._swap_out_all()
+
+    # -- NVMe swap of the Adam moments ---------------------------------
+    def _swap_name(self, li, key, which):
+        return f"{self._names[li]}__{key}__{which}"
+
+    def _swap_out_all(self):
+        if self.swapper is None:
+            return
+        for li, per_leaf in enumerate(self._state):
+            for key, ent in per_leaf.items():
+                self.swapper.swap_out(self._swap_name(li, key, "m"), ent[1])
+                self.swapper.swap_out(self._swap_name(li, key, "v"), ent[2])
+        self.swapper.flush()
+        for per_leaf in self._state:
+            for ent in per_leaf.values():
+                ent[1] = ent[2] = None  # moments now live on SSD only
+
+    def _prefetch_leaf(self, li):
+        if self.swapper is None:
+            return
+        for key in self._state[li]:
+            self.swapper.prefetch(self._swap_name(li, key, "m"))
+            self.swapper.prefetch(self._swap_name(li, key, "v"))
+
+    # ------------------------------------------------------------------
+    def step(self, grads_tree, lr: float, finite: bool = True):
+        """Apply one Adam step; returns the updated param tree (device)."""
+        if not finite:
+            return None  # caller keeps old params (loss-scale skip)
+        # ONE bias-correction step shared by every leaf/shard this call
+        self.adam.set_steps(self.adam.steps + 1)
+        global_step = self.adam.steps
+        flat_grads = jax.tree.leaves(grads_tree)
+        flat_psh = jax.tree.leaves(self.param_shardings)
+        new_leaves = []
+        if self.swapper is not None and self._state:
+            self._prefetch_leaf(0)
+        for li, (g_leaf, per_leaf, psh) in enumerate(
+                zip(flat_grads, self._state, flat_psh)):
+            if self.swapper is not None and li + 1 < len(self._state):
+                self._prefetch_leaf(li + 1)   # overlap SSD read with compute
+            shards = {(_index_key(s.index)): s for s in g_leaf.addressable_shards}
+            bufs = []
+            for key, ent in per_leaf.items():
+                master, m, v, devices, index = ent
+                if self.swapper is not None:
+                    m = self.swapper.swap_in(self._swap_name(li, key, "m"))
+                    v = self.swapper.swap_in(self._swap_name(li, key, "v"))
+                g = np.array(shards[key].data, dtype=np.float32)
+                flat_master = master.reshape(-1)
+                out_dtype = self._dtypes[li]
+                out_bf16 = (np.empty(flat_master.shape, np.uint16)
+                            if out_dtype == jnp.bfloat16 else None)
+                self.adam.step(flat_master, g.reshape(-1), m.reshape(-1),
+                               v.reshape(-1), lr=lr, out_bf16=out_bf16,
+                               global_step=global_step)
+                if out_bf16 is not None:
+                    import ml_dtypes
+                    updated = out_bf16.view(ml_dtypes.bfloat16).reshape(
+                        master.shape)
+                else:
+                    updated = flat_master.reshape(master.shape).astype(out_dtype)
+                for d in devices:
+                    bufs.append(jax.device_put(jnp.asarray(updated), d))
+                if self.swapper is not None:
+                    self.swapper.swap_out(self._swap_name(li, key, "m"), m)
+                    self.swapper.swap_out(self._swap_name(li, key, "v"), v)
+            gsh = _device_memory(g_leaf.sharding)
+            arr = jax.make_array_from_single_device_arrays(
+                self._shapes[li], gsh, bufs)
+            new_leaves.append(jax.device_put(arr, psh))  # ZeRO all-gather
+        if self.swapper is not None:
+            self.swapper.flush()
+        return jax.tree.unflatten(self._treedef, new_leaves)
+
+    # -- checkpoint hooks ----------------------------------------------
+    def reset_from_params(self, params, skip_moments: bool = False):
+        """Re-seed the fp32 masters from a (restored) param tree. Checkpoint
+        load MUST call this before (optionally) overlaying saved state:
+        masters are otherwise still the construction-time weights and the
+        next step would silently revert the model to initialization.
+
+        ``skip_moments=True`` when load_state_dict will immediately follow
+        (it rewrites m/v anyway — avoids a full extra NVMe write)."""
+        flat_params = jax.tree.leaves(params)
+        for li, (leaf, per_leaf) in enumerate(zip(flat_params, self._state)):
+            gsh = _device_memory(jax.tree.leaves(self.grad_shardings)[li])
+            shard_view = jax.device_put(leaf, gsh)
+            fresh = {_index_key(s.index): s for s in shard_view.addressable_shards}
+            for key, ent in per_leaf.items():
+                ent[0] = np.array(fresh[key].data, dtype=np.float32)
+                if skip_moments:
+                    continue
+                zeros = np.zeros_like(ent[0])
+                if self.swapper is not None:
+                    self.swapper.swap_out(self._swap_name(li, key, "m"), zeros)
+                    self.swapper.swap_out(self._swap_name(li, key, "v"),
+                                          zeros.copy())
+                else:
+                    ent[1] = np.zeros_like(ent[0])
+                    ent[2] = np.zeros_like(ent[0])
+        if self.swapper is not None and not skip_moments:
+            self.swapper.flush()
+        self.adam.set_steps(0)
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        """Flat host-state dict for this process's shards (reference:
+        per-rank zero_pp_rank_N files)."""
+        out = {"__step__": np.int64(self.adam.steps)}
+        for li, per_leaf in enumerate(self._state):
+            for key, ent in per_leaf.items():
+                master, m, v = ent[0], ent[1], ent[2]
+                if self.swapper is not None:
+                    # swap_in only reads — the .swp files stay intact on
+                    # disk, so no write-back is needed
+                    m = self.swapper.swap_in(self._swap_name(li, key, "m"))
+                    v = self.swapper.swap_in(self._swap_name(li, key, "v"))
+                base = f"{li}|{key}"
+                out[base + "|master"] = master
+                out[base + "|m"] = m
+                out[base + "|v"] = v
+        return out
+
+    def load_state_dict(self, sd: Dict[str, np.ndarray]):
+        self.adam.set_steps(int(sd["__step__"]))
+        for li, per_leaf in enumerate(self._state):
+            for key, ent in per_leaf.items():
+                base = f"{li}|{key}"
+                ent[0][...] = sd[base + "|master"]
+                m, v = np.array(sd[base + "|m"]), np.array(sd[base + "|v"])
+                if self.swapper is not None:
+                    self.swapper.swap_out(self._swap_name(li, key, "m"), m)
+                    self.swapper.swap_out(self._swap_name(li, key, "v"), v)
+                else:
+                    ent[1][...] = m
+                    ent[2][...] = v
+        if self.swapper is not None:
+            self.swapper.flush()
+
+
+def _index_key(index) -> str:
+    return repr(tuple((s.start, s.stop, s.step) for s in index))
+
+
+def _device_memory(sharding):
+    """The same sharding placed in default device memory (grads arrive in
+    pinned_host; the rebuilt params go straight to HBM)."""
+    try:
+        if getattr(sharding, "memory_kind", None) not in (None, "device"):
+            return sharding.with_memory_kind("device")
+    except Exception:
+        pass
+    return sharding

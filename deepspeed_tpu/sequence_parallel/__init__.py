@@ -1,0 +1,25 @@
+"""Sequence / context parallelism (long-context training).
+
+ABSENT in the reference snapshot (pre-0.10 DeepSpeed — SURVEY.md §2.2 row
+SP/CP); built here as a first-class mesh axis because long-context is a
+core capability of the modern framework this replaces. Two strategies:
+
+- Ulysses (``ulysses_attention``): all-to-all that trades the sequence
+  shard for a head shard around the attention core, so each device runs
+  *full-sequence* attention on ``heads/sp`` heads. Communication is two
+  all-to-alls per attention (O(S*D/P) per device), riding ICI.
+- Ring attention (``ring_attention``): K/V blocks rotate around the
+  ``seq`` axis ring via ``lax.ppermute`` while each device keeps its
+  query shard, accumulating with an online (flash-style) softmax. No
+  head-count divisibility requirement; comm overlaps with blockwise
+  compute.
+
+Both are ``shard_map`` regions over the global mesh, so they compose with
+data/fsdp batch sharding and tensor-parallel head sharding, and nest
+inside the engine's jitted train step.
+"""
+
+from .ulysses import ulysses_attention
+from .ring import ring_attention
+
+__all__ = ["ulysses_attention", "ring_attention"]

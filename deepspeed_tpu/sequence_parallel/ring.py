@@ -1,0 +1,100 @@
+"""Ring attention over the ``seq`` mesh axis.
+
+Each device keeps its query shard resident and rotates K/V blocks around
+the ring with ``lax.ppermute`` (XLA CollectivePermute -> nearest-neighbor
+ICI hops), accumulating the attention output with an online flash-style
+softmax. Memory per device is O(S/P) for K/V and O(S/P * D) for the
+accumulator, so sequence length scales linearly with ring size — the
+long-context capability the reference snapshot lacks (SURVEY.md §2.2).
+
+Causality is enforced per block pair from the *global* block indices:
+block ``src < my`` attends fully, ``src == my`` applies the triangular
+mask, ``src > my`` contributes nothing (still computed — SPMD uniform —
+but masked to -inf).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import get_global_mesh
+from ..utils.jax_compat import shard_map
+from .ulysses import _fit_axes
+
+_BATCH_AXES = ("data", "fsdp")
+_HEAD_AXIS = "model"
+_SEQ_AXIS = "seq"
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+
+def _ring_local(q, k, v, *, axis_name, causal, softmax_scale):
+    """Local shard computation: q/k/v [b, s_l, h, d]."""
+    sp = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_l, h, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+
+    q32 = q.astype(jnp.float32) * scale
+    qpos = jnp.arange(s_l)[:, None]          # local row offsets
+    kpos = jnp.arange(s_l)[None, :]
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, t):
+        k_blk, v_blk, acc, m, denom = carry
+        src = (my - t) % sp                  # global block index of k_blk
+        # [b, h, s_l, s_l] logits
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                            k_blk.astype(jnp.float32))
+        if causal:
+            gq = my * s_l + qpos             # global positions
+            gk = src * s_l + kpos
+            logits = jnp.where((gk <= gq)[None, None], logits, _NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        # rows with no valid key yet keep m == -inf; guard the exp args
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(logits - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        denom = denom * corr + p.sum(axis=-1)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, acc, m_new, denom), None
+
+    acc0 = jnp.zeros((b, h, s_l, d), jnp.float32)
+    m0 = jnp.full((b, h, s_l), _NEG_INF, jnp.float32)
+    den0 = jnp.zeros((b, h, s_l), jnp.float32)
+    (_, _, acc, _, denom), _ = lax.scan(
+        step, (k, v, acc0, m0, den0), jnp.arange(sp))
+
+    out = acc / jnp.maximum(denom, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)   # [b, s_l, h, d]
+
+
+def ring_attention(q, k, v, *, causal=True, softmax_scale=None, mesh=None,
+                   axis_name=_SEQ_AXIS, batch_axes=_BATCH_AXES,
+                   head_axis=_HEAD_AXIS):
+    """Ring attention over seq-sharded [B, S, H, D] global arrays.
+
+    Unlike Ulysses there is no head-divisibility requirement, so it also
+    covers few-head / GQA-ish models; comm is P-1 neighbor permutes.
+    """
+    mesh = mesh or get_global_mesh()
+    sp = mesh.shape[axis_name]
+    if sp == 1:
+        from ..ops.transformer.attention import attention as attn_fn
+        return attn_fn(q, k, v, causal=causal, softmax_scale=softmax_scale)
+    if q.shape[1] % sp != 0:
+        raise ValueError(f"sequence length {q.shape[1]} not divisible by sp={sp}")
+
+    spec = P(_fit_axes(q.shape[0], batch_axes, mesh), axis_name,
+             _fit_axes(q.shape[2], head_axis, mesh), None)
+    local = partial(_ring_local, axis_name=axis_name, causal=causal,
+                    softmax_scale=softmax_scale)
+    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

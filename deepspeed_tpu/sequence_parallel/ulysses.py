@@ -1,0 +1,96 @@
+"""DeepSpeed-Ulysses-style sequence parallelism.
+
+The idea (absent from the reference snapshot; modern DeepSpeed's
+``DistributedAttention`` wraps a local attention with two all-to-alls):
+activations arrive sharded on the sequence dim over the ``seq`` mesh
+axis. Attention needs the full sequence, but is embarrassingly parallel
+over heads — so an all-to-all converts the seq shard into a head shard,
+the unmodified local attention core runs on full sequences, and a second
+all-to-all converts back.
+
+TPU-native: a ``shard_map`` region with ``jax.lax.all_to_all`` over the
+``seq`` axis (lowering to XLA AllToAll on ICI), composing with batch
+sharding over data/fsdp and head sharding over model (tensor parallel).
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import get_global_mesh
+from ..utils.jax_compat import shard_map
+
+# q/k/v/out layout everywhere: [batch, seq, heads, head_dim]
+_BATCH_AXES = ("data", "fsdp")
+_HEAD_AXIS = "model"
+_SEQ_AXIS = "seq"
+
+
+def _qkv_spec(q_shape, mesh, batch_axes, seq_axis, head_axis):
+    return P(_fit_axes(q_shape[0], batch_axes, mesh), seq_axis,
+             _fit_axes(q_shape[2], head_axis, mesh), None)
+
+
+def _fit_axes(dim_size, axes, mesh):
+    """Longest prefix of ``axes`` whose cumulative product divides dim_size.
+
+    The engine traces the model on tiny sample batches (batch=1) where the
+    full data/fsdp sharding can't apply; sharding the batch dim is a
+    throughput concern, not a correctness one, so degrade gracefully."""
+    kept = []
+    prod = 1
+    for a in (axes if isinstance(axes, (tuple, list)) else (axes,)):
+        nxt = prod * mesh.shape.get(a, 1)
+        if dim_size % nxt != 0:
+            break
+        kept.append(a)
+        prod = nxt
+    if not kept:
+        return None
+    return tuple(kept) if len(kept) > 1 else kept[0]
+
+
+def ulysses_attention(q, k, v, *, causal=False, softmax_scale=None,
+                      attn_fn=None, mesh=None, axis_name=_SEQ_AXIS,
+                      batch_axes=_BATCH_AXES, head_axis=_HEAD_AXIS):
+    """Full-sequence attention over seq-sharded inputs, [B, S, H, D] global.
+
+    ``attn_fn(q, k, v, causal=..., softmax_scale=...)`` is the local
+    attention core (default: the ops.transformer dispatch, so the Pallas
+    flash kernel is used on TPU when eligible). Requires
+    ``H / tp_degree`` divisible by the seq-axis size.
+    """
+    mesh = mesh or get_global_mesh()
+    sp = mesh.shape[axis_name]
+    if attn_fn is None:
+        from ..ops.transformer.attention import attention
+        attn_fn = partial(attention, seq_parallel="none")
+    if sp == 1:
+        return attn_fn(q, k, v, causal=causal, softmax_scale=softmax_scale)
+
+    n_heads, seq_len = q.shape[2], q.shape[1]
+    tp = mesh.shape.get(head_axis, 1)
+    local_heads = n_heads // tp
+    if local_heads % sp != 0:
+        raise ValueError(
+            f"Ulysses needs heads/tp ({n_heads}/{tp}={local_heads}) divisible "
+            f"by the seq-parallel degree {sp}")
+    if seq_len % sp != 0:
+        raise ValueError(f"sequence length {seq_len} not divisible by sp={sp}")
+
+    spec = _qkv_spec(q.shape, mesh, batch_axes, axis_name, head_axis)
+
+    def local_fn(q, k, v):
+        # [b, s/sp, h, d] -> [b, s, h/sp, d]: the head<->seq swap
+        q, k, v = (lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True) for t in (q, k, v))
+        out = attn_fn(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        # [b, s, h/sp, d] -> [b, s/sp, h, d]
+        return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)
