@@ -1,0 +1,100 @@
+"""Collective benchmark sweep (`ds_bench` analog).
+
+Reference: benchmarks/communication/ — allreduce/allgather/alltoall/
+broadcast/pt2pt sweeps with algbw/busbw reporting. Here each collective
+is a jitted shard_map over the global mesh's data axis; busbw uses the
+standard ring-algorithm factors (allreduce 2(n-1)/n, allgather and
+reduce-scatter (n-1)/n).
+
+Run: python benchmarks/communication/run_all.py [--maxsize 26] [--trials 20]
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def human(nbytes):
+    for s, u in ((2**30, "GB"), (2**20, "MB"), (2**10, "KB")):
+        if nbytes >= s:
+            return f"{nbytes / s:.0f} {u}"
+    return f"{nbytes} B"
+
+
+def bench_collective(name, fn, x, trials, warmup=3):
+    import jax
+    for _ in range(warmup):
+        jax.block_until_ready(fn(x))
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        jax.block_until_ready(fn(x))
+    return (time.perf_counter() - t0) / trials
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--maxsize", type=int, default=24,
+                   help="log2 of the largest message in bytes")
+    p.add_argument("--minsize", type=int, default=18)
+    p.add_argument("--trials", type=int, default=10)
+    p.add_argument("--dtype", default="float32")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.comm import MeshSpec, build_mesh
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    mesh = build_mesh(MeshSpec())
+    n = mesh.shape["data"]
+    dtype = jnp.dtype(args.dtype)
+    print(f"devices={n} dtype={dtype.name} trials={args.trials}")
+    print(f"{'op':<16} {'size':>8} {'latency':>12} {'algbw':>12} {'busbw':>12}")
+
+    def smap(f):
+        return jax.jit(shard_map(f, mesh, in_specs=P("data"),
+                                 out_specs=P("data")))
+
+    ops = {
+        "all_reduce": (smap(lambda x: lax.psum(x, "data") / n),
+                       lambda s: 2 * (n - 1) / n * s),
+        "all_gather": (smap(lambda x: lax.all_gather(
+            x, "data", tiled=True).reshape(x.shape[0] * n, *x.shape[1:])[
+                :x.shape[0]]), lambda s: (n - 1) / n * s),
+        "reduce_scatter": (smap(lambda x: jnp.repeat(
+            lax.psum_scatter(x, "data", tiled=True), n, axis=0)),
+            lambda s: (n - 1) / n * s),
+        "all_to_all": (smap(lambda x: lax.all_to_all(
+            x.reshape(n, -1), "data", 0, 0, tiled=True).reshape(x.shape)),
+            lambda s: (n - 1) / n * s),
+        "broadcast": (smap(lambda x: jnp.broadcast_to(
+            lax.all_gather(x, "data", tiled=True)[:x.shape[0]], x.shape)),
+            lambda s: s),
+        "pt2pt(ppermute)": (smap(lambda x: lax.ppermute(
+            x, "data", [(i, (i + 1) % n) for i in range(n)])),
+            lambda s: s),
+    }
+
+    for size_log in range(args.minsize, args.maxsize + 1, 2):
+        nbytes = 2 ** size_log
+        elems = max(n, nbytes // dtype.itemsize // n * n)
+        x = jnp.zeros((elems,), dtype)
+        for name, (fn, bus_factor) in ops.items():
+            try:
+                dt = bench_collective(name, fn, x, args.trials)
+            except Exception as e:
+                print(f"{name:<16} {human(nbytes):>8} FAILED: {e}")
+                continue
+            algbw = nbytes / dt
+            busbw = bus_factor(nbytes) / dt
+            print(f"{name:<16} {human(nbytes):>8} {dt*1e6:>9.1f} us "
+                  f"{algbw/2**30:>9.2f} GB/s {busbw/2**30:>9.2f} GB/s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,13 +128,15 @@ struct Handle {
     return err;
   }
 
+  // Barrier only: waits until no request is in flight. Completion records
+  // are NOT consumed — callers still wait(ticket) individually (so a
+  // barrier between prefetch and swap_in cannot orphan the read ticket).
   int wait_all() {
     std::unique_lock<std::mutex> lock(mu);
     cv_done.wait(lock, [&] { return inflight == 0; });
     int worst = 0;
     for (auto& kv : done)
       if (kv.second != 0) worst = kv.second;
-    done.clear();
     return worst;
   }
 };

@@ -1,0 +1,4 @@
+from .autotuner import Autotuner, TuneResult
+from .tuner import GridSearchTuner, RandomTuner
+
+__all__ = ["Autotuner", "TuneResult", "GridSearchTuner", "RandomTuner"]

@@ -1,0 +1,125 @@
+"""Autotuner: measured search over ZeRO stage x micro-batch.
+
+Reference: autotuning/autotuner.py:23 — `tune()` (:390) walks per-stage
+tuning spaces from config templates, launching short REAL profiling runs
+through the scheduler and reading back metrics;
+model_info_profile_run (:658) measures params/activation memory first to
+prune the space. TPU edition runs candidates in-process (one JAX client
+already owns the chips — no subprocess scheduler needed): each candidate
+builds an engine, runs a few timed steps, and OOM/sharding failures are
+caught and scored as infeasible. Metric = samples/sec (reference's
+throughput mode).
+"""
+
+import dataclasses
+import itertools
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
+
+TUNER_MAP = {"gridsearch": GridSearchTuner, "random": RandomTuner,
+             "model_based": ModelBasedTuner}
+
+
+@dataclasses.dataclass
+class TuneResult:
+    config: Dict[str, Any]
+    samples_per_sec: Optional[float]   # None = infeasible
+    step_ms: Optional[float] = None
+    error: Optional[str] = None
+
+    @property
+    def feasible(self):
+        return self.samples_per_sec is not None
+
+
+class Autotuner:
+    """In-process tuner.
+
+    Args:
+        make_engine: fn(config_dict) -> engine with ``train_batch``;
+            called fresh per candidate (the reference's per-experiment
+            launch).
+        make_batch: fn(config_dict) -> a global batch matching the
+            candidate's train_batch_size.
+    """
+
+    def __init__(self, make_engine: Callable[[Dict], Any],
+                 make_batch: Callable[[Dict], Any],
+                 warmup_steps: int = 1, measure_steps: int = 3):
+        self.make_engine = make_engine
+        self.make_batch = make_batch
+        self.warmup_steps = warmup_steps
+        self.measure_steps = measure_steps
+        self.results: List[TuneResult] = []
+
+    # -- space construction (reference: the template_zeroN.json spaces) --
+    @staticmethod
+    def build_space(base_config: Dict[str, Any], zero_stages: List[int],
+                    micro_batches: List[int],
+                    dp_world_size: int = 1) -> List[Dict[str, Any]]:
+        space = []
+        for stage, mb in itertools.product(zero_stages, micro_batches):
+            cfg = {k: (dict(v) if isinstance(v, dict) else v)
+                   for k, v in base_config.items()}
+            cfg.setdefault("zero_optimization", {})
+            cfg["zero_optimization"] = dict(cfg["zero_optimization"],
+                                            stage=stage)
+            gas = cfg.get("gradient_accumulation_steps", 1)
+            cfg["train_micro_batch_size_per_gpu"] = mb
+            cfg["train_batch_size"] = mb * gas * dp_world_size
+            space.append(cfg)
+        return space
+
+    def measure(self, config: Dict[str, Any]) -> TuneResult:
+        try:
+            engine = self.make_engine(config)
+            batch = self.make_batch(config)
+            for _ in range(self.warmup_steps):
+                engine.train_batch(batch)
+            t0 = time.perf_counter()
+            for _ in range(self.measure_steps):
+                engine.train_batch(batch)
+            dt = (time.perf_counter() - t0) / self.measure_steps
+            return TuneResult(config, config["train_batch_size"] / dt,
+                              step_ms=dt * 1e3)
+        except Exception as e:  # OOM / bad sharding = infeasible point
+            logger.warning(f"autotune candidate failed: {e}")
+            return TuneResult(config, None,
+                              error="".join(traceback.format_exception_only(e)))
+
+    def tune(self, base_config: Dict[str, Any],
+             zero_stages=(0, 1, 2, 3), micro_batches=(1, 2, 4, 8),
+             dp_world_size: int = 1, tuner_type: str = "model_based",
+             early_stop: Optional[int] = None) -> TuneResult:
+        """Measure the space, return the best feasible point (reference:
+        tune() :390; fast mode = early_stop after N non-improving)."""
+        space = self.build_space(base_config, list(zero_stages),
+                                 list(micro_batches), dp_world_size)
+        order = TUNER_MAP[tuner_type](space).order()
+        best: Optional[TuneResult] = None
+        since_best = 0
+        for cfg in order:
+            res = self.measure(cfg)
+            self.results.append(res)
+            if res.feasible and (best is None
+                                 or res.samples_per_sec > best.samples_per_sec):
+                best, since_best = res, 0
+            else:
+                since_best += 1
+            if early_stop and since_best >= early_stop:
+                logger.info(f"autotune early stop after {since_best} "
+                            "non-improving candidates")
+                break
+        if best is None:
+            raise RuntimeError("no feasible autotuning candidate "
+                               f"(tried {len(self.results)})")
+        z = best.config.get("zero_optimization", {}).get("stage")
+        logger.info(
+            f"autotune best: stage={z} "
+            f"micro_batch={best.config['train_micro_batch_size_per_gpu']} "
+            f"-> {best.samples_per_sec:.1f} samples/s ({best.step_ms:.1f} ms)")
+        return best

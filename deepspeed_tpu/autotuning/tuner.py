@@ -1,0 +1,52 @@
+"""Search strategies over the tuning space.
+
+Reference: autotuning/tuner/index_based_tuner.py (GridSearchTuner :21,
+RandomTuner :6) and model_based_tuner.py. Search points are config dicts;
+strategies order them. The XGBoost cost model is replaced by a simple
+arithmetic-intensity heuristic (no xgboost in the TPU image).
+"""
+
+import random
+from typing import Dict, List
+
+
+class BaseTuner:
+    def __init__(self, space: List[Dict]):
+        self.space = list(space)
+
+    def order(self) -> List[Dict]:
+        raise NotImplementedError
+
+
+class GridSearchTuner(BaseTuner):
+    """Exhaustive in declaration order (reference: :21)."""
+
+    def order(self):
+        return list(self.space)
+
+
+class RandomTuner(BaseTuner):
+    """Shuffled exploration (reference: :6)."""
+
+    def __init__(self, space, seed: int = 0):
+        super().__init__(space)
+        self.seed = seed
+
+    def order(self):
+        pts = list(self.space)
+        random.Random(self.seed).shuffle(pts)
+        return pts
+
+
+class ModelBasedTuner(BaseTuner):
+    """Heuristic stand-in for the reference's XGBoostCostModel
+    (tuner/cost_model.py:9): larger micro batches first (better MXU
+    utilization), lower ZeRO stages first (less collective traffic) —
+    measured results still decide."""
+
+    def order(self):
+        def score(pt):
+            mb = pt.get("train_micro_batch_size_per_gpu", 1)
+            stage = pt.get("zero_optimization", {}).get("stage", 0)
+            return (-mb, stage)
+        return sorted(self.space, key=score)

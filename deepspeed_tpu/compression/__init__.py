@@ -1,0 +1,6 @@
+from .compress import (Compressor, init_compression, redundancy_clean)
+from .config import CompressionConfig
+from .scheduler import CompressionScheduler
+
+__all__ = ["Compressor", "init_compression", "redundancy_clean",
+           "CompressionConfig", "CompressionScheduler"]

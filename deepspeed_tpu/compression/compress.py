@@ -1,0 +1,150 @@
+"""Compression-aware training as functional param transforms.
+
+Reference: compression/compress.py — init_compression (:97) swaps
+Linear/Embedding for compressible variants (basic_layer.py:134
+LinearLayer_Compress) that fake-quantize weights / apply pruning masks in
+forward; redundancy_clean (:127) bakes the compression in at the end.
+
+Flax params are pure pytrees, so the TPU-native mechanism is a
+*projection* applied to the param tree at the gradient-accumulation
+boundary (quantize-aware training's straight-through estimator is exactly
+"project after step"): fake-quant snaps matched weights to their
+bits-wide grid, pruning applies magnitude masks. ``redundancy_clean``
+returns the final projected tree for serving.
+"""
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+from .config import CompressionConfig
+
+
+def _matches(path: str, patterns) -> bool:
+    return any(p == "*" or p in path for p in patterns)
+
+
+def fake_quantize(w, bits: int = 8, symmetric: bool = True,
+                  per_channel: bool = True):
+    """Uniform fake quantization (reference: basic_layer.py weight
+    quantization; kernels csrc/quantization). Keeps dtype; snaps values
+    to the 2^bits grid — the straight-through forward."""
+    q = 2 ** (bits - 1) - 1
+    axis = tuple(range(w.ndim - 1)) if per_channel and w.ndim > 1 else None
+    if symmetric:
+        scale = jnp.max(jnp.abs(w), axis=axis, keepdims=True) / q
+        scale = jnp.maximum(scale, 1e-8)
+        return jnp.round(w / scale).clip(-q - 1, q) * scale
+    lo = jnp.min(w, axis=axis, keepdims=True)
+    hi = jnp.max(w, axis=axis, keepdims=True)
+    scale = jnp.maximum((hi - lo) / (2 ** bits - 1), 1e-8)
+    return jnp.round((w - lo) / scale) * scale + lo
+
+
+def magnitude_mask(w, ratio: float):
+    """Unstructured sparse-pruning mask: zero the smallest |w| fraction
+    (reference: sparse_pruning method=l1)."""
+    if ratio <= 0:
+        return jnp.ones_like(w, dtype=bool)
+    k = int(np.prod(w.shape) * ratio)
+    if k == 0:
+        return jnp.ones_like(w, dtype=bool)
+    thresh = jnp.sort(jnp.abs(w).reshape(-1))[k - 1]
+    return jnp.abs(w) > thresh
+
+
+def row_mask(w, ratio: float):
+    """Structured row pruning: drop output rows with the smallest L2 norm
+    (reference: basic_layer.py row pruning)."""
+    if ratio <= 0 or w.ndim < 2:
+        return jnp.ones_like(w, dtype=bool)
+    norms = jnp.sqrt(jnp.sum(w * w, axis=tuple(range(w.ndim - 1))))
+    k = int(norms.shape[0] * ratio)
+    if k == 0:
+        return jnp.ones_like(w, dtype=bool)
+    thresh = jnp.sort(norms)[k - 1]
+    return jnp.broadcast_to(norms > thresh, w.shape)
+
+
+class Compressor:
+    """Schedule-driven param projection; apply() each step (cheap no-op
+    before the schedule offsets)."""
+
+    def __init__(self, config: CompressionConfig):
+        self.config = config
+        self._jitted: Dict[Any, Any] = {}
+
+    def _project_leaf(self, path: str, w, step: int):
+        if not hasattr(w, "ndim") or w.ndim == 0 or \
+                not jnp.issubdtype(w.dtype, jnp.floating):
+            return w
+        c = self.config
+        out = w
+        if c.sparse_pruning.enabled and step >= c.sparse_pruning.schedule_offset:
+            for g in c.sparse_pruning.groups.values():
+                if _matches(path, g.modules):
+                    out = out * magnitude_mask(
+                        out, float(g.params.get("dense_ratio_delta", 0)
+                                   or 1 - g.params.get("dense_ratio", 1)))
+        if c.row_pruning.enabled and step >= c.row_pruning.schedule_offset:
+            for g in c.row_pruning.groups.values():
+                if _matches(path, g.modules):
+                    out = out * row_mask(
+                        out, 1 - g.params.get("dense_ratio", 1))
+        if c.weight_quantization.enabled and \
+                step >= c.weight_quantization.schedule_offset:
+            for g in c.weight_quantization.groups.values():
+                if _matches(path, g.modules):
+                    out = fake_quantize(
+                        out, bits=int(g.params.get("start_bits",
+                                                   g.params.get("bits", 8))),
+                        symmetric=g.params.get("quantization_type",
+                                               "symmetric") == "symmetric")
+        return out
+
+    def active(self, step: int) -> bool:
+        c = self.config
+        return any(t.enabled and step >= t.schedule_offset
+                   for t in (c.weight_quantization, c.sparse_pruning,
+                             c.row_pruning, c.head_pruning, c.channel_pruning))
+
+    def apply(self, params, step: int):
+        """Project the param tree per the schedule (jitted per step-phase,
+        not per step: the projection only changes when techniques toggle)."""
+        if not self.active(step):
+            return params
+        phase = tuple(
+            t.enabled and step >= t.schedule_offset
+            for t in (self.config.weight_quantization,
+                      self.config.sparse_pruning, self.config.row_pruning))
+        if phase not in self._jitted:
+            def project(tree):
+                flat, treedef = jax.tree.flatten_with_path(tree)
+                out = [self._project_leaf(jax.tree_util.keystr(p), w, step)
+                       for p, w in flat]
+                return jax.tree.unflatten(treedef, out)
+            self._jitted[phase] = jax.jit(project)
+        return self._jitted[phase](params)
+
+
+def init_compression(config: Optional[dict]) -> Optional[Compressor]:
+    """Build a Compressor from the ``compression_training`` block
+    (reference: init_compression compress.py:97); None when nothing is
+    enabled."""
+    cc = CompressionConfig.from_dict(config)
+    if not cc.any_enabled():
+        return None
+    logger.info("compression-aware training enabled: " + ", ".join(
+        f for f in cc.__dataclass_fields__ if getattr(cc, f).enabled))
+    return Compressor(cc)
+
+
+def redundancy_clean(params, config: Optional[dict]):
+    """Final projection for serving (reference: compress.py:127)."""
+    comp = init_compression(config)
+    if comp is None:
+        return params
+    return comp.apply(params, step=1 << 30)

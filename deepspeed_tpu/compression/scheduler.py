@@ -1,0 +1,19 @@
+"""Compression scheduler (reference: compression/scheduler.py, stepped at
+engine.py:1885): tracks the training step and applies the Compressor's
+projection at gradient-accumulation boundaries."""
+
+from typing import Optional
+
+from .compress import Compressor
+
+
+class CompressionScheduler:
+    def __init__(self, compressor: Compressor):
+        self.compressor = compressor
+        self.training_steps = 0
+
+    def step(self, params):
+        """Call once per optimizer step; returns (possibly projected)
+        params."""
+        self.training_steps += 1
+        return self.compressor.apply(params, self.training_steps)

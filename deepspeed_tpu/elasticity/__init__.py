@@ -1,0 +1,9 @@
+from .elasticity import (ElasticityError, ElasticityConfigError,
+                         ElasticityIncompatibleWorldSize, ElasticityConfig,
+                         compute_elastic_config, elasticity_enabled,
+                         ensure_immutable_elastic_config)
+
+__all__ = ["ElasticityError", "ElasticityConfigError",
+           "ElasticityIncompatibleWorldSize", "ElasticityConfig",
+           "compute_elastic_config", "elasticity_enabled",
+           "ensure_immutable_elastic_config"]

@@ -1,0 +1,165 @@
+"""Elastic training config algebra.
+
+Reference: deepspeed/elasticity/elasticity.py — elasticity is *static
+batch-size algebra*, not runtime migration: compute_elastic_config (:224)
+picks a total train batch highly composite in micro_batch x gas so that
+any accelerator count in [min, max] divides it
+(_get_compatible_gpus_v01 :126), and the choice is pinned across restarts
+via a scheduler env var (ensure_immutable_elastic_config :191). Recovery =
+restart from checkpoint at a different world size; the sharded orbax
+checkpoints reshard on load, which is the TPU analog of the reference's
+elastic_checkpoint option.
+
+"gpus" in names below = accelerator *chips* (kept for schema parity).
+"""
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..utils.logging import logger
+
+ELASTICITY = "elasticity"
+ENABLED_DEFAULT = False
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"
+
+
+class ElasticityError(Exception):
+    """Base (reference: elasticity/constants.py analog)."""
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+@dataclass
+class ElasticityConfig:
+    """Schema of the ``elasticity`` config block (reference:
+    elasticity/config.py)."""
+    enabled: bool = ENABLED_DEFAULT
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch_size: bool = True
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ElasticityConfig":
+        known = {f for f in cls.__dataclass_fields__}
+        unknown = set(d) - known
+        if unknown:
+            raise ElasticityConfigError(
+                f"unknown elasticity config keys: {sorted(unknown)}")
+        return cls(**d)
+
+    def repr_dict(self) -> dict:
+        return {f: getattr(self, f) for f in self.__dataclass_fields__}
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    return bool(ds_config.get(ELASTICITY, {}).get("enabled", ENABLED_DEFAULT))
+
+
+def _get_valid_gpus(batch_size: int, micro_batches: List[int],
+                    min_gpus: int, max_gpus: int) -> List[int]:
+    """Chip counts that evenly consume ``batch_size`` with SOME micro batch
+    (reference: elasticity.py get_valid_gpus)."""
+    valid = []
+    for g in range(min_gpus, max_gpus + 1):
+        if any(batch_size % (g * mb) == 0 for mb in micro_batches):
+            valid.append(g)
+    return valid
+
+
+def _get_compatible_gpus_v01(micro_batches: List[int], max_batch: int,
+                             min_gpus: int, max_gpus: int,
+                             prefer_larger: bool) -> Tuple[int, List[int]]:
+    """Pick the batch <= max_batch maximizing the number of valid chip
+    counts (reference: elasticity.py:126)."""
+    base = min(micro_batches)
+    if max_batch < base:
+        raise ElasticityConfigError(
+            f"max_train_batch_size {max_batch} smaller than the smallest "
+            f"micro batch {base}")
+    best_batch, best_valid = 0, []
+    for b in range(base, max_batch + 1, base):
+        valid = _get_valid_gpus(b, micro_batches, min_gpus, max_gpus)
+        better = (len(valid) > len(best_valid)
+                  or (len(valid) == len(best_valid) and prefer_larger))
+        if valid and better:
+            best_batch, best_valid = b, valid
+    if not best_valid:
+        raise ElasticityConfigError(
+            f"no batch size <= {max_batch} divides any chip count in "
+            f"[{min_gpus}, {max_gpus}] with micro batches {micro_batches}")
+    return best_batch, best_valid
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str = "",
+                           world_size: int = 0
+                           ) -> Tuple[int, List[int], Optional[int]]:
+    """Resolve (final_batch_size, valid_chip_counts, micro_batch for this
+    world size) from the ``elasticity`` block (reference: :224).
+
+    With ``world_size > 0`` also validates this run's chip count and
+    returns its micro batch (largest eligible when
+    prefer_larger_batch_size)."""
+    if ELASTICITY not in ds_config:
+        raise ElasticityConfigError(
+            f"'{ELASTICITY}' missing from the config")
+    cfg = ElasticityConfig.from_dict(dict(ds_config[ELASTICITY]))
+    if not cfg.enabled:
+        raise ElasticityConfigError("elasticity.enabled is false")
+    if cfg.version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"unsupported elasticity version {cfg.version}")
+    if not cfg.ignore_non_elastic_batch_info:
+        for key in ("train_batch_size", "train_micro_batch_size_per_gpu",
+                    "gradient_accumulation_steps"):
+            if key in ds_config:
+                raise ElasticityConfigError(
+                    f"{key} conflicts with elasticity; remove it or set "
+                    "elasticity.ignore_non_elastic_batch_info")
+
+    final_batch, valid_gpus = _get_compatible_gpus_v01(
+        cfg.micro_batch_sizes, cfg.max_train_batch_size, cfg.min_gpus,
+        cfg.max_gpus, cfg.prefer_larger_batch_size)
+
+    micro_batch = None
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not in the valid elastic set "
+                f"{valid_gpus} for batch {final_batch}")
+        candidates = sorted(
+            (mb for mb in cfg.micro_batch_sizes
+             if final_batch % (world_size * mb) == 0),
+            reverse=cfg.prefer_larger_batch_size)
+        micro_batch = candidates[0]
+    return final_batch, valid_gpus, micro_batch
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict):
+    """Cross-restart pin via scheduler env (reference: :191): the resolved
+    elastic config MUST NOT change between elastic restarts."""
+    if DEEPSPEED_ELASTICITY_CONFIG in os.environ:
+        scheduler_dict = json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG])
+        scheduler = ElasticityConfig.from_dict(scheduler_dict)
+        runtime = ElasticityConfig.from_dict(runtime_elastic_config_dict)
+        if scheduler.repr_dict() != runtime.repr_dict():
+            raise ElasticityConfigError(
+                "elasticity config changed across restarts: scheduler="
+                f"{scheduler.repr_dict()} runtime={runtime.repr_dict()}")
+    else:
+        os.environ[DEEPSPEED_ELASTICITY_CONFIG] = json.dumps(
+            runtime_elastic_config_dict)

@@ -1,0 +1,61 @@
+"""`ds_tpu_report`: environment / op-compatibility report.
+
+Reference: deepspeed/env_report.py — op_report (:23) prints the
+installed/compatible matrix for every native op, main (:127) adds
+torch/cuda versions. TPU edition reports jax/libtpu, the device
+inventory, Pallas availability, and the csrc/ native-op build matrix.
+"""
+
+import shutil
+import subprocess
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def op_report(printer=print):
+    from .ops.op_builder import op_report as native_rows
+    printer("-" * 64)
+    printer("native op name " + "." * 20 + " compatible ...... reason")
+    printer("-" * 64)
+    for name, ok, reason in native_rows():
+        printer(f"{name:.<35s} {GREEN_OK if ok else RED_NO} ...... {reason}")
+
+    # device-side kernels: Pallas lowering availability
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        printer(f"{'pallas (device kernels)':.<35s} {GREEN_OK}")
+    except Exception as e:  # pragma: no cover
+        printer(f"{'pallas (device kernels)':.<35s} {RED_NO} ...... {e}")
+
+
+def main(printer=print):
+    import jax
+    import jaxlib
+
+    printer("-" * 64)
+    printer("DeepSpeed-TPU general environment info:")
+    printer(f"python version ..................... {sys.version.split()[0]}")
+    printer(f"jax version ........................ {jax.__version__}")
+    printer(f"jaxlib version ..................... {jaxlib.__version__}")
+    try:
+        import flax
+        import optax
+        printer(f"flax / optax ....................... "
+                f"{flax.__version__} / {optax.__version__}")
+    except Exception:
+        pass
+    printer(f"default backend .................... {jax.default_backend()}")
+    devs = jax.devices()
+    printer(f"devices ............................ {len(devs)} x "
+            f"{devs[0].device_kind if devs else 'none'}")
+    printer(f"process count ...................... {jax.process_count()}")
+    printer(f"g++ ................................ "
+            f"{shutil.which('g++') or 'not found'}")
+    op_report(printer)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,7 @@ class InferenceEngine:
         self.mesh = mesh
         self.params = params
         self.checkpoint = checkpoint
+        self.max_tokens = max_tokens
         self._injected = False
         self._compiled: Dict[str, Any] = {}
 
@@ -51,7 +52,7 @@ class InferenceEngine:
             from ..module_inject.replace_module import replace_transformer_layer
             self.module, self.params = replace_transformer_layer(
                 model, params=self.params, policy=injection_policy,
-                dtype=dtype, mesh=mesh, max_tokens=max_tokens)
+                dtype=dtype, mesh=mesh)
             self._injected = True
 
         if self.params is None and checkpoint is not None:
@@ -81,7 +82,23 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32, **kwargs):
         """Greedy/sampled generation with a preallocated KV cache
-        (reference: the KV-cache attention kernels, softmax_context)."""
+        (reference: the KV-cache attention kernels, softmax_context).
+
+        The cache is sized to the engine's ``max_tokens`` (reference:
+        init_inference(max_tokens=...)), so repeated calls with different
+        prompt lengths reuse one compiled decode loop."""
         from .generation import generate as _generate
+        import numpy as np
+        prompt_len = np.shape(input_ids)[-1]
+        needed = prompt_len + max_new_tokens
+        cache_len = max(self.max_tokens, needed)
+        model_max = getattr(getattr(self.module, "config", None),
+                            "max_seq_len", None)
+        if model_max is not None and needed <= model_max:
+            # clamp the preallocated cache to the model limit — but when the
+            # request itself exceeds the limit, pass it through so
+            # generation's informative max_seq_len error fires
+            cache_len = min(cache_len, model_max)
+        kwargs.setdefault("max_len", cache_len)
         return _generate(self.module, self.params, input_ids,
                          max_new_tokens=max_new_tokens, **kwargs)

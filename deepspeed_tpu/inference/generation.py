@@ -99,6 +99,14 @@ def generate(module, params, input_ids, *, max_new_tokens: int = 32,
     total = max_len or (prompt_len + max_new_tokens)
     if total < prompt_len + max_new_tokens:
         raise ValueError("max_len too small for prompt + max_new_tokens")
+    model_max = getattr(getattr(module, "config", None), "max_seq_len", None)
+    if model_max is not None and total > model_max:
+        # jnp.take on the position table clips out-of-range indices, so
+        # without this check decoding past the limit would silently reuse
+        # the last position embedding instead of failing
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {total} exceeds the model's "
+            f"max_seq_len {model_max}")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     cache = init_cache(module, params, b, total)

@@ -1,0 +1,16 @@
+"""Multi-host launcher (reference: deepspeed/launcher/).
+
+The reference spawns one process per GPU per node via pdsh/mpirun
+(launcher/runner.py:317, launcher/launch.py:90). On TPU pods the unit is
+one process per HOST (each host drives its local chips through a single
+JAX client), so the launcher's job is: parse the hostfile, pick a
+coordinator, and start the training script on every host with
+``DS_COORDINATOR_ADDRESS`` / ``DS_NUM_PROCESSES`` / ``DS_PROCESS_ID`` set
+(consumed by deepspeed_tpu.comm.init_distributed ->
+jax.distributed.initialize).
+"""
+
+from .runner import main as runner_main
+from .launch import main as launch_main
+
+__all__ = ["runner_main", "launch_main"]

@@ -1,0 +1,211 @@
+"""`ds_tpu` CLI: multi-host job launcher.
+
+Reference: launcher/runner.py — fetch_hostfile (:157), include/exclude
+filters (:198), world-info encoding (:298), runner selection, main (:317).
+TPU shape: hostfile lines are ``hostname slots=N`` (slots = chips, kept
+for reporting; process count is per-host). Runners build pdsh/ssh command
+lines that exec ``python -m deepspeed_tpu.launcher.launch`` on every host
+with the rendezvous env.
+"""
+
+import argparse
+import base64
+import json
+import os
+import re
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+
+
+def fetch_hostfile(hostfile_path: str) -> "OrderedDict[str, int]":
+    """Parse ``hostname slots=N`` lines (reference: runner.py:157)."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning(f"Unable to find hostfile {hostfile_path}; "
+                       "proceeding single-host")
+        return OrderedDict()
+    resource_pool: "OrderedDict[str, int]" = OrderedDict()
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = re.match(r"^(\S+)\s+slots=(\d+)", line)
+            if m is None:
+                raise ValueError(f"Hostfile line malformed: '{line}' "
+                                 "(expect 'hostname slots=N')")
+            host, slots = m.group(1), int(m.group(2))
+            if host in resource_pool:
+                raise ValueError(f"Hostfile contains duplicate host {host}")
+            resource_pool[host] = slots
+    return resource_pool
+
+
+def parse_inclusion_exclusion(resource_pool: Dict[str, int],
+                              inclusion: str, exclusion: str
+                              ) -> "OrderedDict[str, int]":
+    """--include/--exclude host filters, 'host1,host2' or '@file' style
+    (reference: runner.py:198 parse_resource_filter; TPU hosts are whole
+    units, so no per-slot selection)."""
+    active = OrderedDict(resource_pool)
+    if inclusion:
+        wanted = set(inclusion.split(","))
+        unknown = wanted - set(active)
+        if unknown:
+            raise ValueError(f"--include hosts not in hostfile: {unknown}")
+        active = OrderedDict((h, s) for h, s in active.items() if h in wanted)
+    if exclusion:
+        dropped = set(exclusion.split(","))
+        unknown = dropped - set(active)
+        if unknown:
+            raise ValueError(f"--exclude hosts not in hostfile: {unknown}")
+        active = OrderedDict((h, s) for h, s in active.items()
+                             if h not in dropped)
+    if not active:
+        raise ValueError("No hosts remain after include/exclude filtering")
+    return active
+
+
+def encode_world_info(resource_pool: Dict[str, int]) -> str:
+    """base64 world map passed down to per-host launchers
+    (reference: runner.py:298)."""
+    return base64.urlsafe_b64encode(
+        json.dumps(resource_pool).encode()).decode()
+
+
+def decode_world_info(encoded: str) -> Dict[str, int]:
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+class MultiNodeRunner:
+    """Reference: multinode_runner.py:13 ABC."""
+
+    def __init__(self, args, world_info_base64: str):
+        self.args = args
+        self.world_info_base64 = world_info_base64
+
+    def backend_exists(self) -> bool:
+        raise NotImplementedError
+
+    def get_cmd(self, environment: Dict[str, str],
+                active_resources: Dict[str, int]) -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def user_arguments(self) -> List[str]:
+        return list(map(shlex.quote, self.args.user_args))
+
+    def _launch_cmd(self, proc_id_expr: str) -> str:
+        """The per-host command: run the per-node launcher module."""
+        exports = " ".join(
+            f"{k}={shlex.quote(v)}" for k, v in self.exports.items())
+        return (f"{exports} {sys.executable} -m deepspeed_tpu.launcher.launch "
+                f"--world_info={self.world_info_base64} "
+                f"--node_rank={proc_id_expr} "
+                f"--master_addr={self.args.master_addr} "
+                f"--master_port={self.args.master_port} "
+                f"{shlex.quote(self.args.user_script)} "
+                + " ".join(self.user_arguments))
+
+    exports: Dict[str, str] = {}
+
+
+class PDSHRunner(MultiNodeRunner):
+    """Reference: multinode_runner.py:45."""
+
+    def backend_exists(self) -> bool:
+        return bool(_which("pdsh"))
+
+    def get_cmd(self, environment, active_resources):
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        hosts = ",".join(active_resources.keys())
+        self.exports = {k: v for k, v in environment.items()
+                        if k.startswith(("DS_", "XLA_", "JAX_", "TPU_"))}
+        # %n is pdsh's node-rank substitution
+        return ["pdsh", "-S", "-f", "1024", "-w", hosts,
+                self._launch_cmd("%n")]
+
+
+class SSHRunner(MultiNodeRunner):
+    """Plain ssh loop (TPU-VM pods: `gcloud compute tpus tpu-vm ssh` is a
+    drop-in by setting --ssh_cmd). One ssh per host, backgrounded."""
+
+    def backend_exists(self) -> bool:
+        return bool(_which(self.args.ssh_cmd.split()[0]))
+
+    def get_cmd(self, environment, active_resources):
+        self.exports = {k: v for k, v in environment.items()
+                        if k.startswith(("DS_", "XLA_", "JAX_", "TPU_"))}
+        cmds = []
+        for rank, host in enumerate(active_resources):
+            cmds.append(" ".join(
+                self.args.ssh_cmd.split() + [host,
+                                             shlex.quote(self._launch_cmd(str(rank)))]))
+        return ["bash", "-c", " & ".join(cmds) + " ; wait"]
+
+
+def _which(prog):
+    import shutil
+    return shutil.which(prog)
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ds_tpu",
+        description="deepspeed_tpu multi-host launcher (reference: the "
+                    "`deepspeed` CLI)")
+    parser.add_argument("-H", "--hostfile", default=DLTS_HOSTFILE,
+                        help="hostname slots=N lines; absent = single host")
+    parser.add_argument("-i", "--include", default="",
+                        help="comma-separated hosts to include")
+    parser.add_argument("-e", "--exclude", default="",
+                        help="comma-separated hosts to exclude")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", default="",
+                        help="coordinator address; default = first host")
+    parser.add_argument("--launcher", default="pdsh",
+                        choices=["pdsh", "ssh"],)
+    parser.add_argument("--ssh_cmd", default="ssh",
+                        help="ssh command prefix (e.g. 'gcloud compute tpus "
+                             "tpu-vm ssh')")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="force the multi-node path on one host")
+    parser.add_argument("user_script", help="training script to launch")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if not resource_pool and not args.force_multi:
+        # single host: exec the script in-process env, no rendezvous needed
+        cmd = [sys.executable, args.user_script] + args.user_args
+        logger.info(f"launching single-host: {' '.join(cmd)}")
+        return subprocess.call(cmd)
+
+    active = parse_inclusion_exclusion(resource_pool, args.include,
+                                       args.exclude)
+    args.master_addr = args.master_addr or next(iter(active))
+    world_info = encode_world_info(active)
+
+    runner_cls = {"pdsh": PDSHRunner, "ssh": SSHRunner}[args.launcher]
+    runner = runner_cls(args, world_info)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend '{args.launcher}' not found "
+                           "on PATH")
+    env = dict(os.environ)
+    cmd = runner.get_cmd(env, active)
+    logger.info(f"cmd = {' '.join(cmd)}")
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,7 +102,13 @@ def load_model_checkpoint(module, checkpoint, mesh, dtype=None, policy=None,
             os.path.exists(os.path.join(checkpoint, "latest")):
         # one of our engine checkpoints: params stored as orbax tree
         from ..runtime.checkpointing import load_module_params
-        return load_module_params(checkpoint, mesh)
+        params = load_module_params(checkpoint, mesh)
+        if isinstance(params, dict) and "params" in params and \
+                set(params) <= {"params", "cache", "batch_stats"}:
+            # engine checkpoints hold full flax variables; serving code
+            # passes the inner param collection to module.apply itself
+            params = params["params"]
+        return params
     sd = load_state_dict_from_checkpoint(checkpoint)
     if hf_config is None:
         if isinstance(checkpoint, str) and os.path.isdir(checkpoint) and \

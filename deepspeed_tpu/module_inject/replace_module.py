@@ -50,8 +50,7 @@ def _state_dict_numpy(model) -> dict:
 
 
 def replace_transformer_layer(model, params=None, policy=None,
-                              dtype=jnp.bfloat16, mesh=None,
-                              max_tokens: int = 1024, checkpoint=None):
+                              dtype=jnp.bfloat16, mesh=None, checkpoint=None):
     """Convert a HF model (torch module or HF config) to (flax_module,
     sharded_params).
 

@@ -387,7 +387,8 @@ class HFBertLayerPolicy(InjectionPolicy):
             type_vocab_size=hf.type_vocab_size, d_model=hf.hidden_size,
             n_layers=hf.num_hidden_layers, n_heads=hf.num_attention_heads,
             d_ff=hf.intermediate_size, dtype=dtype,
-            ln_epsilon=hf.layer_norm_eps, pre_ln=False, scan_layers=True)
+            ln_epsilon=hf.layer_norm_eps, pre_ln=False, scan_layers=True,
+            activation=_act(hf, "hidden_act", default="gelu"))
 
     @classmethod
     def convert(cls, sd, cfg):

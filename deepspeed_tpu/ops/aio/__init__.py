@@ -45,8 +45,15 @@ class AsyncIOHandle:
             raise OSError(err, os.strerror(err))
 
     def wait_all(self):
+        """Barrier + consume every ticket THIS handle tracks. The C++
+        barrier leaves completion records intact, so tickets a caller is
+        still holding (e.g. a swapper prefetch) remain individually
+        waitable."""
         err = self.lib.ds_aio_wait_all(self._h)
-        self._pinned.clear()
+        for t in list(self._pinned):
+            e = self.lib.ds_aio_wait(self._h, t)  # immediate: all complete
+            err = err or e
+            self._pinned.pop(t, None)
         if err != 0:
             raise OSError(err, os.strerror(err))
 

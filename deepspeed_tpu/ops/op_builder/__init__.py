@@ -82,16 +82,18 @@ class OpBuilder:
 
     @classmethod
     def _build(cls, lib_path: str):
+        # pid-unique temp then atomic rename: concurrent processes (multi-
+        # host launch, pytest-xdist) may race to build the same op
+        tmp = f"{lib_path}.{os.getpid()}.tmp"
         cmd = (["g++", "-O3", "-fPIC", "-shared", "-std=c++17"]
-               + cls.EXTRA_FLAGS + cls.absolute_sources()
-               + ["-o", lib_path + ".tmp"])
+               + cls.EXTRA_FLAGS + cls.absolute_sources() + ["-o", tmp])
         logger.info(f"building native op {cls.NAME}: {' '.join(cmd)}")
         try:
             subprocess.run(cmd, check=True, capture_output=True, text=True)
         except subprocess.CalledProcessError as e:
             raise RuntimeError(
                 f"native build of '{cls.NAME}' failed:\n{e.stderr}") from e
-        os.replace(lib_path + ".tmp", lib_path)
+        os.replace(tmp, lib_path)
 
 
 class CPUAdamBuilder(OpBuilder):

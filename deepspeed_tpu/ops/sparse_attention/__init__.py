@@ -1,0 +1,10 @@
+from .sparsity_config import (SparsityConfig, DenseSparsityConfig,
+                              FixedSparsityConfig, VariableSparsityConfig,
+                              BigBirdSparsityConfig,
+                              BSLongformerSparsityConfig)
+from .sparse_self_attention import SparseSelfAttention, sparse_attention
+
+__all__ = ["SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+           "VariableSparsityConfig", "BigBirdSparsityConfig",
+           "BSLongformerSparsityConfig", "SparseSelfAttention",
+           "sparse_attention"]

@@ -65,6 +65,22 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
     return path
 
 
+def load_module_params(load_dir, mesh=None, tag=None):
+    """Restore only the model params from an engine checkpoint directory
+    (reference: load_checkpoint with load_module_only=True,
+    engine.py:2472) — used by the inference loader to serve weights
+    trained by this framework without constructing a training engine."""
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = os.path.join(os.path.abspath(load_dir), str(tag), "state")
+    restored = _checkpointer().restore(path)
+    if "params" not in restored:
+        raise ValueError(f"checkpoint at {path} has no 'params' subtree")
+    return restored["params"]
+
+
 def load_engine_checkpoint(engine, load_dir, tag=None,
                            load_optimizer_states=True,
                            load_module_only=False):

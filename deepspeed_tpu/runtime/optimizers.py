@@ -86,19 +86,24 @@ def build_optimizer(opt_type: str, params: dict,
         return optax.sgd(lr, momentum=params.get("momentum", 0.0),
                          nesterov=params.get("nesterov", False))
 
-    if name in (ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
-        # Communication-compressed optimizers (reference: runtime/fp16/onebit/).
-        # On an ICI mesh the gradient reduction is already near-wire-speed;
-        # the compressed-collective analog (EQuARX-style int8 allreduce)
-        # lives in ops.quantizer.compressed_allreduce and is wired by the
-        # engine when communication_data_type requests it. The optimizer
-        # math itself is Adam/LAMB.
-        logger.warning(f"{opt_type}: using uncompressed {('lamb' if 'lamb' in name else 'adam')} "
-                       "math; compressed comm is handled at the collective layer on TPU")
-        if "lamb" in name:
-            return optax.lamb(lr, weight_decay=wd, **_adam_args(params))
-        return optax.adamw(lr, weight_decay=wd, **_adam_args(params)) if wd > 0 else \
-            optax.adam(lr, **_adam_args(params))
+    if name in (ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
+        # error-feedback momentum compression (reference: onebit/adam.py:10,
+        # zoadam.py:10); the wire-level analog lives in
+        # runtime/comm_compression.compressed_allreduce
+        from .comm_compression import onebit_adam, zero_one_adam
+        kw = dict(weight_decay=wd, **_adam_args(params))
+        if name == ZERO_ONE_ADAM_OPTIMIZER:
+            return zero_one_adam(
+                lr, var_freeze_step=params.get("var_freeze_step", 100),
+                var_update_scaler=params.get("var_update_scaler", 16), **kw)
+        return onebit_adam(lr, freeze_step=params.get("freeze_step", 100),
+                           **kw)
+
+    if name == ONEBIT_LAMB_OPTIMIZER:
+        logger.warning(f"{opt_type}: compressed-LAMB falls back to exact "
+                       "LAMB math (momentum compression for LAMB trust "
+                       "ratios is not implemented)")
+        return optax.lamb(lr, weight_decay=wd, **_adam_args(params))
 
     raise ValueError(f"Unknown optimizer type '{opt_type}' "
                      f"(valid: {DEEPSPEED_OPTIMIZERS})")

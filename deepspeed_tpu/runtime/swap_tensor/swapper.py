@@ -62,9 +62,11 @@ class AsyncTensorSwapper:
         return buf
 
     def flush(self):
-        """Join all outstanding writes (call before reusing source buffers)."""
-        self.handle.wait_all()
-        self._write_tickets.clear()
+        """Join all outstanding WRITES (call before reusing source
+        buffers). Pending prefetch reads stay in flight — a flush between
+        prefetch and swap_in must not consume their tickets."""
+        for name in list(self._write_tickets):
+            self.handle.wait(self._write_tickets.pop(name))
 
     def remove(self, name: str):
         self._meta.pop(name, None)

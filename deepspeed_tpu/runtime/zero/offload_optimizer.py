@@ -166,9 +166,9 @@ class CPUAdamOffloadOptimizer:
         ``skip_moments=True`` when load_state_dict will immediately follow
         (it rewrites m/v anyway — avoids a full extra NVMe write)."""
         flat_params = jax.tree.leaves(params)
+        flat_gsh = jax.tree.leaves(self.grad_shardings)
         for li, (leaf, per_leaf) in enumerate(zip(flat_params, self._state)):
-            gsh = _device_memory(jax.tree.leaves(self.grad_shardings)[li])
-            shard_view = jax.device_put(leaf, gsh)
+            shard_view = jax.device_put(leaf, _device_memory(flat_gsh[li]))
             fresh = {_index_key(s.index): s for s in shard_view.addressable_shards}
             for key, ent in per_leaf.items():
                 ent[0] = np.array(fresh[key].data, dtype=np.float32)

@@ -1,0 +1,49 @@
+"""Consolidate a sharded engine checkpoint into standalone fp32 weights.
+
+Reference: deepspeed/utils/zero_to_fp32.py (482 LoC) — offline tool that
+merges per-rank ZeRO shard files into one fp32 state dict. Orbax
+checkpoints are already globally addressed, so "merging" is just a
+restore + downcast-free flatten; the value of this tool is producing a
+framework-independent .npz any numpy/torch/jax user can read.
+
+CLI: python -m deepspeed_tpu.utils.zero_to_fp32 <ckpt_dir> <out.npz> [tag]
+"""
+
+import sys
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Flat {path: fp32 ndarray} from an engine checkpoint (reference:
+    get_fp32_state_dict_from_zero_checkpoint)."""
+    import jax
+    from ..runtime.checkpointing import load_module_params
+
+    params = load_module_params(checkpoint_dir, tag=tag)
+    flat, _ = jax.tree.flatten_with_path(params)
+    out = {}
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        out[name] = np.asarray(leaf, dtype=np.float32)
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+        checkpoint_dir: str, output_file: str, tag: Optional[str] = None):
+    """Write the consolidated weights to ``output_file`` (.npz)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file, **sd)
+    total = sum(v.size for v in sd.values())
+    print(f"saved {len(sd)} tensors / {total:,} params -> {output_file}")
+    return output_file
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 3:
+        print(__doc__)
+        sys.exit(1)
+    convert_zero_checkpoint_to_fp32_state_dict(
+        sys.argv[1], sys.argv[2], sys.argv[3] if len(sys.argv) > 3 else None)

@@ -1,0 +1,204 @@
+"""Compression + 1-bit optimizer tests (reference analogs:
+test_compression.py, test_onebit.py)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+
+class TestFakeQuant:
+    def test_grid_snap_symmetric(self):
+        from deepspeed_tpu.compression.compress import fake_quantize
+        w = jnp.asarray(np.random.default_rng(0).standard_normal((16, 8)),
+                        jnp.float32)
+        q = fake_quantize(w, bits=8)
+        assert q.shape == w.shape
+        # snapping error bounded by half a grid step per channel
+        scale = jnp.max(jnp.abs(w), axis=0) / 127
+        assert jnp.all(jnp.abs(q - w) <= scale[None, :] * 0.5 + 1e-7)
+        # idempotent: quantizing a quantized tensor is a no-op
+        np.testing.assert_allclose(fake_quantize(q, bits=8), q, atol=1e-6)
+
+    def test_lower_bits_coarser(self):
+        from deepspeed_tpu.compression.compress import fake_quantize
+        w = jnp.asarray(np.random.default_rng(1).standard_normal(512),
+                        jnp.float32)
+        err4 = float(jnp.mean((fake_quantize(w, bits=4) - w) ** 2))
+        err8 = float(jnp.mean((fake_quantize(w, bits=8) - w) ** 2))
+        assert err4 > err8
+
+
+class TestPruning:
+    def test_magnitude_mask_ratio(self):
+        from deepspeed_tpu.compression.compress import magnitude_mask
+        w = jnp.asarray(np.random.default_rng(2).standard_normal((32, 32)),
+                        jnp.float32)
+        mask = magnitude_mask(w, 0.5)
+        frac = float(jnp.mean(mask.astype(jnp.float32)))
+        assert 0.45 <= frac <= 0.55
+        # survivors are the larger magnitudes
+        assert float(jnp.abs(w[mask]).min()) >= float(jnp.abs(w[~mask]).max()) - 1e-7
+
+    def test_row_mask_structured(self):
+        from deepspeed_tpu.compression.compress import row_mask
+        w = jnp.asarray(np.random.default_rng(3).standard_normal((16, 8)),
+                        jnp.float32)
+        mask = row_mask(w, 0.25)
+        cols = np.asarray(mask).all(axis=0) | (~np.asarray(mask)).all(axis=0)
+        assert cols.all()  # each output column fully kept or fully dropped
+
+
+class TestCompressor:
+    CFG = {"weight_quantization": {
+               "shared_parameters": {"enabled": True, "schedule_offset": 5},
+               "different_groups": {"wq": {"params": {"start_bits": 8},
+                                           "modules": ["kernel"]}}},
+           "sparse_pruning": {
+               "shared_parameters": {"enabled": True, "schedule_offset": 10},
+               "different_groups": {"sp": {"params": {"dense_ratio": 0.75},
+                                           "modules": ["kernel"]}}}}
+
+    def test_schedule_gating(self):
+        from deepspeed_tpu.compression import init_compression
+        comp = init_compression(self.CFG)
+        params = {"dense": {"kernel": jnp.asarray(
+            np.random.default_rng(4).standard_normal((8, 8)), jnp.float32),
+            "bias": jnp.ones((8,), jnp.float32)}}
+        # before any offset: untouched
+        out = comp.apply(params, step=1)
+        np.testing.assert_array_equal(out["dense"]["kernel"],
+                                      params["dense"]["kernel"])
+        # after quant offset: kernel snapped, bias untouched
+        out5 = comp.apply(params, step=6)
+        assert not np.array_equal(out5["dense"]["kernel"],
+                                  params["dense"]["kernel"])
+        np.testing.assert_array_equal(out5["dense"]["bias"],
+                                      params["dense"]["bias"])
+        # after prune offset too: ~25% zeros
+        out10 = comp.apply(params, step=11)
+        zeros = float(np.mean(np.asarray(out10["dense"]["kernel"]) == 0))
+        assert zeros >= 0.2
+
+    def test_disabled_returns_none(self):
+        from deepspeed_tpu.compression import init_compression
+        assert init_compression(None) is None
+        assert init_compression({}) is None
+
+    def test_redundancy_clean(self):
+        from deepspeed_tpu.compression import redundancy_clean
+        params = {"kernel": jnp.asarray(
+            np.random.default_rng(5).standard_normal((8, 8)), jnp.float32)}
+        out = redundancy_clean(params, self.CFG)
+        assert not np.array_equal(out["kernel"], params["kernel"])
+
+
+class TestOneBitAdam:
+    def _rosenbrockish(self):
+        def loss(p):
+            return jnp.sum((p["a"] - 1.0) ** 2) + jnp.sum(p["b"] ** 2)
+        p = {"a": jnp.zeros(32), "b": jnp.ones(16)}
+        return loss, p
+
+    def test_warmup_matches_adam(self):
+        import optax
+        from deepspeed_tpu.runtime.comm_compression import onebit_adam
+        loss, p0 = self._rosenbrockish()
+        ob = onebit_adam(1e-2, freeze_step=1000)   # never leaves warmup
+        ad = optax.adam(1e-2)
+        p1, s1 = dict(p0), ob.init(p0)
+        p2, s2 = dict(p0), ad.init(p0)
+        for _ in range(10):
+            g1 = jax.grad(loss)(p1)
+            u1, s1 = ob.update(g1, s1, p1)
+            p1 = optax.apply_updates(p1, u1)
+            g2 = jax.grad(loss)(p2)
+            u2, s2 = ad.update(g2, s2, p2)
+            p2 = optax.apply_updates(p2, u2)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5),
+                     p1, p2)
+
+    @pytest.mark.parametrize("maker", ["onebit", "zeroone"])
+    def test_converges_post_freeze(self, maker):
+        import optax
+        from deepspeed_tpu.runtime.comm_compression import (onebit_adam,
+                                                            zero_one_adam)
+        loss, p = self._rosenbrockish()
+        opt = (onebit_adam(5e-2, freeze_step=5) if maker == "onebit"
+               else zero_one_adam(5e-2, var_freeze_step=5, var_update_scaler=4))
+        state = opt.init(p)
+
+        @jax.jit
+        def step(p, state):
+            g = jax.grad(loss)(p)
+            u, state = opt.update(g, state, p)
+            return optax.apply_updates(p, u), state
+
+        l0 = float(loss(p))
+        for _ in range(60):
+            p, state = step(p, state)
+        assert float(loss(p)) < l0 * 0.05, float(loss(p))
+
+    def test_compressed_allreduce_mean(self):
+        from deepspeed_tpu.comm import MeshSpec, build_mesh
+        from deepspeed_tpu.comm.mesh import set_global_mesh
+        from deepspeed_tpu.runtime.comm_compression import compressed_allreduce
+        from deepspeed_tpu.utils.jax_compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = build_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+        x = jnp.asarray(np.random.default_rng(6).standard_normal((4, 64)),
+                        jnp.float32)
+        err = jnp.zeros_like(x)
+
+        def local(x, e):
+            return compressed_allreduce(x, e, "data")
+
+        red, new_err = shard_map(
+            local, mesh, in_specs=(P("data", None), P("data", None)),
+            out_specs=(P("data", None), P("data", None)))(x, err)
+        red = np.asarray(red)
+        xs = np.asarray(x)
+        assert np.isfinite(red).all()
+        # result rows identical: the compressed mean is a true allreduce
+        for i in range(1, 4):
+            np.testing.assert_allclose(red[i], red[0], atol=1e-6)
+        # and equals mean_i(scale_i * sign_i)
+        scales = np.abs(xs).mean(axis=1, keepdims=True)
+        signs = np.where(np.sign(xs) == 0, 1.0, np.sign(xs))
+        np.testing.assert_allclose(red[0], (scales * signs).mean(axis=0),
+                                   rtol=1e-2, atol=1e-3)
+        # error feedback = each participant's LOCAL quantization residual
+        np.testing.assert_allclose(np.asarray(new_err), xs - scales * signs,
+                                   rtol=1e-2, atol=1e-3)
+        set_global_mesh(None)
+
+
+class TestAutotuner:
+    def test_autotuner_picks_feasible_best(self):
+        from deepspeed_tpu.autotuning import Autotuner
+
+        calls = []
+
+        class FakeEngine:
+            def __init__(self, cfg):
+                self.cfg = cfg
+                stage = cfg["zero_optimization"]["stage"]
+                if stage == 3:
+                    raise MemoryError("RESOURCE_EXHAUSTED (fake)")
+                self.delay = {0: 0.004, 1: 0.002, 2: 0.003}[stage]
+
+            def train_batch(self, batch):
+                import time
+                time.sleep(self.delay / self.cfg["train_micro_batch_size_per_gpu"])
+
+        tuner = Autotuner(make_engine=lambda c: FakeEngine(c),
+                          make_batch=lambda c: None,
+                          warmup_steps=0, measure_steps=2)
+        best = tuner.tune({"optimizer": {"type": "Adam", "params": {}}},
+                          zero_stages=(0, 1, 2, 3), micro_batches=(1, 2),
+                          tuner_type="gridsearch")
+        assert best.feasible
+        assert best.config["zero_optimization"]["stage"] != 3
+        infeasible = [r for r in tuner.results if not r.feasible]
+        assert len(infeasible) == 2  # both stage-3 points failed

@@ -149,3 +149,56 @@ class TestInferenceEngine:
                                            replace_with_kernel_inject=True)
         out = eng.generate(jnp.asarray(ids_np), max_new_tokens=4)
         assert out.shape == (2, 16)
+
+
+class TestCheckpointServing:
+    def test_load_module_params_roundtrip(self, tmp_path):
+        """Train-engine checkpoint -> inference weights (reference:
+        InferenceEngine checkpoint loading, inference/engine.py:240)."""
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.comm import MeshSpec, build_mesh
+        from deepspeed_tpu.comm.mesh import set_global_mesh
+        from deepspeed_tpu.models import GPT, GPTConfig, gpt_loss_fn
+        from deepspeed_tpu.runtime.checkpointing import load_module_params
+
+        cfg = GPTConfig(vocab_size=90, max_seq_len=32, d_model=32, n_layers=2,
+                        n_heads=2, dtype=jnp.float32, scan_layers=True)
+
+        def loss_fn(model, params, batch, rng, train):
+            logits = model.apply(params, batch["input_ids"],
+                                 deterministic=not train)
+            return gpt_loss_fn(logits[:, :-1], batch["input_ids"][:, 1:])
+
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 90, size=(2, 32),
+                                           dtype=np.int32)}
+        mesh = build_mesh(MeshSpec(data=2), devices=jax.devices()[:2])
+        engine, _, _, _ = ds.initialize(
+            model=GPT(cfg), config={
+                "train_batch_size": 2, "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "steps_per_print": 1000},
+            loss_fn=loss_fn, sample_batch={"input_ids": batch["input_ids"][:1]},
+            rng=jax.random.PRNGKey(0), mesh=mesh)
+        engine.train_batch(batch)
+        engine.save_checkpoint(str(tmp_path))
+        want = engine.eval_batch(batch)
+        set_global_mesh(None)
+
+        params = load_module_params(str(tmp_path))
+        model = GPT(cfg)
+        logits = jax.jit(lambda p, x: model.apply(p, x, deterministic=True))(
+            params, batch["input_ids"])
+        got = float(gpt_loss_fn(logits[:, :-1], batch["input_ids"][:, 1:]))
+        np.testing.assert_allclose(got, float(want), rtol=1e-5)
+
+    def test_generate_rejects_past_max_seq_len(self):
+        from deepspeed_tpu.models import GPT, GPTConfig
+        from deepspeed_tpu.inference.generation import generate
+        cfg = GPTConfig(vocab_size=32, max_seq_len=16, d_model=16, n_layers=1,
+                        n_heads=2, dtype=jnp.float32)
+        m = GPT(cfg)
+        ids = jnp.ones((1, 12), jnp.int32)
+        params = m.init(jax.random.PRNGKey(0), ids)["params"]
+        with pytest.raises(ValueError, match="max_seq_len"):
+            generate(m, params, ids, max_new_tokens=8)

@@ -108,6 +108,15 @@ def test_tensor_swapper(tmp_path):
     sw.prefetch("layers/0/kernel")
     np.testing.assert_array_equal(sw.swap_in("layers/0/bias"), b)
     np.testing.assert_array_equal(sw.swap_in("layers/0/kernel"), a)
+
+    # regression: a flush() between prefetch and swap_in must not consume
+    # the read ticket (previously hung forever)
+    c = np.arange(256, dtype=np.float32)
+    sw.prefetch("layers/0/kernel")
+    sw.swap_out("layers/0/extra", c)
+    sw.flush()
+    np.testing.assert_array_equal(sw.swap_in("layers/0/kernel"), a)
+    np.testing.assert_array_equal(sw.swap_in("layers/0/extra"), c)
     sw.close()
 
 
