@@ -34,14 +34,14 @@ def main():
     n_chips = len(jax.devices())
     mcfg = dataclasses.replace(GPT2_PRESETS["gpt2-125m"],
                                dtype=jnp.bfloat16, scan_layers=True,
-                               remat="none")
+                               remat="dots")
 
     def loss_fn(model, params, batch, rng, train):
         ids = batch["input_ids"]
         logits = model.apply(params, ids, deterministic=not train)
         return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
 
-    batch_per_chip = 8
+    batch_per_chip = 24
     global_batch = batch_per_chip * n_chips
     config = {
         "train_batch_size": global_batch,

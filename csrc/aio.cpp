@@ -21,6 +21,7 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -40,6 +41,7 @@ struct Handle {
   std::mutex mu;
   std::condition_variable cv_submit, cv_done;
   std::unordered_map<int64_t, int> done;  // ticket -> errno (0 = ok)
+  std::unordered_set<int64_t> pending;    // submitted, not yet completed
   int64_t next_ticket = 1;
   int64_t inflight = 0;
   bool shutdown = false;
@@ -100,6 +102,7 @@ struct Handle {
       {
         std::lock_guard<std::mutex> lock(mu);
         done[r.ticket] = err;
+        pending.erase(r.ticket);
         --inflight;
       }
       cv_done.notify_all();
@@ -114,17 +117,25 @@ struct Handle {
       if (shutdown) return -1;
       t = next_ticket++;
       queue.push_back(Request{t, write, path, buf, nbytes, offset});
+      pending.insert(t);
       ++inflight;
     }
     cv_submit.notify_one();
     return t;
   }
 
+  // Safe against double-wait: a ticket that is neither pending nor in
+  // done was already consumed (or never issued) — return 0 instead of
+  // blocking forever.
   int wait(int64_t ticket) {
     std::unique_lock<std::mutex> lock(mu);
-    cv_done.wait(lock, [&] { return done.count(ticket) > 0; });
-    int err = done[ticket];
-    done.erase(ticket);
+    cv_done.wait(lock, [&] {
+      return done.count(ticket) > 0 || pending.count(ticket) == 0;
+    });
+    auto it = done.find(ticket);
+    if (it == done.end()) return 0;
+    int err = it->second;
+    done.erase(it);
     return err;
   }
 

@@ -1,0 +1,3 @@
+from .reshape import DeepSpeedCheckpoint, reshape_checkpoint
+
+__all__ = ["DeepSpeedCheckpoint", "reshape_checkpoint"]

@@ -1,0 +1,118 @@
+"""Offline checkpoint reshaping (TP/PP/DP degree changes).
+
+Reference: deepspeed/checkpoint/ (962 LoC) — DeepSpeedCheckpoint
+(deepspeed_checkpoint.py:37) re-maps per-rank Megatron shard files when
+the parallel topology changes (reshape_3d_utils.py, reshape_meg_2d.py),
+because torch checkpoints are rank-file-shaped.
+
+Orbax checkpoints are *globally addressed*: every array is stored with
+its global shape, so "reshaping" to a new mesh is simply restoring under
+the new topology's shardings — the engine's load path already does this
+(runtime/checkpointing.py restore-with-template). This module provides
+the reference's offline surface on top of that fact:
+
+- ``DeepSpeedCheckpoint``: inspect a checkpoint (params, shapes, step
+  metadata) without building an engine.
+- ``reshape_checkpoint``: rewrite a checkpoint for a target MeshSpec —
+  verifying the new topology divides every sharded dim — so a resumed
+  run fails fast at reshape time, not mid-restore on a pod.
+"""
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class DeepSpeedCheckpoint:
+    """reference surface: DeepSpeedCheckpoint(dir).show_*/get_* without
+    the TP/PP slicing zoo (global addressing makes it unnecessary)."""
+
+    def __init__(self, ckpt_dir: str, tag: Optional[str] = None):
+        from ..runtime.checkpointing import LATEST_FILE
+        self.dir = ckpt_dir
+        if tag is None:
+            with open(os.path.join(ckpt_dir, LATEST_FILE)) as f:
+                tag = f.read().strip()
+        self.tag = str(tag)
+        self.path = os.path.join(os.path.abspath(ckpt_dir), self.tag)
+        meta_path = os.path.join(self.path, "engine_meta.json")
+        self.meta: Dict = {}
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                self.meta = json.load(f)
+
+    @property
+    def global_steps(self) -> int:
+        return int(self.meta.get("global_steps", 0))
+
+    @property
+    def zero_stage(self) -> int:
+        return int(self.meta.get("zero_stage", 0))
+
+    @property
+    def dp_world_size(self) -> int:
+        return int(self.meta.get("dp_world_size", 1))
+
+    def load_params(self):
+        from ..runtime.checkpointing import load_module_params
+        return load_module_params(self.dir, tag=self.tag)
+
+    def param_shapes(self) -> Dict[str, tuple]:
+        import jax
+        params = self.load_params()
+        flat, _ = jax.tree.flatten_with_path(params)
+        return {jax.tree_util.keystr(p): tuple(np.shape(v)) for p, v in flat}
+
+    def show_parameters(self):
+        for name, shape in self.param_shapes().items():
+            print(f"{name}: {shape}")
+
+
+def reshape_checkpoint(src_dir: str, dst_dir: str, target_mesh_spec=None,
+                       tag: Optional[str] = None):
+    """Re-write ``src_dir`` under ``dst_dir`` validated against a target
+    topology (reference: the ds_to_universal/reshape flow).
+
+    The rewrite stores plain global arrays; restoring on the target mesh
+    shards them per the engine's rules. With ``target_mesh_spec`` given,
+    sharded-dim divisibility is checked up front (the reference's degree-
+    compatibility checks in reshape_3d_utils.py).
+    """
+    import jax
+    import orbax.checkpoint as ocp
+    from ..runtime.checkpointing import LATEST_FILE
+
+    src = DeepSpeedCheckpoint(src_dir, tag)
+    params = src.load_params()
+
+    if target_mesh_spec is not None:
+        sizes = {"model": target_mesh_spec.model,
+                 "fsdp": target_mesh_spec.fsdp,
+                 "expert": target_mesh_spec.expert}
+        flat, _ = jax.tree.flatten_with_path(params)
+        for path, v in flat:
+            shape = np.shape(v)
+            if not shape:
+                continue
+            for axis_name, size in sizes.items():
+                if size > 1 and not any(d % size == 0 for d in shape):
+                    raise ValueError(
+                        f"param {jax.tree_util.keystr(path)} shape {shape} "
+                        f"has no dim divisible by {axis_name}={size}; "
+                        "target topology cannot shard it")
+
+    dst = os.path.join(os.path.abspath(dst_dir), src.tag)
+    os.makedirs(dst, exist_ok=True)
+    ocp.PyTreeCheckpointer().save(os.path.join(dst, "state"),
+                                  {"params": params}, force=True)
+    if src.meta:
+        with open(os.path.join(dst, "engine_meta.json"), "w") as f:
+            json.dump(src.meta, f)
+    with open(os.path.join(dst_dir, LATEST_FILE), "w") as f:
+        f.write(src.tag)
+    logger.info(f"reshaped checkpoint {src.path} -> {dst}")
+    return dst

@@ -69,6 +69,39 @@ def row_mask(w, ratio: float):
     return jnp.broadcast_to(norms > thresh, w.shape)
 
 
+def channel_mask(w, ratio: float):
+    """Structured input-channel pruning: drop rows of the FIRST dim with
+    the smallest L2 norm (reference: basic_layer.py channel pruning)."""
+    if ratio <= 0 or w.ndim < 2:
+        return jnp.ones_like(w, dtype=bool)
+    norms = jnp.sqrt(jnp.sum(w * w, axis=tuple(range(1, w.ndim))))
+    k = int(norms.shape[0] * ratio)
+    if k == 0:
+        return jnp.ones_like(w, dtype=bool)
+    thresh = jnp.sort(norms)[k - 1]
+    keep = (norms > thresh).reshape((w.shape[0],) + (1,) * (w.ndim - 1))
+    return jnp.broadcast_to(keep, w.shape)
+
+
+def head_mask(w, ratio: float, num_heads: int):
+    """Structured head pruning on an attention projection whose LAST dim
+    is heads*head_dim: drop whole head-blocks by L2 norm (reference:
+    basic_layer.py head pruning on the output projection's rows)."""
+    out_dim = w.shape[-1]
+    if ratio <= 0 or num_heads <= 1 or out_dim % num_heads != 0:
+        return jnp.ones_like(w, dtype=bool)
+    head_dim = out_dim // num_heads
+    grouped = w.reshape(*w.shape[:-1], num_heads, head_dim)
+    norms = jnp.sqrt(jnp.sum(
+        grouped * grouped, axis=tuple(range(grouped.ndim - 2)) + (grouped.ndim - 1,)))
+    k = int(num_heads * ratio)
+    if k == 0:
+        return jnp.ones_like(w, dtype=bool)
+    thresh = jnp.sort(norms)[k - 1]
+    keep = jnp.repeat(norms > thresh, head_dim)
+    return jnp.broadcast_to(keep, w.shape)
+
+
 class Compressor:
     """Schedule-driven param projection; apply() each step (cheap no-op
     before the schedule offsets)."""
@@ -94,6 +127,18 @@ class Compressor:
                 if _matches(path, g.modules):
                     out = out * row_mask(
                         out, 1 - g.params.get("dense_ratio", 1))
+        if c.channel_pruning.enabled and \
+                step >= c.channel_pruning.schedule_offset:
+            for g in c.channel_pruning.groups.values():
+                if _matches(path, g.modules):
+                    out = out * channel_mask(
+                        out, 1 - g.params.get("dense_ratio", 1))
+        if c.head_pruning.enabled and step >= c.head_pruning.schedule_offset:
+            for g in c.head_pruning.groups.values():
+                if _matches(path, g.modules):
+                    out = out * head_mask(
+                        out, 1 - g.params.get("dense_ratio", 1),
+                        num_heads=int(g.params.get("num_heads", 1)))
         if c.weight_quantization.enabled and \
                 step >= c.weight_quantization.schedule_offset:
             for g in c.weight_quantization.groups.values():
@@ -119,7 +164,8 @@ class Compressor:
         phase = tuple(
             t.enabled and step >= t.schedule_offset
             for t in (self.config.weight_quantization,
-                      self.config.sparse_pruning, self.config.row_pruning))
+                      self.config.sparse_pruning, self.config.row_pruning,
+                      self.config.channel_pruning, self.config.head_pruning))
         if phase not in self._jitted:
             def project(tree):
                 flat, treedef = jax.tree.flatten_with_path(tree)

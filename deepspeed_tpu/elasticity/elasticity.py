@@ -70,15 +70,35 @@ def elasticity_enabled(ds_config: dict) -> bool:
     return bool(ds_config.get(ELASTICITY, {}).get("enabled", ENABLED_DEFAULT))
 
 
+def _divisors(n: int) -> List[int]:
+    small, large = [], []
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            small.append(d)
+            if d != n // d:
+                large.append(n // d)
+        d += 1
+    return small + large[::-1]
+
+
 def _get_valid_gpus(batch_size: int, micro_batches: List[int],
                     min_gpus: int, max_gpus: int) -> List[int]:
     """Chip counts that evenly consume ``batch_size`` with SOME micro batch
-    (reference: elasticity.py get_valid_gpus)."""
-    valid = []
-    for g in range(min_gpus, max_gpus + 1):
-        if any(batch_size % (g * mb) == 0 for mb in micro_batches):
-            valid.append(g)
-    return valid
+    (reference: elasticity.py get_valid_gpus).
+
+    g is valid iff g*mb divides batch for some mb — i.e. g = D/mb for a
+    divisor D of batch with mb | D. Enumerating divisors is
+    O(sqrt(batch) * n_micro) instead of scanning every count up to
+    max_gpus (10k+ by default)."""
+    valid = set()
+    for d in _divisors(batch_size):
+        for mb in micro_batches:
+            if d % mb == 0:
+                g = d // mb
+                if min_gpus <= g <= max_gpus:
+                    valid.add(g)
+    return sorted(valid)
 
 
 def _get_compatible_gpus_v01(micro_batches: List[int], max_batch: int,

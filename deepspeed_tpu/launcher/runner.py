@@ -147,7 +147,13 @@ class SSHRunner(MultiNodeRunner):
             cmds.append(" ".join(
                 self.args.ssh_cmd.split() + [host,
                                              shlex.quote(self._launch_cmd(str(rank)))]))
-        return ["bash", "-c", " & ".join(cmds) + " ; wait"]
+        # join each pid explicitly — a bare `wait` always exits 0 and would
+        # mask remote training failures from CI/schedulers
+        script = ("pids=(); "
+                  + " ".join(f"{c} & pids+=($!);" for c in cmds)
+                  + ' rc=0; for p in "${pids[@]}"; do wait "$p" || rc=1; '
+                  "done; exit $rc")
+        return ["bash", "-c", script]
 
 
 def _which(prog):

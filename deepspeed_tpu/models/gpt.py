@@ -140,15 +140,16 @@ class GPT(nn.Module):
         block_cls = Block
         policy = REMAT_POLICIES.get(cfg.remat)
         if cfg.remat != "none":
+            # all-positional call below; deterministic (4) and decode (6)
+            # are python bools and must stay static under remat
             block_cls = nn.remat(
                 Block, policy=policy, prevent_cse=not cfg.scan_layers,
-                static_argnums=(4,))
+                static_argnums=(4, 6))
 
         if cfg.scan_layers:
             def body(block, carry):
                 x = block(carry, mask, bias, deterministic,
-                          layer_keep_prob=layer_keep_prob, decode=decode,
-                          positions=positions)
+                          layer_keep_prob, decode, positions)
                 return x, None
 
             h, _ = nn.scan(
@@ -161,8 +162,8 @@ class GPT(nn.Module):
         else:
             for i in range(cfg.n_layers):
                 h = block_cls(**block_kwargs, name=f"h_{i}")(
-                    h, mask, bias, deterministic, layer_keep_prob=layer_keep_prob,
-                    decode=decode, positions=positions)
+                    h, mask, bias, deterministic, layer_keep_prob,
+                    decode, positions)
 
         h = LayerNorm(epsilon=cfg.ln_epsilon, name="ln_f")(h)
 

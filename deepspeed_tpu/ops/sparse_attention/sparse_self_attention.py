@@ -10,7 +10,7 @@ compose with both). A Pallas kernel that skips zero blocks entirely
 (splash-attention style) can swap in behind this same interface.
 """
 
-from functools import lru_cache
+
 from typing import Any, Optional
 
 import numpy as np
@@ -21,23 +21,28 @@ from ..transformer.attention import attention
 from .sparsity_config import SparsityConfig, FixedSparsityConfig
 
 
-@lru_cache(maxsize=32)
-def _dense_mask_cached(config_key, seq_len):
-    cfg, = config_key
-    layout = cfg.make_layout(seq_len)
-    block = cfg.block
-    mask = np.kron(layout, np.ones((block, block), np.int8))
-    return jnp.asarray(mask[None].astype(bool))  # [1, H, S, S]
+_MASK_CACHE = {}
 
 
 def layout_to_dense_mask(config: SparsityConfig, seq_len: int):
-    """Expand the block layout to a [1, heads, S, S] boolean mask."""
+    """Expand the block layout to a [1, heads, S, S] boolean mask, cached
+    by config VALUE (not identity — configs are routinely rebuilt per
+    call, e.g. SparseSelfAttention's default Fixed config)."""
     try:
-        return _dense_mask_cached((config,), seq_len)
-    except TypeError:  # unhashable custom config
-        layout = config.make_layout(seq_len)
-        mask = np.kron(layout, np.ones((config.block, config.block), np.int8))
-        return jnp.asarray(mask[None].astype(bool))
+        key = (config.cache_key(), seq_len)
+    except TypeError:   # unhashable custom attribute: compute uncached
+        key = None
+    if key is not None and key in _MASK_CACHE:
+        return _MASK_CACHE[key]
+    layout = config.make_layout(seq_len)
+    mask = jnp.asarray(np.kron(
+        layout, np.ones((config.block, config.block), np.int8))[None]
+        .astype(bool))  # [1, H, S, S]
+    if key is not None:
+        if len(_MASK_CACHE) >= 32:
+            _MASK_CACHE.pop(next(iter(_MASK_CACHE)))
+        _MASK_CACHE[key] = mask
+    return mask
 
 
 def sparse_attention(q, k, v, sparsity_config: SparsityConfig, *,

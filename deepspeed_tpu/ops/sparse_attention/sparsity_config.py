@@ -20,6 +20,14 @@ class SparsityConfig:
         self.block = block
         self.different_layout_per_head = different_layout_per_head
 
+    def cache_key(self):
+        """Value-based key for mask caching (configs have no __eq__; two
+        equal-valued instances must share one cached mask)."""
+        items = tuple(sorted(
+            (k, tuple(v) if isinstance(v, list) else v)
+            for k, v in vars(self).items()))
+        return (type(self).__name__, items)
+
     def setup_layout(self, seq_len: int) -> np.ndarray:
         if seq_len % self.block != 0:
             raise ValueError(

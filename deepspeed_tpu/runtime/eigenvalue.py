@@ -1,0 +1,112 @@
+"""Power-iteration curvature estimation (MoQ's eigenvalue schedule).
+
+Reference: runtime/eigenvalue.py:7 — estimates the max |eigenvalue| of
+each layer-block's loss Hessian by power iteration over autograd
+grad-of-grad products; MoQ uses the per-layer ratios to decide how fast
+each layer's quantization bits shrink.
+
+JAX edition: the Hessian-vector product is ``jvp of grad`` (forward-over-
+reverse), exact and jit-compiled; one ``lax.scan``'d power loop per
+requested block. Blocks are selected by a path-substring predicate over
+the param tree (the reference's layer-name regex).
+"""
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+
+
+def _normalize(tree):
+    norm = jnp.sqrt(sum(jnp.vdot(x, x).real for x in jax.tree.leaves(tree)))
+    norm = jnp.maximum(norm, 1e-12)
+    return jax.tree.map(lambda x: x / norm, tree), norm
+
+
+class Eigenvalue:
+    """reference surface: Eigenvalue(verbose, max_iter, tol, stability,
+    gas_boundary_resolution, layer_name, layer_num).compute_eigenvalue"""
+
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1,
+                 layer_name: str = "", layer_num: int = 0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+
+    def _block_masks(self, params) -> List[Any]:
+        """One boolean mask tree per block (params whose path contains
+        layer_name; all-in-one block when no name given)."""
+        flat, treedef = jax.tree.flatten_with_path(params)
+        if not self.layer_name:
+            return [jax.tree.unflatten(treedef, [True] * len(flat))]
+        masks = []
+        n = max(self.layer_num, 1)
+        for i in range(n):
+            key = f"{self.layer_name}" + (f"_{i}" if self.layer_num else "")
+            masks.append(jax.tree.unflatten(
+                treedef, [key in jax.tree_util.keystr(p) for p, _ in flat]))
+        return masks
+
+    def compute_eigenvalue(self, loss_fn: Callable, params,
+                           rng: Optional[jax.Array] = None) -> List[float]:
+        """Max |eigenvalue| per block of the Hessian of
+        ``loss_fn(params)`` (reference: compute_eigenvalue; the torch
+        version seeds random +-1 vectors and iterates grad-of-grad)."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(p, v):
+            return jax.jvp(grad_fn, (p,), (v,))[1]
+
+        results = []
+        for mask in self._block_masks(params):
+            def masked(tree):
+                return jax.tree.map(
+                    lambda x, m: x if m else jnp.zeros_like(x), tree, mask)
+
+            flat_p, treedef = jax.tree.flatten(params)
+            flat_m = jax.tree.leaves(mask)
+            v = jax.tree.unflatten(treedef, [
+                (jax.random.rademacher(jax.random.fold_in(rng, i), x.shape,
+                                       dtype=jnp.float32).astype(x.dtype)
+                 if m else jnp.zeros_like(x))
+                for i, (x, m) in enumerate(zip(flat_p, flat_m))])
+            v, _ = _normalize(v)
+
+            @jax.jit
+            def power_step(v):
+                hv = masked(hvp(params, v))
+                return _normalize(hv)
+
+            eig_prev = jnp.float32(0.0)
+            eig = jnp.float32(0.0)
+            for i in range(self.max_iter):
+                v, eig = power_step(v)
+                if i > 0 and abs(float(eig - eig_prev)) / max(
+                        float(abs(eig)), 1e-12) < self.tol:
+                    break
+                eig_prev = eig
+            results.append(float(eig) + self.stability)
+            if self.verbose:
+                logger.info(f"eigenvalue block {len(results)-1}: "
+                            f"{results[-1]:.4e} ({i+1} iters)")
+        return results
+
+
+def post_process_eigenvalues(values: List[float]) -> List[float]:
+    """Ratios in (0, 1] for MoQQuantizer.layer_ratios: the LARGEST
+    curvature gets the SMALLEST ratio (longest quantization period — most
+    sensitive layers quantize last, the reference's eigenvalue mode)."""
+    if not values:
+        return []
+    mn = min(v for v in values if v > 0) if any(v > 0 for v in values) else 1.0
+    return [mn / v if v > 0 else 1.0 for v in values]

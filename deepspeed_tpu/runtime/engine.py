@@ -480,6 +480,10 @@ class DeepSpeedEngine:
         self.tput_timer.stop(global_step=True)
         self._last_loss = metrics["loss"]
 
+        if (cfg.flops_profiler.enabled
+                and self.global_steps == cfg.flops_profiler.profile_step):
+            self._print_flops_profile(batch)
+
         if self.global_steps % cfg.steps_per_print == 0:
             self._report_step(metrics)
         self._write_monitor(metrics)
@@ -642,6 +646,46 @@ class DeepSpeedEngine:
                                       load_module_only=load_module_only)
 
     # ------------------------------------------------------------------
+
+    def _print_flops_profile(self, placed_batch):
+        """FLOPS profile of the actual compiled train step at profile_step
+        (reference: FlopsProfiler printed from engine.py:1599/:1976 —
+        there by functional monkey-patching, here from XLA cost analysis
+        of the very executable that runs)."""
+        import numpy as np
+        try:
+            scaler = self.loss_scale_state or init_loss_scale(1.0)
+            rng = jax.random.fold_in(self.rng, self.global_steps)
+            if self.native_offload is not None:
+                lowered = self._compiled["grad_step"].lower(
+                    self.params, scaler, placed_batch, rng)
+            else:
+                lowered = self._compiled["train_step"].lower(
+                    self.params, self.optimizer_state, scaler,
+                    placed_batch, rng)
+            cost = lowered.compile().cost_analysis() or {}
+            if isinstance(cost, list):
+                cost = cost[0] if cost else {}
+            flops = float(cost.get("flops", 0.0))
+            n_params = int(sum(np.prod(x.shape)
+                               for x in jax.tree.leaves(self.params)))
+            step_s = self.tput_timer.avg_step_time() if hasattr(
+                self.tput_timer, "avg_step_time") else None
+            line = (f"flops profiler @ step {self.global_steps}: "
+                    f"params={n_params/1e6:.1f}M "
+                    f"train-step flops={flops/1e9:.2f}G "
+                    f"bytes={float(cost.get('bytes accessed', 0))/1e9:.2f}G")
+            if step_s:
+                line += f" achieved={flops/step_s/1e12:.1f} TFLOPS"
+            log_dist(line, ranks=[0])
+            out_file = self.config.flops_profiler.output_file
+            if out_file and jax.process_index() == 0:
+                with open(out_file, "w") as f:
+                    f.write(line + "\n")
+                    for k, v in sorted(cost.items()):
+                        f.write(f"{k}: {v}\n")
+        except Exception as e:  # profiling must never kill training
+            logger.warning(f"flops profiler failed: {e}")
 
     def _report_step(self, metrics):
         loss = float(metrics["loss"])

@@ -1,0 +1,94 @@
+"""Training-time mixed-precision quantization (MoQ).
+
+Reference: runtime/quantize.py (Quantizer, 186 LoC) + weight_quantizer.py
+— MoQ anneals weight precision from ``start_bits`` to ``target_bits``
+over ``quantize_period`` steps (doubling the period each bit drop), with
+an optional eigenvalue mode where layers with larger Hessian curvature
+shrink more slowly. The fake-quant snap itself is shared with the
+compression package (same grid math as csrc/quantization kernels).
+"""
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..compression.compress import fake_quantize
+from ..utils.logging import logger
+
+
+@dataclass
+class MoQConfig:
+    """reference: the ``quantize_training`` config block."""
+    enabled: bool = False
+    quantize_verbose: bool = False
+    quantizer_kernel: bool = False          # reference: CUDA kernel; Pallas/XLA here
+    quantize_type: str = "symmetric"        # symmetric | asymmetric
+    quantize_bits_start: int = 16
+    quantize_bits_target: int = 8
+    quantize_period: int = 100
+    quantize_groups: int = 1
+    fp16_mixed_quantize: bool = False
+    quantize_change_ratio: float = 0.001
+    eigenvalue_enabled: bool = False
+
+
+class MoQQuantizer:
+    """Stepwise bit-annealing quantizer (reference: Quantizer.quantize).
+
+    ``bits(step)``: start_bits, halving toward target_bits with the period
+    doubling at each drop (the reference's schedule); per-layer ratios
+    (from Eigenvalue) stretch the period of high-curvature layers:
+    ``layer_ratios`` maps a param-path substring to its ratio in (0, 1]
+    (post_process_eigenvalues output) — smaller ratio = longer period =
+    that layer quantizes more slowly.
+    """
+
+    def __init__(self, config: MoQConfig,
+                 layer_ratios: Optional[Dict[str, float]] = None):
+        self.config = config
+        self.layer_ratios = dict(layer_ratios or {})
+        self._jitted = {}
+
+    def _ratio_for(self, path: str) -> float:
+        for pattern, r in self.layer_ratios.items():
+            if pattern in path:
+                return float(r)
+        return 1.0
+
+    def bits_at(self, step: int, ratio: float = 1.0) -> int:
+        c = self.config
+        bits = c.quantize_bits_start
+        period = max(int(c.quantize_period / max(ratio, 1e-3)), 1)
+        t = step
+        while bits > c.quantize_bits_target and t >= period:
+            t -= period
+            period *= 2   # each precision drop holds twice as long
+            bits = max(bits // 2, c.quantize_bits_target)
+        return bits
+
+    def quantize(self, params, step: int):
+        """Snap floating-point weight matrices to their current per-layer
+        bit grid (bits depend on the layer's eigenvalue ratio)."""
+        if not self.config.enabled:
+            return params
+        sym = self.config.quantize_type == "symmetric"
+        flat, treedef = jax.tree.flatten_with_path(params)
+        leaf_bits = tuple(
+            self.bits_at(step, self._ratio_for(jax.tree_util.keystr(p)))
+            for p, _ in flat)
+        if all(b >= 16 for b in leaf_bits):  # fp16-mixed region: no snap yet
+            return params
+        key = (leaf_bits, sym)
+        if key not in self._jitted:
+            def project(leaves):
+                return [fake_quantize(w, bits=b, symmetric=sym)
+                        if (b < 16 and hasattr(w, "ndim") and w.ndim >= 2
+                            and jnp.issubdtype(w.dtype, jnp.floating)) else w
+                        for w, b in zip(leaves, leaf_bits)]
+            self._jitted[key] = jax.jit(project)
+        if self.config.quantize_verbose:
+            logger.info(f"MoQ: step {step} -> bits {sorted(set(leaf_bits))}")
+        return jax.tree.unflatten(treedef,
+                                  self._jitted[key]([w for _, w in flat]))

@@ -1,0 +1,61 @@
+"""Sparse gradient container.
+
+Reference: runtime/sparse_tensor.py SparseTensor + engine.sparse_allreduce
+(engine.py:2248) — torch emits sparse COO grads for
+``nn.Embedding(sparse=True)`` and DeepSpeed allreduces (indices, values)
+instead of the dense table.
+
+JAX computes dense embedding grads (scatter-add into the table), and
+XLA's in-network allreduce makes the dense reduction the fast path on
+ICI, so this container exists for (a) API parity, (b) bandwidth-starved
+DCN links where row-sparse exchange wins. It holds the row-compressed
+form of an embedding gradient; ``sparse_allreduce`` sums over a mesh
+axis inside shard_map via gather-of-rows (the reference's
+all-gather-based sparse allreduce, engine.py:2295).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class SparseTensor(NamedTuple):
+    """Row-sparse view of a [vocab, dim] gradient (reference surface:
+    SparseTensor(indices, values, dense_size))."""
+    indices: jnp.ndarray      # [nnz] row ids
+    values: jnp.ndarray       # [nnz, dim]
+    dense_shape: tuple
+
+    @classmethod
+    def from_dense(cls, dense, max_rows: int):
+        """Top-|max_rows| nonzero rows (static nnz keeps it jittable)."""
+        row_norm = jnp.sum(jnp.abs(dense), axis=tuple(range(1, dense.ndim)))
+        _, idx = lax.top_k(row_norm, max_rows)
+        return cls(idx, dense[idx], tuple(dense.shape))
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    @property
+    def sparse_size(self):
+        return self.indices.size + self.values.size
+
+
+def sparse_allreduce(st: SparseTensor, axis_name: str) -> SparseTensor:
+    """Sum row-sparse grads across an axis inside shard_map: all-gather
+    (indices, values) and re-compress (reference: sparse_allreduce's
+    gather + unique path).
+
+    Capacity of the result = n_participants * local nnz — the union's true
+    upper bound. Compressing back to the local nnz would silently DROP
+    rows whenever participants touch different rows (the normal DP case).
+    """
+    n = lax.psum(1, axis_name)
+    all_idx = lax.all_gather(st.indices, axis_name, tiled=True)
+    all_val = lax.all_gather(st.values, axis_name, tiled=True)
+    dense = jnp.zeros(st.dense_shape, st.values.dtype).at[all_idx].add(all_val)
+    capacity = min(int(n) * st.indices.shape[0], st.dense_shape[0])
+    return SparseTensor.from_dense(dense, max_rows=capacity)

@@ -1,0 +1,91 @@
+"""Tiled linear layers.
+
+Reference: runtime/zero/tiling.py:27 TiledLinear — splits a huge linear
+into in/out tiles so ZeRO-3 can fetch/partition pieces instead of one
+giant tensor (copy_params_from :206 imports a dense layer's weights).
+
+On TPU the fsdp sharding rules already partition any big matmul, so the
+remaining reasons to tile are the reference's other two: bounding the
+*transient* memory of gather-before-use (each tile all-gathers
+separately under scan) and aligning huge vocab projections to mesh-
+divisible chunks. The flax module keeps the reference's splits/API; XLA
+fuses the per-tile matmuls back into efficient MXU work.
+"""
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ...models.layers import dense_init
+
+
+def split_dim(total: int, splits: int):
+    """Reference: partition_uniform — sizes of each tile (last absorbs)."""
+    if splits < 1 or total < splits:
+        raise ValueError(f"cannot split {total} into {splits} tiles")
+    base = total // splits
+    sizes = [base] * splits
+    sizes[-1] += total - base * splits
+    return sizes
+
+
+class TiledLinear(nn.Module):
+    """y = x @ W + b computed as out-tiles of in-tile partial sums
+    (reference: TiledLinear with in_splits x out_splits sub-linears)."""
+    features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    logical_names: tuple = ("embed", "mlp")
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        in_sizes = split_dim(in_dim, self.in_splits)
+        out_sizes = split_dim(self.features, self.out_splits)
+        in_offs = np.cumsum([0] + in_sizes)
+        outs = []
+        for o, osz in enumerate(out_sizes):
+            acc = None
+            for i, isz in enumerate(in_sizes):
+                w = self.param(
+                    f"tile_{i}_{o}",
+                    nn.with_logical_partitioning(
+                        nn.initializers.lecun_normal(), self.logical_names),
+                    (isz, osz), self.param_dtype)
+                xi = jax.lax.slice_in_dim(x, in_offs[i], in_offs[i + 1],
+                                          axis=-1)
+                part = jnp.dot(xi, w.astype(self.dtype))
+                acc = part if acc is None else acc + part
+            outs.append(acc)
+        y = jnp.concatenate(outs, axis=-1)
+        if self.use_bias:
+            b = self.param("bias", nn.with_logical_partitioning(
+                nn.initializers.zeros, (self.logical_names[-1],)),
+                (self.features,), self.param_dtype)
+            y = y + b.astype(self.dtype)
+        return y
+
+    @staticmethod
+    def copy_params_from(dense_kernel, dense_bias, in_splits: int,
+                         out_splits: int):
+        """Dense weights -> tiled param dict (reference:
+        copy_params_from tiling.py:206)."""
+        in_dim, out_dim = np.shape(dense_kernel)
+        in_sizes = split_dim(in_dim, in_splits)
+        out_sizes = split_dim(out_dim, out_splits)
+        io = np.cumsum([0] + in_sizes)
+        oo = np.cumsum([0] + out_sizes)
+        params = {}
+        for o in range(out_splits):
+            for i in range(in_splits):
+                params[f"tile_{i}_{o}"] = jnp.asarray(
+                    dense_kernel[io[i]:io[i + 1], oo[o]:oo[o + 1]])
+        if dense_bias is not None:
+            params["bias"] = jnp.asarray(dense_bias)
+        return params

@@ -65,6 +65,21 @@ class TestLauncher:
                        "DS_NUM_PROCESSES": "2", "DS_PROCESS_ID": "1"}
 
 
+class TestSSHRunner:
+    def test_cmd_propagates_failures(self):
+        """The generated bash must join each pid (bare `wait` exits 0 and
+        masks remote failures)."""
+        import argparse
+        from deepspeed_tpu.launcher.runner import SSHRunner
+        args = argparse.Namespace(ssh_cmd="ssh", master_addr="h0",
+                                  master_port=29500, user_script="t.py",
+                                  user_args=[])
+        r = SSHRunner(args, "e30=")
+        cmd = r.get_cmd({}, {"h0": 4, "h1": 4})
+        assert cmd[0:2] == ["bash", "-c"]
+        assert 'wait "$p" || rc=1' in cmd[2] and "exit $rc" in cmd[2]
+
+
 # -- elasticity --------------------------------------------------------------
 
 class TestElasticity:
@@ -175,3 +190,81 @@ def test_get_model_profile_flax_model():
         model=model, params=params, args=(ids,),
         kwargs={"deterministic": True}, print_profile=False)
     assert flops > 0 and n_params > cfg.vocab_size * cfg.d_model
+
+
+# -- sparse tensor -----------------------------------------------------------
+
+class TestSparseTensor:
+    def test_roundtrip_and_allreduce(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        from deepspeed_tpu.comm import MeshSpec, build_mesh
+        from deepspeed_tpu.comm.mesh import set_global_mesh
+        from deepspeed_tpu.runtime.sparse_tensor import (SparseTensor,
+                                                         sparse_allreduce)
+        from deepspeed_tpu.utils.jax_compat import shard_map
+
+        dense = jnp.zeros((16, 4)).at[3].set(1.0).at[7].set(2.0)
+        st = SparseTensor.from_dense(dense, max_rows=4)
+        np.testing.assert_allclose(np.asarray(st.to_dense()),
+                                   np.asarray(dense))
+        assert st.sparse_size < dense.size
+
+        mesh = build_mesh(MeshSpec(data=2), devices=jax.devices()[:2])
+        # two participants with different hot rows; reduced = sum
+        d0 = dense
+        # DISJOINT hot rows (regression: the union must not be truncated
+        # back to one shard's nnz)
+        d1 = (jnp.zeros((16, 4)).at[1].set(5.0).at[5].set(1.0)
+              .at[9].set(2.0).at[12].set(1.0))
+        stacked = jnp.stack([d0, d1])
+
+        def local(d):
+            st = SparseTensor.from_dense(d[0], max_rows=4)
+            red = sparse_allreduce(st, "data")
+            return red.to_dense()[None]
+
+        out = shard_map(local, mesh, in_specs=P("data"),
+                        out_specs=P("data"))(stacked)
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(d0 + d1))
+        set_global_mesh(None)
+
+
+def test_engine_flops_profiler_wiring(tmp_path):
+    """flops_profiler block triggers a cost-analysis profile at
+    profile_step (reference: engine.py:1599)."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.comm import MeshSpec, build_mesh
+    from deepspeed_tpu.comm.mesh import set_global_mesh
+    from deepspeed_tpu.models import GPT, GPTConfig, gpt_loss_fn
+
+    cfg = GPTConfig(vocab_size=64, max_seq_len=16, d_model=16, n_layers=1,
+                    n_heads=2, dtype=jnp.float32)
+
+    def loss_fn(model, params, batch, rng, train):
+        logits = model.apply(params, batch["input_ids"],
+                             deterministic=not train)
+        return gpt_loss_fn(logits[:, :-1], batch["input_ids"][:, 1:])
+
+    out_file = str(tmp_path / "flops.txt")
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, size=(2, 16), dtype=np.int32)}
+    mesh = build_mesh(MeshSpec(data=2), devices=__import__("jax").devices()[:2])
+    engine, _, _, _ = ds.initialize(
+        model=GPT(cfg), config={
+            "train_batch_size": 2, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "flops_profiler": {"enabled": True, "profile_step": 2,
+                               "output_file": out_file},
+            "steps_per_print": 1000},
+        loss_fn=loss_fn, sample_batch={"input_ids": batch["input_ids"][:1]},
+        rng=__import__("jax").random.PRNGKey(0), mesh=mesh)
+    engine.train_batch(batch)
+    engine.train_batch(batch)   # profile_step
+    set_global_mesh(None)
+    assert os.path.exists(out_file)
+    text = open(out_file).read()
+    assert "flops" in text

@@ -49,6 +49,39 @@ class TestPruning:
         assert cols.all()  # each output column fully kept or fully dropped
 
 
+class TestStructuredPruning:
+    def test_channel_mask(self):
+        from deepspeed_tpu.compression.compress import channel_mask
+        w = jnp.asarray(np.random.default_rng(7).standard_normal((8, 6)),
+                        jnp.float32)
+        mask = np.asarray(channel_mask(w, 0.25))
+        rows = mask.all(axis=1) | (~mask).all(axis=1)
+        assert rows.all()          # whole input channels dropped
+        assert (~mask).all(axis=1).sum() == 2
+
+    def test_head_mask(self):
+        from deepspeed_tpu.compression.compress import head_mask
+        w = jnp.asarray(np.random.default_rng(8).standard_normal((6, 16)),
+                        jnp.float32)
+        mask = np.asarray(head_mask(w, 0.5, num_heads=4))  # head_dim 4
+        blocks = mask.reshape(6, 4, 4)
+        per_head = blocks.all(axis=(0, 2)) | (~blocks).all(axis=(0, 2))
+        assert per_head.all()      # whole heads kept or dropped
+        assert (~blocks).all(axis=(0, 2)).sum() == 2
+
+    def test_enabled_head_pruning_actually_projects(self):
+        from deepspeed_tpu.compression import init_compression
+        comp = init_compression({"head_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"hp": {
+                "params": {"dense_ratio": 0.5, "num_heads": 4},
+                "modules": ["attn"]}}}})
+        params = {"attn": {"out": jnp.asarray(
+            np.random.default_rng(9).standard_normal((8, 16)), jnp.float32)}}
+        out = comp.apply(params, step=1)
+        assert float(np.mean(np.asarray(out["attn"]["out"]) == 0)) >= 0.4
+
+
 class TestCompressor:
     CFG = {"weight_quantization": {
                "shared_parameters": {"enabled": True, "schedule_offset": 5},

@@ -181,3 +181,16 @@ def test_native_offload_engine_matches_default(tmp_path, device):
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4),
         final_params["native"], final_params["default"])
+
+
+@needs_gxx
+def test_aio_double_wait_is_safe(tmp_path):
+    """wait() on an already-consumed ticket returns instead of hanging."""
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(n_threads=1)
+    buf = np.arange(64, dtype=np.float32)
+    t = h.pwrite(str(tmp_path / "x.bin"), buf)
+    h.wait(t)
+    h.lib.ds_aio_wait(h._h, t)      # consumed: must return immediately
+    h.wait_all()                     # and the barrier stays clean
+    h.close()
