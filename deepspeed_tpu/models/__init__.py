@@ -1,3 +1,4 @@
-from .gpt import GPT, GPTConfig, GPT2_PRESETS, gpt_loss_fn
+from .gpt import (GPT, GPTConfig, GPT2_PRESETS, gpt_loss_fn,
+                  gpt_chunked_loss_fn)
 from .bert import BertEncoder, BertForPreTraining, BertConfig, BERT_PRESETS, bert_pretrain_loss
 from .layers import Block, SelfAttention, MLP, LayerNorm, set_activation_rules

@@ -1,0 +1,33 @@
+"""Kernel-injected serving of a HuggingFace model (BASELINE config #5
+shape: init_inference + generate with a preallocated KV cache).
+
+Run: python examples/serve_hf_model.py [model_name]
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax.numpy as jnp
+
+import deepspeed_tpu
+
+
+def main():
+    name = sys.argv[1] if len(sys.argv) > 1 else "gpt2"
+    from transformers import AutoModelForCausalLM, AutoTokenizer
+    tok = AutoTokenizer.from_pretrained(name)
+    hf = AutoModelForCausalLM.from_pretrained(name)
+
+    engine = deepspeed_tpu.init_inference(
+        hf, mp_size=1, dtype=jnp.bfloat16,
+        replace_with_kernel_inject=True, max_tokens=256)
+
+    prompt = "The fastest way to train a large model on TPUs is"
+    ids = np.asarray(tok(prompt, return_tensors="np")["input_ids"])
+    out = engine.generate(ids, max_new_tokens=48, temperature=0.0)
+    print(tok.decode(np.asarray(out)[0]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""GPT-2 pretraining with ZeRO-3 + bf16 (BASELINE configs #1/#2 shape).
+
+Run single-host:   python examples/train_gpt2_zero3.py
+Run on a pod:      bin/ds_tpu -H hostfile examples/train_gpt2_zero3.py
+"""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import (GPT, GPT2_PRESETS, gpt_chunked_loss_fn)
+
+SEQ = 1024
+STEPS = 20
+
+
+def synthetic_batches(vocab, global_batch, steps, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        yield {"input_ids": rng.integers(0, vocab, size=(global_batch, SEQ),
+                                         dtype=np.int32)}
+
+
+def main():
+    import dataclasses
+    mcfg = dataclasses.replace(GPT2_PRESETS["gpt2-125m"],
+                               dtype=jnp.bfloat16, remat="full")
+
+    def loss_fn(model, params, batch, rng, train):
+        ids = batch["input_ids"]
+        h, wte = model.apply(params, ids, deterministic=not train,
+                             return_hidden=True)
+        return gpt_chunked_loss_fn(h[:, :-1], wte, ids[:, 1:], chunk=128)
+
+    n_chips = len(jax.devices())
+    config = {
+        "train_batch_size": 32 * n_chips,
+        "train_micro_batch_size_per_gpu": 32,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 6e-4, "weight_decay": 0.1}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 100}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 5,
+    }
+    engine, _, _, _ = ds.initialize(
+        model=GPT(mcfg), config=config, loss_fn=loss_fn,
+        sample_batch={"input_ids": np.zeros((1, SEQ), np.int32)},
+        rng=jax.random.PRNGKey(0))
+
+    for step, batch in enumerate(synthetic_batches(
+            mcfg.vocab_size, config["train_batch_size"], STEPS)):
+        loss = engine.train_batch(batch)
+    engine.save_checkpoint("/tmp/gpt2_zero3_ckpt")
+    print(f"final loss {float(loss):.4f} after {STEPS} steps")
+
+
+if __name__ == "__main__":
+    main()

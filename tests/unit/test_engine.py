@@ -175,3 +175,35 @@ def test_checkpoint_roundtrip(tmp_path):
     p2 = jax.tree.leaves(engine2.params)
     for a, b in zip(p1, p2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_chunked_loss_matches_full():
+    """gpt_chunked_loss_fn == gpt_loss_fn on full logits (values AND
+    grads) — the bench's memory-efficient path must be exact."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import (GPT, GPTConfig, gpt_chunked_loss_fn,
+                                      gpt_loss_fn)
+
+    cfg = GPTConfig(vocab_size=96, max_seq_len=33, d_model=32, n_layers=2,
+                    n_heads=4, dtype=jnp.float32)
+    model = GPT(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 96, size=(2, 33)), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    from flax.core import meta
+    params = meta.unbox(variables)
+
+    def full(p):
+        logits = model.apply(p, ids, deterministic=True)
+        return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
+
+    def chunked(p):
+        h, wte = model.apply(p, ids, deterministic=True, return_hidden=True)
+        return gpt_chunked_loss_fn(h[:, :-1], wte, ids[:, 1:], chunk=8)
+
+    lf, gf = jax.value_and_grad(full)(params)
+    lc, gc = jax.value_and_grad(chunked)(params)
+    np.testing.assert_allclose(float(lc), float(lf), rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        a, b, rtol=1e-5, atol=1e-6), gc, gf)
