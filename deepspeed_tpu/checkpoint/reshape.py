@@ -72,6 +72,86 @@ class DeepSpeedCheckpoint:
             print(f"{name}: {shape}")
 
 
+class _ShapeOnlyMesh:
+    """Duck-typed stand-in for jax.sharding.Mesh: the sharding rules only
+    consult ``mesh.shape`` — lets offline validation run without devices."""
+
+    def __init__(self, axis_sizes: Dict[str, int]):
+        self.shape = dict(axis_sizes)
+
+
+def _validate_target_topology(src: DeepSpeedCheckpoint, params,
+                              target_mesh_spec):
+    """Check the target topology with the engine's actual sharding rules.
+
+    Uses the logical-axis names recorded at save time (engine_meta.json
+    ``param_logical_names``) and replays ``make_param_rules`` — the exact
+    function the engine applies at restore — so a dim the rules *will*
+    shard (qkv/mlp/vocab over ``model``, experts over ``expert``, the
+    stage-3 fsdp pick) is checked for divisibility, and nothing else is.
+    Reference analog: the degree-compatibility checks in
+    deepspeed/checkpoint/reshape_3d_utils.py.
+    """
+    import jax
+    from ..runtime.zero.sharding import make_param_rules, TP_RULES
+
+    mesh = _ShapeOnlyMesh({"data": getattr(target_mesh_spec, "data", 1),
+                           "fsdp": target_mesh_spec.fsdp,
+                           "model": target_mesh_spec.model,
+                           "expert": target_mesh_spec.expert})
+    names_by_key = src.meta.get("param_logical_names")
+    flat, _ = jax.tree.flatten_with_path(params)
+
+    if names_by_key is None:
+        # pre-names checkpoint: fall back to the coarse any-dim heuristic
+        logger.warning("checkpoint has no param_logical_names metadata; "
+                       "falling back to shape-only topology validation")
+        for path, v in flat:
+            shape = np.shape(v)
+            if not shape:
+                continue
+            for axis_name in ("model", "fsdp", "expert"):
+                size = mesh.shape[axis_name]
+                if size > 1 and not any(d % size == 0 for d in shape):
+                    raise ValueError(
+                        f"param {jax.tree_util.keystr(path)} shape {shape} "
+                        f"has no dim divisible by {axis_name}={size}; "
+                        "target topology cannot shard it")
+        return
+
+    rules = make_param_rules(src.zero_stage, 0)
+    for path, v in flat:
+        key = jax.tree_util.keystr(path)
+        shape = np.shape(v)
+        names = names_by_key.get(key)
+        if not shape or names is None:
+            continue
+        names = tuple(names)
+        # dims the rule table targets must divide their mesh axis — the
+        # engine silently replicates otherwise, which breaks TP/EP math
+        # expectations for weights that logically MUST be sharded
+        for i, n in enumerate(names[:len(shape)]):
+            axis = TP_RULES.get(n) if n is not None else None
+            if axis in ("model", "expert"):
+                size = mesh.shape.get(axis, 1)
+                if size > 1 and shape[i] % size != 0:
+                    raise ValueError(
+                        f"param {key} dim {i} ('{n}', {shape[i]}) is not "
+                        f"divisible by {axis}={size}; target topology "
+                        "cannot shard a weight the engine's rules require "
+                        "sharded — rejected")
+        # stage-3: warn when the fsdp pick degrades to full replication
+        if src.zero_stage == 3 and mesh.shape.get("fsdp", 1) > 1:
+            spec = rules(names, shape, mesh)
+            flat_axes = [a for ax in spec for a in
+                         (ax if isinstance(ax, (tuple, list)) else (ax,))]
+            if "fsdp" not in flat_axes and int(np.prod(shape)) > 0:
+                logger.warning(
+                    f"param {key} shape {shape} cannot shard over "
+                    f"fsdp={mesh.shape['fsdp']} under the engine's rules; "
+                    "it will be replicated on restore")
+
+
 def reshape_checkpoint(src_dir: str, dst_dir: str, target_mesh_spec=None,
                        tag: Optional[str] = None):
     """Re-write ``src_dir`` under ``dst_dir`` validated against a target
@@ -90,20 +170,7 @@ def reshape_checkpoint(src_dir: str, dst_dir: str, target_mesh_spec=None,
     params = src.load_params()
 
     if target_mesh_spec is not None:
-        sizes = {"model": target_mesh_spec.model,
-                 "fsdp": target_mesh_spec.fsdp,
-                 "expert": target_mesh_spec.expert}
-        flat, _ = jax.tree.flatten_with_path(params)
-        for path, v in flat:
-            shape = np.shape(v)
-            if not shape:
-                continue
-            for axis_name, size in sizes.items():
-                if size > 1 and not any(d % size == 0 for d in shape):
-                    raise ValueError(
-                        f"param {jax.tree_util.keystr(path)} shape {shape} "
-                        f"has no dim divisible by {axis_name}={size}; "
-                        "target topology cannot shard it")
+        _validate_target_topology(src, params, target_mesh_spec)
 
     dst = os.path.join(os.path.abspath(dst_dir), src.tag)
     os.makedirs(dst, exist_ok=True)

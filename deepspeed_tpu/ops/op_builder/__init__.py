@@ -19,7 +19,7 @@ from typing import List, Optional
 from ...utils.logging import logger
 
 _CSRC = os.path.normpath(os.path.join(os.path.dirname(__file__),
-                                      "..", "..", "..", "csrc"))
+                                      "..", "..", "csrc"))
 
 
 def _cache_dir() -> str:

@@ -55,6 +55,16 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
         "dp_world_size": engine.dp_world_size,
         "client_state": client_state or {},
     }
+    # logical axis names per param, so offline tools (checkpoint/reshape.py)
+    # can validate a target topology with the SAME sharding rules the
+    # engine applies at restore time, not a shape heuristic
+    names = getattr(engine, "_param_names", None)
+    if names is not None:
+        flat, _ = jax.tree.flatten_with_path(
+            names, is_leaf=lambda x: x is None or isinstance(x, tuple))
+        meta["param_logical_names"] = {
+            jax.tree_util.keystr(p): (list(n) if n is not None else None)
+            for p, n in flat}
     if jax.process_index() == 0:
         with open(os.path.join(path, "engine_meta.json"), "w") as f:
             json.dump(meta, f)

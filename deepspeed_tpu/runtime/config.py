@@ -335,6 +335,7 @@ class DeepSpeedConfig:
     # free-form blocks consumed by their subsystems
     sparse_attention: Optional[Dict[str, Any]] = None
     compression_training: Optional[Dict[str, Any]] = None
+    quantize_training: Optional[Dict[str, Any]] = None  # MoQ (runtime/quantize.py)
     elasticity: Optional[Dict[str, Any]] = None
     autotuning: Optional[Dict[str, Any]] = None
     data_efficiency: Optional[Dict[str, Any]] = None

@@ -51,7 +51,10 @@ class Eigenvalue:
         masks = []
         n = max(self.layer_num, 1)
         for i in range(n):
-            key = f"{self.layer_name}" + (f"_{i}" if self.layer_num else "")
+            # component-exact match via keystr's quoting ("['h_1']"), so
+            # block 1 does not also claim layers 10..19 by substring
+            key = (f"'{self.layer_name}_{i}'" if self.layer_num
+                   else self.layer_name)
             masks.append(jax.tree.unflatten(
                 treedef, [key in jax.tree_util.keystr(p) for p, _ in flat]))
         return masks

@@ -135,12 +135,49 @@ class DeepSpeedEngine:
             ProgressiveLayerDrop(theta=config.progressive_layer_drop.theta,
                                  gamma=config.progressive_layer_drop.gamma)
             if config.progressive_layer_drop.enabled else None)
+        # PLD theta reaches the model through the loss_fn: it is threaded
+        # as a traced scalar kwarg when the loss_fn declares it (reference:
+        # engine.py:1603 passes the PLD state into the module forward)
+        import inspect
+        try:
+            _sig = inspect.signature(loss_fn).parameters
+            self._loss_fn_kwargs = {
+                name for name, p in _sig.items()
+                if p.kind in (p.KEYWORD_ONLY, p.POSITIONAL_OR_KEYWORD)
+            } | ({"*"} if any(p.kind == p.VAR_KEYWORD
+                              for p in _sig.values()) else set())
+        except (TypeError, ValueError):  # builtins/partials without sigs
+            self._loss_fn_kwargs = {"*"}
+        if (self.progressive_layer_drop is not None
+                and not self._loss_accepts("layer_keep_prob")):
+            logger.warning(
+                "progressive_layer_drop is enabled but loss_fn does not "
+                "accept a 'layer_keep_prob' kwarg — theta cannot reach the "
+                "model and PLD is a no-op")
+
+        # compression-aware training + MoQ quantize-aware training, applied
+        # to the weights at the gradient-accumulation boundary (reference:
+        # compression scheduler stepped at engine.py:1885; MoQ applied
+        # inside the training step)
+        from ..compression.compress import init_compression
+        self.compression_scheduler = init_compression(config.compression_training)
+        self.moq_quantizer = None
+        qt = dict(config.quantize_training or {})
+        if qt.get("enabled", False):
+            from .config_utils import dict_to_dataclass
+            from .quantize import MoQConfig, MoQQuantizer
+            self.moq_quantizer = MoQQuantizer(
+                dict_to_dataclass(MoQConfig, qt, "quantize_training"))
+        self._next_eigenvalue_step = 0
+        self._eigenvalue = None
 
         # state for the forward/backward/step calling convention
         self._pending_grads = None
         self._accum_grads = None
         self._accum_count = 0
         self._last_loss = None
+        self._last_eval_batch = None   # one microbatch, kept for eigenvalue
+        self._last_extra = {}
 
         log_dist(
             f"DeepSpeedEngine ready: zero_stage={self.zero_stage} "
@@ -153,6 +190,9 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # setup
     # ------------------------------------------------------------------
+
+    def _loss_accepts(self, kwarg: str) -> bool:
+        return "*" in self._loss_fn_kwargs or kwarg in self._loss_fn_kwargs
 
     def _init_params(self, params, sample_batch):
         cfg = self.config
@@ -263,9 +303,15 @@ class DeepSpeedEngine:
             lambda spec: NamedSharding(self.mesh, spec), grad_specs,
             is_leaf=lambda x: isinstance(x, P)))
         opt_params = dict(self.config.optimizer.params) if self.config.optimizer else {}
+        # decay semantics must match build_optimizer exactly: 'Adam' with
+        # weight_decay>0 honors adam_w_mode (default True -> decoupled decay),
+        # so the same config trains identically with/without native offload
+        wd = opt_params.get("weight_decay", 0.0)
+        adamw = (opt_type.lower().replace("deepspeed", "").replace("_", "")
+                 == "adamw") or (wd > 0 and opt_params.get("adam_w_mode", True))
         self.native_offload = CPUAdamOffloadOptimizer(
             self.params, self.grad_shardings, self.param_shardings,
-            opt_params, adamw=(opt_type.lower() == "adamw"),
+            opt_params, adamw=adamw,
             nvme_swap_dir=(off.nvme_path if off.device == "nvme" else None),
             aio_threads=off.aio_threads)
         self.optimizer_state = ()
@@ -307,11 +353,28 @@ class DeepSpeedEngine:
         model = self.module
         loss_fn = self._loss_fn
 
-        def microbatch_loss(params, batch, rng, scale):
-            loss = loss_fn(model, params, batch, rng, True)
+        # ZeRO stage >= 2: the grad-accum scan carry is pinned to the ZeRO
+        # partition (same rule as the opt state), so full-shape fp32 grads
+        # never persist across microbatches — XLA emits the reduce-scatter
+        # the reference hand-codes in stage_1_and_2.py:895 average_tensor.
+        grad_constraint = None
+        if self.zero_stage >= 2 and self.native_offload is None:
+            opt_rule = make_opt_state_rules(self.zero_stage, self.mesh)
+            grad_specs = jax.tree.map(
+                lambda spec, s: opt_rule(spec, s.shape),
+                self.param_specs, self._param_shapes,
+                is_leaf=lambda x: isinstance(x, P))
+
+            def grad_constraint(g):
+                return jax.lax.with_sharding_constraint(g, jax.tree.map(
+                    lambda spec: NamedSharding(self.mesh, spec), grad_specs,
+                    is_leaf=lambda x: isinstance(x, P)))
+
+        def microbatch_loss(params, batch, rng, scale, extra):
+            loss = loss_fn(model, params, batch, rng, True, **extra)
             return loss * scale / gas, loss
 
-        def accumulate(params, scaler, batch, rng):
+        def accumulate(params, scaler, batch, rng, extra):
             scale = scaler.scale if fp16 else jnp.float32(1.0)
 
             def micro(carry, xs):
@@ -319,12 +382,16 @@ class DeepSpeedEngine:
                 mb = jax.tree.map(lambda x: x[i], batch)
                 mrng = jax.random.fold_in(rng, i)
                 (_, loss), grads = jax.value_and_grad(
-                    microbatch_loss, has_aux=True)(params, mb, mrng, scale)
+                    microbatch_loss, has_aux=True)(params, mb, mrng, scale, extra)
                 grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                if grad_constraint is not None:
+                    grads_acc = grad_constraint(grads_acc)
                 return (grads_acc, loss_acc + loss, i + 1), None
 
             zero_grads = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, jnp.float32), self._param_shapes)
+            if grad_constraint is not None:
+                zero_grads = grad_constraint(zero_grads)
             (grads, loss_sum, _), _ = jax.lax.scan(
                 micro, (zero_grads, jnp.float32(0.0), 0), None, length=gas)
             mean_loss = loss_sum / gas
@@ -347,8 +414,8 @@ class DeepSpeedEngine:
         optimizer = self.optimizer
         accumulate = self._make_accumulate_fn()
 
-        def train_step(params, opt_state, scaler, batch, rng):
-            grads, mean_loss, gnorm = accumulate(params, scaler, batch, rng)
+        def train_step(params, opt_state, scaler, batch, rng, extra):
+            grads, mean_loss, gnorm = accumulate(params, scaler, batch, rng, extra)
 
             def apply(operand):
                 params_, opt_state_, grads_ = operand
@@ -396,8 +463,8 @@ class DeepSpeedEngine:
         fp16 = self.fp16_enabled
         accumulate = self._make_accumulate_fn()
 
-        def grad_step(params, scaler, batch, rng):
-            grads, mean_loss, gnorm = accumulate(params, scaler, batch, rng)
+        def grad_step(params, scaler, batch, rng, extra):
+            grads, mean_loss, gnorm = accumulate(params, scaler, batch, rng, extra)
             if cfg.gradient_clipping and cfg.gradient_clipping > 0:
                 # same formula as optax.clip_by_global_norm (the default
                 # path's chained transform)
@@ -424,11 +491,11 @@ class DeepSpeedEngine:
         return jax.jit(grad_step,
                        out_shardings=(self.grad_shardings, scaler_sh, None))
 
-    def _native_offload_batch(self, batch, scaler, rng):
+    def _native_offload_batch(self, batch, scaler, rng, extra):
         if "grad_step" not in self._compiled:
             self._compiled["grad_step"] = self._make_grad_step()
         grads, new_scaler, metrics = self._compiled["grad_step"](
-            self.params, scaler, batch, rng)
+            self.params, scaler, batch, rng, extra)
         finite = bool(metrics["finite"])
         lr = float(self.lr_schedule(self.global_steps)) if callable(
             self.lr_schedule) else float(self.lr_schedule)
@@ -448,6 +515,20 @@ class DeepSpeedEngine:
         nproc = jax.process_count()
         local_rows = gas * micro_global // nproc  # this host's slice
 
+        # Curriculum learning: step the difficulty, then TRUNCATE the batch
+        # seq dim to it (reference: engine.py:1609-1615 passes
+        # curriculum_seqlen into the model forward, which truncates).
+        # Difficulties are bucketed by the scheduler so XLA sees only a few
+        # shapes, each compiled once and cached by jit.
+        if (self.curriculum_scheduler is not None
+                and self.curriculum_scheduler.config.curriculum_type == "seqlen"):
+            seqlen = self.curriculum_scheduler.update_difficulty(
+                self.global_steps + 1)
+            batch = jax.tree.map(
+                lambda x: x[:, :seqlen]
+                if (hasattr(x, "ndim") and x.ndim >= 2
+                    and x.shape[1] > seqlen) else x, batch)
+
         def to_micro(x):
             x = np.asarray(x) if nproc > 1 else jnp.asarray(x)
             if x.shape[0] != local_rows:
@@ -462,14 +543,25 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         scaler = self.loss_scale_state or init_loss_scale(1.0)
         rng = jax.random.fold_in(self.rng, self.global_steps + 1)
+        extra = {}
+        if (self.progressive_layer_drop is not None
+                and self._loss_accepts("layer_keep_prob")):
+            theta = self.progressive_layer_drop.update_state(self.global_steps)
+            extra["layer_keep_prob"] = jnp.float32(theta)  # traced: no recompile
+        self._last_extra = extra
+        if (self.moq_quantizer is not None
+                and self.moq_quantizer.config.eigenvalue_enabled
+                and self.config.eigenvalue.enabled):
+            self._last_eval_batch = jax.tree.map(lambda x: x[0], batch)
         if self.native_offload is not None:
-            new_scaler, metrics = self._native_offload_batch(batch, scaler, rng)
+            new_scaler, metrics = self._native_offload_batch(
+                batch, scaler, rng, extra)
         else:
             if "train_step" not in self._compiled:
                 self._compiled["train_step"] = self._make_train_step()
             step_fn = self._compiled["train_step"]
             self.params, self.optimizer_state, new_scaler, metrics = step_fn(
-                self.params, self.optimizer_state, scaler, batch, rng)
+                self.params, self.optimizer_state, scaler, batch, rng, extra)
         if self.fp16_enabled:
             self.loss_scale_state = new_scaler
             self.skipped_steps += int(metrics["skipped"])
@@ -477,6 +569,7 @@ class DeepSpeedEngine:
         self.global_steps += 1
         self.micro_steps += gas
         self.global_samples += cfg.train_batch_size
+        self._apply_weight_projections()
         self.tput_timer.stop(global_step=True)
         self._last_loss = metrics["loss"]
 
@@ -489,24 +582,91 @@ class DeepSpeedEngine:
         self._write_monitor(metrics)
         return metrics["loss"]
 
+    def _apply_weight_projections(self):
+        """Gas-boundary weight projections (reference: compression
+        scheduler stepped at engine.py:1885; MoQ quantize applied during
+        training): fake-quant / pruning masks / bit-annealed snap applied
+        to the freshly stepped params. Pure jitted projections — sharding
+        follows the inputs."""
+        step = self.global_steps
+        if (self.compression_scheduler is not None
+                and self.compression_scheduler.active(step)):
+            self.params = self.compression_scheduler.apply(self.params, step)
+        if self.moq_quantizer is not None:
+            if (self.moq_quantizer.config.eigenvalue_enabled
+                    and self.config.eigenvalue.enabled
+                    and step >= self._next_eigenvalue_step):
+                self._refresh_moq_eigenvalue_ratios()
+            self.params = self.moq_quantizer.quantize(self.params, step)
+
+    def _refresh_moq_eigenvalue_ratios(self):
+        """Power-iteration curvature ratios for MoQ's eigenvalue mode
+        (reference: engine computes eigenvalues at gas boundaries every
+        gas_boundary_resolution steps; here refreshed once per quantize
+        period — the only boundaries where ratios change bits). The HVP
+        power loop re-traces per refresh (params/batch change), bounded
+        to once per quantize_period."""
+        ev_cfg = self.config.eigenvalue
+        if self._eigenvalue is None:
+            from .eigenvalue import Eigenvalue
+            self._eigenvalue = Eigenvalue(
+                verbose=ev_cfg.verbose, max_iter=ev_cfg.max_iter,
+                tol=ev_cfg.tol, stability=ev_cfg.stability,
+                gas_boundary_resolution=ev_cfg.gas_boundary_resolution,
+                layer_name=ev_cfg.layer_name, layer_num=ev_cfg.layer_num)
+        if self._last_eval_batch is None:
+            return
+        from .eigenvalue import post_process_eigenvalues
+        model, loss_fn, rng = self.module, self._loss_fn, self.rng
+        mb, extra = self._last_eval_batch, dict(self._last_extra)
+        values = self._eigenvalue.compute_eigenvalue(
+            lambda p: loss_fn(model, p, mb, rng, True, **extra),
+            self.params, rng)
+        ratios = post_process_eigenvalues(values)
+        if ev_cfg.layer_num:
+            # component-exact keys ("'h_1'" not "h_1") so layer 1 cannot
+            # swallow layers 10..19 by substring
+            self.moq_quantizer.layer_ratios = {
+                f"'{ev_cfg.layer_name}_{i}'": r for i, r in enumerate(ratios)}
+        elif ratios:
+            self.moq_quantizer.layer_ratios = {"": ratios[0]}
+        period = max(self.moq_quantizer.config.quantize_period, 1)
+        self._next_eigenvalue_step = self.global_steps + period
+
     # ------------------------------------------------------------------
     # reference-style forward / backward / step calling convention
     # ------------------------------------------------------------------
 
     def forward(self, batch: Dict[str, Any]):
         """Compute loss AND cache grads for the following backward()
-        (autodiff needs the forward anyway; caching avoids recompute)."""
+        (autodiff needs the forward anyway; caching avoids recompute).
+        Applies the same curriculum truncation / PLD theta as the fused
+        train_batch path."""
         if "fwd_grads" not in self._compiled:
             model, loss_fn = self.module, self._loss_fn
 
-            def fwd(params, batch, rng):
+            def fwd(params, batch, rng, extra):
                 return jax.value_and_grad(
-                    lambda p: loss_fn(model, p, batch, rng, True))(params)
+                    lambda p: loss_fn(model, p, batch, rng, True, **extra))(params)
             self._compiled["fwd_grads"] = jax.jit(fwd)
+        if (self.curriculum_scheduler is not None
+                and self.curriculum_scheduler.config.curriculum_type == "seqlen"):
+            seqlen = self.curriculum_scheduler.update_difficulty(
+                self.global_steps + 1)
+            batch = jax.tree.map(
+                lambda x: x[:, :seqlen]
+                if (hasattr(x, "ndim") and x.ndim >= 2
+                    and x.shape[1] > seqlen) else x, batch)
+        extra = {}
+        if (self.progressive_layer_drop is not None
+                and self._loss_accepts("layer_keep_prob")):
+            theta = self.progressive_layer_drop.update_state(self.global_steps)
+            extra["layer_keep_prob"] = jnp.float32(theta)
+        self._last_extra = extra
         batch = self._place_batch(batch, with_gas_dim=False)
         rng = jax.random.fold_in(self.rng, self.micro_steps + 1)
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        loss, grads = self._compiled["fwd_grads"](self.params, batch, rng)
+        loss, grads = self._compiled["fwd_grads"](self.params, batch, rng, extra)
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._pending_grads = grads
         self._last_loss = loss
@@ -584,6 +744,7 @@ class DeepSpeedEngine:
         self._accum_grads = None
         self._accum_count = 0
         self.global_steps += 1
+        self._apply_weight_projections()
         self.timers(STEP_GLOBAL_TIMER).stop()
         if self.global_steps % self.config.steps_per_print == 0:
             log_dist(f"step={self.global_steps} lr={self.get_lr():.3e} "
@@ -658,11 +819,11 @@ class DeepSpeedEngine:
             rng = jax.random.fold_in(self.rng, self.global_steps)
             if self.native_offload is not None:
                 lowered = self._compiled["grad_step"].lower(
-                    self.params, scaler, placed_batch, rng)
+                    self.params, scaler, placed_batch, rng, self._last_extra)
             else:
                 lowered = self._compiled["train_step"].lower(
                     self.params, self.optimizer_state, scaler,
-                    placed_batch, rng)
+                    placed_batch, rng, self._last_extra)
             cost = lowered.compile().cost_analysis() or {}
             if isinstance(cost, list):
                 cost = cost[0] if cost else {}

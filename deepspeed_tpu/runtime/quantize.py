@@ -37,8 +37,9 @@ class MoQConfig:
 class MoQQuantizer:
     """Stepwise bit-annealing quantizer (reference: Quantizer.quantize).
 
-    ``bits(step)``: start_bits, halving toward target_bits with the period
-    doubling at each drop (the reference's schedule); per-layer ratios
+    ``bits(step)``: start_bits, dropping one bit toward target_bits with
+    the period doubling at each drop (the reference's
+    update_fp16_ratio schedule); per-layer ratios
     (from Eigenvalue) stretch the period of high-curvature layers:
     ``layer_ratios`` maps a param-path substring to its ratio in (0, 1]
     (post_process_eigenvalues output) — smaller ratio = longer period =
@@ -65,7 +66,7 @@ class MoQQuantizer:
         while bits > c.quantize_bits_target and t >= period:
             t -= period
             period *= 2   # each precision drop holds twice as long
-            bits = max(bits // 2, c.quantize_bits_target)
+            bits = max(bits - 1, c.quantize_bits_target)
         return bits
 
     def quantize(self, params, step: int):

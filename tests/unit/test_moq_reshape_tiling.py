@@ -40,6 +40,16 @@ class TestEigenvalue:
         got = ev.compute_eigenvalue(loss, params)
         np.testing.assert_allclose(got, [6.0, 2.0], rtol=1e-2)
 
+    def test_block_masks_no_substring_collision(self):
+        """Block 'layer_1' must NOT also claim 'layer_10' (component-exact
+        matching via keystr quoting)."""
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+        params = {f"layer_{i}": {"w": jnp.ones((2,))} for i in (0, 1, 10)}
+        ev = Eigenvalue(layer_name="layer", layer_num=2)
+        masks = ev._block_masks(params)
+        assert masks[1]["layer_1"]["w"] is True
+        assert masks[1]["layer_10"]["w"] is False
+
     def test_post_process_ratios(self):
         """Largest curvature -> smallest ratio -> slowest quantization."""
         from deepspeed_tpu.runtime.eigenvalue import post_process_eigenvalues
@@ -52,9 +62,12 @@ class TestMoQ:
         q = MoQQuantizer(MoQConfig(enabled=True, quantize_bits_start=16,
                                    quantize_bits_target=4,
                                    quantize_period=10))
-        bits = [q.bits_at(s) for s in range(0, 200, 5)]
+        bits = [q.bits_at(s) for s in range(0, 60000, 50)]
         assert bits[0] == 16 and min(bits) == 4
         assert all(b1 >= b2 for b1, b2 in zip(bits, bits[1:]))
+        # one bit per period (reference: update_fp16_ratio start_bits -= 1),
+        # period doubling at each drop: first drop at 10, second at 30
+        assert q.bits_at(10) == 15 and q.bits_at(30) == 14
 
     def test_eigenvalue_ratio_slows_quantization(self):
         from deepspeed_tpu.runtime.quantize import MoQConfig, MoQQuantizer

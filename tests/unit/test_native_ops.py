@@ -121,8 +121,17 @@ def test_tensor_swapper(tmp_path):
 
 
 @needs_gxx
-@pytest.mark.parametrize("device", ["cpu", "nvme"])
-def test_native_offload_engine_matches_default(tmp_path, device):
+@pytest.mark.parametrize("device,optimizer", [
+    ("cpu", {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.01}}),
+    ("nvme", {"type": "AdamW", "params": {"lr": 1e-3, "weight_decay": 0.01}}),
+    # 'Adam' + weight_decay follows adam_w_mode (default True -> decoupled
+    # decay): native offload must derive the same semantics as
+    # build_optimizer, not assume classic L2 (ADVICE r1 finding)
+    ("cpu", {"type": "Adam", "params": {"lr": 1e-3, "weight_decay": 0.01}}),
+    ("cpu", {"type": "Adam", "params": {"lr": 1e-3, "weight_decay": 0.01,
+                                        "adam_w_mode": False}}),
+])
+def test_native_offload_engine_matches_default(tmp_path, device, optimizer):
     """ZeRO-Offload via cpu_adam reproduces the in-XLA Adam trajectory
     (reference: test_zero.py correctness-vs-baseline pattern)."""
     import jax
@@ -142,8 +151,8 @@ def test_native_offload_engine_matches_default(tmp_path, device):
 
     base_config = {
         "train_batch_size": 4, "train_micro_batch_size_per_gpu": 2,
-        "optimizer": {"type": "AdamW",
-                      "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "optimizer": {"type": optimizer["type"],
+                      "params": dict(optimizer["params"])},
         "zero_optimization": {"stage": 1},
         "steps_per_print": 1000,
     }
