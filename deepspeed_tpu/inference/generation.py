@@ -109,7 +109,11 @@ def generate(module, params, input_ids, *, max_new_tokens: int = 32,
             f"max_seq_len {model_max}")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
-    cache = init_cache(module, params, b, total)
+    # round the CACHE allocation up to a multiple of 128 so the Pallas
+    # decode kernel's 128-aligned tiling always applies (slots past
+    # `total` are never valid — the in-kernel length mask covers them)
+    cache_len = (total + 127) // 128 * 128
+    cache = init_cache(module, params, b, cache_len)
     logits, cache = _prefill(module, params, cache, input_ids,
                              jnp.arange(prompt_len))
     first = _sample(logits[:, -1, :], rng, temperature, top_k, top_p)

@@ -126,39 +126,73 @@ class SelfAttention(nn.Module):
                                         positions=positions)
 
         causal = self.causal
+        decode_out = None
         if decode:
+            # Cache lives TRANSPOSED ([b, heads, d, max_len], "K^T
+            # layout") so the Pallas decode kernel streams 128-aligned
+            # (d, block_k) tiles for any head_dim and q.K^T is a direct
+            # MXU matmul (see ops/pallas/decode_attention.py).
+            kc = k.transpose(0, 2, 3, 1)                 # [b, h, d, s]
+            vc = v.transpose(0, 2, 3, 1)
             cached_key = self.variable("cache", "cached_key", jnp.zeros,
-                                       k.shape, k.dtype)
+                                       kc.shape, kc.dtype)
             cached_value = self.variable("cache", "cached_value", jnp.zeros,
-                                         v.shape, v.dtype)
+                                         vc.shape, vc.dtype)
             cache_index = self.variable("cache", "cache_index",
                                         lambda: jnp.zeros((), jnp.int32))
             if self.is_initializing():
                 max_len = s
             else:
-                max_len = cached_key.value.shape[1]
+                max_len = cached_key.value.shape[3]
                 idx = cache_index.value
-                k = jax.lax.dynamic_update_slice(cached_key.value, k,
-                                                 (0, idx, 0, 0))
-                v = jax.lax.dynamic_update_slice(cached_value.value, v,
-                                                 (0, idx, 0, 0))
-                cached_key.value = k
-                cached_value.value = v
+                k_all = jax.lax.dynamic_update_slice(cached_key.value, kc,
+                                                     (0, 0, 0, idx))
+                v_all = jax.lax.dynamic_update_slice(cached_value.value, vc,
+                                                     (0, 0, 0, idx))
+                cached_key.value = k_all
+                cached_value.value = v_all
                 cache_index.value = idx + s
-                # validity+causality in one mask: query row i (global pos
-                # idx+i) may attend to cache slots j <= idx+i.
-                rows = idx + jnp.arange(s)[:, None]
-                cols = jnp.arange(max_len)[None, :]
-                cache_mask = (cols <= rows)[None, None, :, :]
-                if mask is not None and mask.shape[-1] != max_len:
-                    # caller's mask covers only the current chunk: scatter it
-                    # into cache key space at the write offset.
-                    full = jnp.ones(mask.shape[:-1] + (max_len,), bool)
-                    mask = jax.lax.dynamic_update_slice(
-                        full, mask.astype(bool), (0,) * (mask.ndim - 1) + (idx,))
-                mask = cache_mask if mask is None else jnp.logical_and(
-                    mask, cache_mask)
-                causal = False
+                if s == 1 and mask is None and (
+                        self.dropout_rate == 0.0 or deterministic):
+                    # THE serving hot path (reference: softmax_context,
+                    # pt_binding.cpp:1197-1244): single-token KV-cache
+                    # attention with the length mask — and ALiBi — handled
+                    # in-kernel. No [b,h,1,S] mask tensor, no bias tensor.
+                    from ..ops.pallas import decode_attention
+                    slopes = (alibi_slopes(self.n_heads)
+                              if self.alibi else None)
+                    decode_out = decode_attention(q, k_all, v_all, idx + 1,
+                                                  alibi_slopes=slopes)
+                else:
+                    # prefill / externally-masked chunks: dense path over
+                    # the cache with an explicit validity+causality mask
+                    # (query row i = global pos idx+i attends slots <= it)
+                    k = k_all.transpose(0, 3, 1, 2)      # [b, s, h, d]
+                    v = v_all.transpose(0, 3, 1, 2)
+                    rows = idx + jnp.arange(s)[:, None]
+                    cols = jnp.arange(max_len)[None, :]
+                    cache_mask = (cols <= rows)[None, None, :, :]
+                    if mask is not None and mask.shape[-1] != max_len:
+                        # caller's mask covers only the current chunk:
+                        # scatter it into cache key space at the offset.
+                        full = jnp.ones(mask.shape[:-1] + (max_len,), bool)
+                        mask = jax.lax.dynamic_update_slice(
+                            full, mask.astype(bool),
+                            (0,) * (mask.ndim - 1) + (idx,))
+                    mask = cache_mask if mask is None else jnp.logical_and(
+                        mask, cache_mask)
+                    causal = False
+
+        if decode_out is not None:
+            out = decode_out.reshape(b, s, self.d_model)
+            out = activation_constraint(out, ("batch", "seq", "embed"))
+            return nn.DenseGeneral(
+                features=self.d_model, use_bias=self.use_bias,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                kernel_init=dense_init(("qkv", "embed")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros, ("embed",)),
+                name="out")(out)
 
         if self.alibi:
             # computed HERE (not in the model) because only the attention op

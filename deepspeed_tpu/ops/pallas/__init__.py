@@ -3,6 +3,7 @@
 | reference (csrc/)                       | here                     |
 |-----------------------------------------|--------------------------|
 | transformer attention + softmax kernels | flash_attention          |
+| inference softmax_context (KV cache)    | decode_attention         |
 | adam/multi_tensor_adam.cu               | fused_adam.fused_adamw   |
 | transformer/normalize_kernels.cu        | layernorm.fused_layer_norm |
 | quantization/quantizer.cu               | quantizer.quantize/dequantize |
@@ -11,6 +12,7 @@ Kernels run in interpreter mode automatically off-TPU so the whole suite
 tests on the CPU mesh.
 """
 
+from .decode_attention import decode_attention
 from .flash_attention import flash_attention
 from .fused_adam import fused_adamw, FusedAdamState
 from .layernorm import fused_layer_norm

@@ -1,0 +1,237 @@
+"""Pallas decode-attention kernel (KV-cache single-token attention).
+
+TPU-native replacement for THE inference kernel of DS-Inference:
+``softmax_context`` (reference: csrc/transformer/inference/csrc/
+pt_binding.cpp:1197-1244 + softmax.cu) — one query token per (batch,
+head) attends to the valid prefix of a preallocated KV cache.
+
+Design (shaped by Mosaic's constraint that dynamically-indexed slices
+need a 128-aligned minor dim):
+
+- **transposed caches**: K/V live as [batch, heads, head_dim, max_len]
+  ("K^T layout") so the minor dim is the sequence — any head_dim (64 of
+  GPT-2 or 128 of BLOOM/LLaMA class) tiles cleanly, q·K is a direct
+  [1,d]x[d,Bk] MXU matmul, and HBM block slices are 128-aligned.
+- **manual-DMA kernel**: grid (batch, head_blocks); the kernel streams
+  K/V blocks HBM->VMEM with double-buffered ``make_async_copy`` inside a
+  ``fori_loop`` whose trip count is ``ceil(length / block_k)`` — DMA
+  traffic AND compute scale with the *valid* cache length, not the
+  allocated max_len (the reference kernel reads only ``total_count``
+  history the same way). Two statically-addressed buffer pairs switched
+  by ``pl.when`` on loop parity (Mosaic cannot dynamically index a
+  buffer stack with a sub-128 lane dim). Measured on v5e at
+  B4/H32/S2048/D128: ~par with the dense XLA path at full cache,
+  ~2.5x faster at half length.
+- the causal/length mask lives IN the kernel (``col < length`` from a
+  scalar-prefetched per-batch length vector) — no [B,H,1,S] mask tensor
+  is ever materialized (the dense fallback builds one per decode step).
+- ALiBi (BLOOM serving) computed in-kernel from per-head slopes:
+  ``slope * (col - (length-1))``, matching models/layers.py alibi_bias.
+- caches whose max_len is not a multiple of 128 take a fused-dense jnp
+  fallback (kernel semantics, XLA codegen) — the generation path rounds
+  its cache allocation up to 128 so serving always hits the kernel.
+
+Inference-only: no custom_vjp (the reference kernel is fwd-only too).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import interpret_mode as _interpret
+
+DEFAULT_BLOCK_K = 512
+DEFAULT_HEAD_BLOCK = 8
+NEG_INF = -1e30
+
+
+def _attend_block(q, kbuf, vbuf, start, length, slopes, m_ref, l_ref,
+                  acc_ref, *, hb, alibi):
+    """One online-softmax update for an [hb, d, Bk] K^T/V^T block.
+
+    q is pre-scaled [hb, d] fp32. Per-head scores are hb small matmuls
+    (MHA has distinct K per head, so there is no single big matmul);
+    the softmax/statistics update is vectorized across the head block.
+    """
+    rows = []
+    for h in range(hb):
+        kh = kbuf[h].astype(jnp.float32)                     # [d, Bk]
+        rows.append(jnp.dot(q[h:h + 1], kh,
+                            preferred_element_type=jnp.float32))  # [1, Bk]
+    s = jnp.concatenate(rows, axis=0)                        # [hb, Bk]
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + start
+    if alibi:
+        s = s + slopes * (col - (length - 1)).astype(jnp.float32)
+    valid = col < length
+    s = jnp.where(valid, s, NEG_INF)
+
+    m_prev = m_ref[...]                                      # [hb, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                                   # [hb, Bk]
+    outs = []
+    for h in range(hb):
+        # columns past the valid prefix may hold padding garbage —
+        # 0-probability x NaN = NaN, so zero the V columns explicitly
+        vh = jnp.where(valid[h:h + 1], vbuf[h].astype(jnp.float32), 0.0)
+        outs.append(jax.lax.dot_general(
+            p[h:h + 1], vh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32))             # [1, d]
+    pv = jnp.concatenate(outs, axis=0)                       # [hb, d]
+    l_ref[...] = corr * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = corr * acc_ref[...] + pv
+    m_ref[...] = m_new
+
+
+def _read_slopes(slopes_ref, h0, hb):
+    return jnp.stack([slopes_ref[h0 + h] for h in range(hb)]).reshape(hb, 1)
+
+
+def _dma_kernel(len_ref, slopes_ref, q_ref, k_hbm, v_hbm, o_ref,
+                kbuf0, vbuf0, kbuf1, vbuf1, sem, m_ref, l_ref, acc_ref,
+                *, scale, block_k, hb, alibi):
+    b, hi = pl.program_id(0), pl.program_id(1)
+    length = len_ref[b]
+    nb = pl.cdiv(length, block_k)
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    slopes = _read_slopes(slopes_ref, hi * hb, hb) if alibi else None
+    bufs = ((kbuf0, vbuf0), (kbuf1, vbuf1))
+
+    def copies(j, slot):
+        start = j * block_k
+        kb, vb = bufs[slot]
+        ck = pltpu.make_async_copy(
+            k_hbm.at[b, hi, :, :, pl.ds(start, block_k)], kb, sem.at[slot, 0])
+        cv = pltpu.make_async_copy(
+            v_hbm.at[b, hi, :, :, pl.ds(start, block_k)], vb, sem.at[slot, 1])
+        return ck, cv
+
+    ck, cv = copies(0, 0)
+    ck.start()
+    cv.start()
+
+    def body(j, carry):
+        slot = jax.lax.rem(j, 2)
+
+        for parity in (0, 1):
+            @pl.when((slot == parity) & (j + 1 < nb))
+            def _prefetch():
+                nk, nv = copies(j + 1, 1 - parity)
+                nk.start()
+                nv.start()
+
+        for parity in (0, 1):
+            @pl.when(slot == parity)
+            def _compute():
+                wk, wv = copies(j, parity)
+                wk.wait()
+                wv.wait()
+                q = q_ref[0].astype(jnp.float32) * scale
+                kb, vb = bufs[parity]
+                _attend_block(q, kb, vb, j * block_k, length, slopes,
+                              m_ref, l_ref, acc_ref, hb=hb, alibi=alibi)
+        return carry
+
+    jax.lax.fori_loop(0, nb, body, 0)
+    o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def _decode_dma(q_bhd, k, v, lengths, slopes, *, scale, block_k, hb, alibi):
+    b, heads, d = q_bhd.shape
+    s = k.shape[3]
+    kr = k.reshape(b, heads // hb, hb, d, s)
+    vr = v.reshape(b, heads // hb, hb, d, s)
+    kv_buf = lambda: pltpu.VMEM((hb, d, block_k), k.dtype)
+    return pl.pallas_call(
+        functools.partial(_dma_kernel, scale=scale, block_k=block_k,
+                          hb=hb, alibi=alibi),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, heads // hb),
+            in_specs=[
+                pl.BlockSpec((1, hb, d), lambda bi, hi, *_: (bi, hi, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, hb, d), lambda bi, hi, *_: (bi, hi, 0)),
+            scratch_shapes=[
+                kv_buf(), kv_buf(), kv_buf(), kv_buf(),
+                pltpu.SemaphoreType.DMA((2, 2)),
+                pltpu.VMEM((hb, 1), jnp.float32),
+                pltpu.VMEM((hb, 1), jnp.float32),
+                pltpu.VMEM((hb, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, heads, d), q_bhd.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=_interpret(),
+    )(lengths, slopes, q_bhd, kr, vr)
+
+
+def _decode_dense(q_bhd, k, v, lengths, slopes, *, scale, alibi):
+    """jnp fallback with IDENTICAL semantics for caches the kernel cannot
+    tile (max_len not a multiple of 128). XLA fuses the chain; the mask
+    still never leaves registers as a [B,H,1,S] tensor thanks to fusion."""
+    s = k.shape[3]
+    logits = jnp.einsum("bhd,bhdk->bhk", q_bhd.astype(jnp.float32) * scale,
+                        k.astype(jnp.float32))
+    col = jnp.arange(s)[None, None, :]
+    ln = lengths[:, None, None]
+    if alibi:
+        logits = logits + slopes[None, :, None] * (col - (ln - 1))
+    logits = jnp.where(col < ln, logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhk,bhdk->bhd", p, v.astype(jnp.float32))
+    return out.astype(q_bhd.dtype)
+
+
+def decode_attention(q, k, v, length, *, softmax_scale=None,
+                     alibi_slopes=None, block_k=DEFAULT_BLOCK_K,
+                     head_block=DEFAULT_HEAD_BLOCK):
+    """Single-token KV-cache attention over transposed caches.
+
+    q: [B, 1, H, d] (or [B, H, d]) — the current token's queries (BSHD).
+    k, v: [B, H, d, S] — the preallocated cache in K^T layout.
+    length: int32 scalar or [B] — number of valid cache slots per row
+        (the query sits at position length-1).
+    alibi_slopes: optional [H] per-head ALiBi slopes (BLOOM).
+
+    Returns [B, 1, H, d] (or [B, H, d], matching q's rank).
+    """
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    b, one, heads, d = q.shape
+    if one != 1:
+        raise ValueError(f"decode_attention is single-token (q_len 1), got {one}")
+    s = k.shape[3]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
+    hb = math.gcd(heads, head_block)
+
+    lengths = jnp.broadcast_to(jnp.asarray(length, jnp.int32), (b,))
+    alibi = alibi_slopes is not None
+    slopes = (jnp.asarray(alibi_slopes, jnp.float32) if alibi
+              else jnp.zeros((heads,), jnp.float32))
+    q_bhd = jnp.swapaxes(q, 1, 2)[:, :, 0, :]                # [B, H, d]
+
+    # block size: a 128-multiple divisor of max_len (Mosaic minor-dim
+    # alignment); otherwise the dense fallback
+    bk = min(block_k, s)
+    bk = (bk // 128) * 128
+    while bk >= 128 and s % bk != 0:
+        bk -= 128
+    if bk >= 128:
+        out = _decode_dma(q_bhd, k, v, lengths, slopes, scale=scale,
+                          block_k=bk, hb=hb, alibi=alibi)
+    else:
+        out = _decode_dense(q_bhd, k, v, lengths, slopes, scale=scale,
+                            alibi=alibi)
+    out = out[:, None]                                       # [B, 1, H, d]
+    return out[:, 0].reshape(b, heads, d) if squeeze else out
