@@ -97,6 +97,12 @@ def get_global_mesh():
     return _GLOBAL_MESH
 
 
+def peek_global_mesh():
+    """Current global mesh or None — no lazy construction (for callers
+    that must not invent a mesh, e.g. activation constraints)."""
+    return _GLOBAL_MESH
+
+
 def axis_size(axis, mesh=None) -> int:
     """Size of a mesh axis (or product over a tuple of axes)."""
     mesh = mesh or get_global_mesh()

@@ -22,12 +22,17 @@ import flax.linen as nn
 from .layers import Block, LayerNorm, activation_constraint
 
 # jax.checkpoint policies keyed by config string (reference analog: the
-# activation_checkpointing config block).
+# activation_checkpointing config block,
+# runtime/activation_checkpointing/config.py:27-43). "offload" is the
+# cpu_checkpointing analog: saveable dot outputs are staged to pinned host
+# memory instead of HBM (reference: checkpointing.py CPU checkpointing).
 REMAT_POLICIES = {
     "none": None,
     "full": jax.checkpoint_policies.nothing_saveable,
     "dots": jax.checkpoint_policies.checkpoint_dots,
     "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "offload": jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+        "device", "pinned_host"),
 }
 
 

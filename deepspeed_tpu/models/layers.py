@@ -33,16 +33,43 @@ def set_activation_rules(rules: dict):
 
 
 def activation_constraint(x, logical_names):
-    """Apply with_sharding_constraint if the engine installed rules."""
+    """Apply with_sharding_constraint if the engine installed rules.
+
+    Builds a concrete NamedSharding against the global mesh — a bare
+    PartitionSpec needs an ambient ``use_mesh`` context and silently
+    fails under plain ``jit``."""
     if not _ACTIVATION_RULES:
         return x
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import PartitionSpec as P, NamedSharding
     axes = tuple(_ACTIVATION_RULES.get(n) for n in logical_names)
     if all(a is None for a in axes):
         return x
     try:
-        return jax.lax.with_sharding_constraint(x, P(*axes))
-    except Exception:
+        # inside shard_map (Manual axes) the global-mesh NamedSharding is
+        # from a different (Auto) mesh view and would poison downstream ops
+        from jax.sharding import get_abstract_mesh
+        am = get_abstract_mesh()
+        if not am.empty and any("Manual" in str(t) for t in am.axis_types):
+            return x
+        from ..comm.mesh import peek_global_mesh
+        mesh = peek_global_mesh()
+        if mesh is None:
+            return x
+        # drop constraints the array can't honor (dim not divisible by the
+        # axis degree — e.g. batch 1 on an 8-way dp axis in eval paths)
+        def ok(dim, a):
+            if a is None:
+                return None
+            from ..comm.mesh import axis_size
+            return a if dim % axis_size(a, mesh) == 0 else None
+        axes = tuple(ok(d, a) for d, a in zip(x.shape, axes))
+        if all(a is None for a in axes):
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*axes)))
+    except Exception as e:  # never break an un-meshed model run
+        from ..utils.logging import warn_once
+        warn_once(f"activation sharding constraint skipped: {e}")
         return x
 
 

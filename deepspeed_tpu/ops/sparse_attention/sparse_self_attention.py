@@ -1,13 +1,15 @@
 """Block-sparse self-attention.
 
 Reference: ops/sparse_attention/sparse_self_attention.py (Triton SDD/DSD
-matmul + sparse softmax kernels, matmul.py/softmax.py). TPU path: the
-block layout lowers to a [heads, S, S] boolean mask consumed by the
-fused attention op — XLA's masked softmax fusion skips no FLOPs but is
-numerically identical; for long sequences the real win comes from
-combining a sparse layout with sequence parallelism (the layouts here
-compose with both). A Pallas kernel that skips zero blocks entirely
-(splash-attention style) can swap in behind this same interface.
+matmul + sparse softmax kernels, matmul.py/softmax.py). Two execution
+paths behind one interface:
+
+- the Pallas block-sparse kernel (block_sparse_kernel.py) — work scales
+  with the number of active layout blocks, like the reference's Triton
+  kernels; used whenever the layout tiles at 128 granularity.
+- a dense-mask fallback (the layout expanded to [1, heads, S, S] bool and
+  fed to the fused attention op) for shapes/extra-mask combinations the
+  kernel doesn't cover; numerically identical, no FLOP savings.
 """
 
 
@@ -47,10 +49,35 @@ def layout_to_dense_mask(config: SparsityConfig, seq_len: int):
 
 def sparse_attention(q, k, v, sparsity_config: SparsityConfig, *,
                      softmax_scale=None, key_padding_mask=None,
-                     attn_mask=None):
+                     attn_mask=None, backend: Optional[str] = None):
     """q/k/v [batch, seq, heads, head_dim]; pattern from the config
-    (reference: SparseSelfAttention.forward)."""
+    (reference: SparseSelfAttention.forward).
+
+    backend: None = auto (Pallas kernel when the layout tiles and no
+    extra masks are given), "pallas" = require the kernel, "dense" =
+    force the dense-mask path."""
+    if backend not in (None, "dense", "pallas"):
+        raise ValueError(f"sparse_attention backend must be None, 'dense' "
+                         f"or 'pallas', got {backend!r}")
     s = q.shape[1]
+    if backend != "dense":
+        extra_masks = key_padding_mask is not None or attn_mask is not None
+        if backend == "pallas" and extra_masks:
+            raise ValueError(
+                "sparse_attention backend='pallas' does not support "
+                "key_padding_mask/attn_mask — drop them or use the dense "
+                "path")
+        if not extra_masks:
+            from .block_sparse_kernel import block_sparse_attention
+            out = block_sparse_attention(q, k, v, sparsity_config,
+                                         softmax_scale=softmax_scale)
+            if out is not None:
+                return out
+            if backend == "pallas":
+                raise ValueError(
+                    "sparse_attention backend='pallas' but the layout cannot "
+                    "be tiled at 128 granularity (need seq % 128 == 0 and "
+                    "block dividing 128, and no all-zero rows)")
     mask = layout_to_dense_mask(sparsity_config, s)
     if key_padding_mask is not None:
         # [batch, S] True=keep -> broadcast over heads and query pos

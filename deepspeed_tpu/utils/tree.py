@@ -54,3 +54,15 @@ def _flatten_one_level(node):
     # except the root itself.
     treedef = jtu.tree_structure(node, is_leaf=lambda x: x is not node)
     return children, treedef
+
+
+def clip_grads_by_global_norm(grads, gnorm, clip):
+    """Scale a grad tree so its global norm is at most ``clip`` — the one
+    shared implementation for every non-optax step path (streamed host
+    offload, native-offload grad step); formula matches
+    optax.clip_by_global_norm (the default path's chained transform)."""
+    import jax.numpy as jnp
+    if not clip or clip <= 0:
+        return grads
+    factor = jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-12))
+    return jax.tree.map(lambda g: g * factor, grads)
