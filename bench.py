@@ -112,9 +112,11 @@ def bench_125m(np, jax, jnp, ds, models):
 
 
 def bench_decode(np, jax, jnp, models, preset="gpt2-2.7b", prompt=128,
-                 tokens=64):
+                 tokens=64, int8=False):
     """Serving p50: largest GPT-class config fitting one chip in bf16,
-    Pallas decode-attention kernel, preallocated KV cache."""
+    Pallas decode-attention kernel, preallocated KV cache. ``int8=True``
+    stores weights int8 (per-channel scales) — the weight-only quantized
+    serving path (reference: *_int8 gemms)."""
     import dataclasses
     from deepspeed_tpu.inference.generation import (init_cache, _prefill,
                                                     _decode_loop)
@@ -128,6 +130,14 @@ def bench_decode(np, jax, jnp, models, preset="gpt2-2.7b", prompt=128,
     params = jax.jit(
         lambda r: flax_meta.unbox(model.init(r, ids))["params"])(
             jax.random.PRNGKey(0))
+    transform = None
+    if int8:
+        from deepspeed_tpu.module_inject.module_quantize import (
+            quantize_param_tree, dequantize_param_tree)
+        params = jax.jit(quantize_param_tree)(params)
+
+        def transform(p):
+            return dequantize_param_tree(p, dtype=jnp.bfloat16)
 
     cache_len = 1024
     cache = init_cache(model, params, 1, cache_len)
@@ -135,7 +145,7 @@ def bench_decode(np, jax, jnp, models, preset="gpt2-2.7b", prompt=128,
     prompt_ids = jnp.asarray(rng.integers(0, mcfg.vocab_size,
                                           size=(1, prompt)), jnp.int32)
     logits, cache = _prefill(model, params, cache, prompt_ids,
-                             jnp.arange(prompt))
+                             jnp.arange(prompt), transform)
     last = jnp.argmax(logits[:, -1, :], axis=-1)
 
     # single-token decode latency (the DS-Inference p50 metric): one
@@ -143,7 +153,7 @@ def bench_decode(np, jax, jnp, models, preset="gpt2-2.7b", prompt=128,
     def one(cache, last, pos):
         toks, cache = _decode_loop(model, params, cache, last,
                                    pos, 1, 0.0, None, None,
-                                   jax.random.PRNGKey(1))
+                                   jax.random.PRNGKey(1), transform)
         return toks[:, -1], cache
     pos = jnp.int32(prompt)
     last_t, cache = one(cache, last, pos)   # compile
@@ -163,15 +173,16 @@ def bench_decode(np, jax, jnp, models, preset="gpt2-2.7b", prompt=128,
     # the timed window excludes its compile.
     _toks, cache = _decode_loop(model, params, cache, last_t,
                                 pos + tokens + 1, 64, 0.0, None, None,
-                                jax.random.PRNGKey(2))
+                                jax.random.PRNGKey(2), transform)
     _ = np.asarray(_toks[0, -1])
     t0 = time.time()
     toks, cache = _decode_loop(model, params, cache, last_t,
                                pos + tokens + 1, 64, 0.0, None, None,
-                               jax.random.PRNGKey(2))
+                               jax.random.PRNGKey(2), transform)
     _ = np.asarray(toks[0, -1])
     amort = (time.time() - t0) * 1e3 / 64
-    return {"model": preset, "p50_ms_per_token": round(p50, 2),
+    return {"model": preset + ("-int8" if int8 else ""),
+            "p50_ms_per_token": round(p50, 2),
             "p90_ms_per_token": round(p90, 2),
             "amortized_ms_per_token": round(amort, 2),
             "tokens_per_sec_batch1": round(1e3 / amort, 1)}
@@ -246,6 +257,7 @@ def main():
     run("gpt2_1p3b_zero_offload", bench_1p3b, np, jax, jnp, ds, models)
     run("gpt2_125m_zero1", bench_125m, np, jax, jnp, ds, models)
     run("decode", bench_decode, np, jax, jnp, models)
+    run("decode_int8", bench_decode, np, jax, jnp, models, int8=True)
     run("sparse_attention_4k", bench_sparse_kernel, np, jax, jnp)
 
     north = extra.get("gpt2_1p3b_zero_offload", {})

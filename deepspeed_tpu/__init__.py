@@ -60,10 +60,25 @@ def initialize(args=None,
         pass
 
     if pipeline:
-        from .runtime.pipe.engine import PipelineEngine
-        engine = PipelineEngine(model, cfg, loss_fn=loss_fn,
-                                sample_batch=sample_batch, rng=rng, mesh=mesh,
-                                optimizer=optimizer, lr_scheduler=lr_scheduler)
+        if getattr(model, "heterogeneous", False):
+            # heterogeneous LayerSpec stacks execute the 1F1B instruction
+            # stream host-side (reference: _exec_schedule, pipe/engine.py:1354)
+            if mesh is not None:
+                from .utils.logging import logger as _logger
+                _logger.warning(
+                    "heterogeneous PipelineModule runs on the host-driven "
+                    "executor, which is single-client: the provided mesh is "
+                    "ignored (batch arithmetic uses world size 1)")
+            from .runtime.pipe.host_engine import HostDrivenPipelineEngine
+            engine = HostDrivenPipelineEngine(
+                model, cfg, loss_fn=loss_fn, sample_batch=sample_batch,
+                rng=rng, optimizer=optimizer, lr_scheduler=lr_scheduler)
+        else:
+            from .runtime.pipe.engine import PipelineEngine
+            engine = PipelineEngine(model, cfg, loss_fn=loss_fn,
+                                    sample_batch=sample_batch, rng=rng,
+                                    mesh=mesh, optimizer=optimizer,
+                                    lr_scheduler=lr_scheduler)
     else:
         engine = DeepSpeedEngine(model, cfg, loss_fn=loss_fn,
                                  params=model_parameters,

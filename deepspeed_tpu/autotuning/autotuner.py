@@ -49,12 +49,16 @@ class Autotuner:
 
     def __init__(self, make_engine: Callable[[Dict], Any],
                  make_batch: Callable[[Dict], Any],
-                 warmup_steps: int = 1, measure_steps: int = 3):
+                 warmup_steps: int = 1, measure_steps: int = 3,
+                 results_dir: Optional[str] = None):
         self.make_engine = make_engine
         self.make_batch = make_batch
         self.warmup_steps = warmup_steps
         self.measure_steps = measure_steps
         self.results: List[TuneResult] = []
+        # reference: per-experiment exp.json files + autotuning_results/
+        # best config written by the ResourceManager; None = in-memory only
+        self.results_dir = results_dir
 
     # -- space construction (reference: the template_zeroN.json spaces) --
     @staticmethod
@@ -105,6 +109,7 @@ class Autotuner:
         for cfg in order:
             res = self.measure(cfg)
             self.results.append(res)
+            self._persist_result(len(self.results) - 1, res)
             if res.feasible and (best is None
                                  or res.samples_per_sec > best.samples_per_sec):
                 best, since_best = res, 0
@@ -117,9 +122,33 @@ class Autotuner:
         if best is None:
             raise RuntimeError("no feasible autotuning candidate "
                                f"(tried {len(self.results)})")
+        self._persist_best(best)
         z = best.config.get("zero_optimization", {}).get("stage")
         logger.info(
             f"autotune best: stage={z} "
             f"micro_batch={best.config['train_micro_batch_size_per_gpu']} "
             f"-> {best.samples_per_sec:.1f} samples/s ({best.step_ms:.1f} ms)")
         return best
+
+    # -- persistence (reference: autotuning exps/*.json + the
+    # autotuning_results best-config file read back by the CLI) ---------
+    def _persist_result(self, idx: int, res: TuneResult):
+        if self.results_dir is None:
+            return
+        import json
+        import os
+        exp_dir = os.path.join(self.results_dir, "exps")
+        os.makedirs(exp_dir, exist_ok=True)
+        with open(os.path.join(exp_dir, f"exp_{idx:04d}.json"), "w") as f:
+            json.dump(dataclasses.asdict(res), f, indent=2, default=str)
+
+    def _persist_best(self, best: TuneResult):
+        if self.results_dir is None:
+            return
+        import json
+        import os
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(os.path.join(self.results_dir, "best_config.json"), "w") as f:
+            json.dump({"config": best.config,
+                       "samples_per_sec": best.samples_per_sec,
+                       "step_ms": best.step_ms}, f, indent=2, default=str)

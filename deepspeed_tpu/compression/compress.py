@@ -194,3 +194,71 @@ def redundancy_clean(params, config: Optional[dict]):
     if comp is None:
         return params
     return comp.apply(params, step=1 << 30)
+
+
+def apply_layer_reduction(params, config: Dict[str, Any]):
+    """Layer reduction / distillation init (reference: compress.py:182
+    student_initialization — the student keeps ``keep_number_layers``
+    layers, each initialized from a chosen teacher layer).
+
+    ``config``: the ``layer_reduction`` block —
+      {"enabled": true, "keep_number_layers": K,
+       "teacher_layer": [i0, ..., iK-1],          # which teacher layers
+       "module_name_prefix": ...}                 # accepted, unused here
+
+    Works on scan-stacked models ([L, ...] leaves under a scan collection
+    like "h") by index-selecting the teacher layers on axis 0, and on
+    unstacked models ("h_0".."h_{L-1}" subtrees) by re-keying. Returns
+    (new_params, kept_layers)."""
+    if not config or not config.get("enabled", False):
+        return params, None
+    teacher_layers = config.get("teacher_layer")
+    keep = config.get("keep_number_layers")
+    if teacher_layers is None:
+        if keep is None:
+            raise ValueError("layer_reduction needs teacher_layer or "
+                             "keep_number_layers")
+        # evenly spaced teacher layers (reference default policy)
+        n_layers = _count_layers(params)
+        idx = np.linspace(0, n_layers - 1, keep).round().astype(int)
+        teacher_layers = [int(i) for i in idx]
+    teacher_layers = list(teacher_layers)
+
+    # unstacked layout: h_0 ... h_{L-1} subtrees
+    keys = params.keys() if isinstance(params, dict) else ()
+    layer_keys = sorted((k for k in keys if k.startswith("h_")),
+                        key=lambda k: int(k.split("_")[1]))
+    if layer_keys:
+        new = {k: v for k, v in params.items() if not k.startswith("h_")}
+        for si, ti in enumerate(teacher_layers):
+            new[f"h_{si}"] = params[f"h_{ti}"]
+        return new, teacher_layers
+
+    # scan-stacked layout: every leaf under a stacked collection has
+    # leading dim == n_layers
+    n_layers = _count_layers(params)
+    sel = jnp.asarray(teacher_layers)
+
+    def one(x):
+        if hasattr(x, "shape") and x.ndim >= 1 and x.shape[0] == n_layers:
+            return jnp.take(x, sel, axis=0)
+        return x
+
+    stacked = {k: jax.tree.map(one, v) for k, v in params.items()
+               if k in ("h", "blocks")}
+    new = dict(params)
+    new.update(stacked)
+    return new, teacher_layers
+
+
+def _count_layers(params) -> int:
+    keys = params.keys() if isinstance(params, dict) else ()
+    layer_keys = [k for k in keys if k.startswith("h_")]
+    if layer_keys:
+        return len(layer_keys)
+    for k in ("h", "blocks"):
+        if k in params:
+            leaf = jax.tree.leaves(params[k])[0]
+            return int(leaf.shape[0])
+    raise ValueError("cannot locate transformer layers in params "
+                     "(expected 'h'/'blocks' stack or 'h_N' subtrees)")

@@ -1,0 +1,89 @@
+// Host-side vectorized Adagrad for ZeRO-Offload.
+//
+// TPU-native analog of the reference's csrc/adagrad/cpu_adagrad.cpp
+// (AVX SIMD + OpenMP): accumulator state lives in host RAM as fp32; each
+// step consumes the device-reduced gradient shard and produces updated
+// master weights plus an optional bf16 downcast for the device — the
+// same C-ABI/ctypes pattern as cpu_adam.cpp.
+//
+// Build: g++ -O3 -march=native -fopenmp -fPIC -shared
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <mutex>
+
+namespace {
+
+struct AdagradState {
+  float lr, eps, weight_decay;
+};
+
+std::unordered_map<int, AdagradState> g_states;
+std::mutex g_mu;
+
+inline uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+}  // namespace
+
+extern "C" {
+
+int ds_adagrad_create(int id, float lr, float eps, float weight_decay) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_states[id] = AdagradState{lr, eps, weight_decay};
+  return 0;
+}
+
+int ds_adagrad_destroy(int id) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  g_states.erase(id);
+  return 0;
+}
+
+// One fused step over a flat shard. params/accum fp32 updated in place;
+// grads fp32. lr < 0 keeps the lr set at create time. Matches
+// optax.adagrad: accum += g^2; p -= lr * g / (sqrt(accum) + eps), with
+// weight decay as classic L2 into the gradient (reference semantics).
+int ds_adagrad_update(int id, float lr, const float* grads, float* params,
+                      float* exp_avg_sq, int64_t n,
+                      uint16_t* params_out_bf16) {
+  AdagradState* st;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    auto it = g_states.find(id);
+    if (it == g_states.end()) return -1;
+    st = &it->second;
+  }
+  const float step_lr = lr >= 0.f ? lr : st->lr;
+  const float eps = st->eps;
+  const float wd = st->weight_decay;
+
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    float p = params[i];
+    if (wd != 0.f) g += wd * p;
+    float a = exp_avg_sq[i] + g * g;
+    exp_avg_sq[i] = a;
+    p = p - step_lr * g / (std::sqrt(a) + eps);
+    params[i] = p;
+    if (params_out_bf16) params_out_bf16[i] = f32_to_bf16(p);
+  }
+  return 0;
+}
+
+int ds_adagrad_simd_level(void) {
+#if defined(__AVX2__)
+  return 2;
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

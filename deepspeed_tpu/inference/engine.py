@@ -34,7 +34,8 @@ class InferenceEngine:
                  params=None, checkpoint=None,
                  replace_with_kernel_inject: bool = False,
                  injection_policy=None, max_tokens: int = 1024,
-                 mesh=None, **kwargs):
+                 mesh=None, quantize_weights: bool = False,
+                 quantize_min_size: int = 4096, **kwargs):
         dist.init_distributed()
         self.module = model
         self.dtype = dtype
@@ -47,6 +48,7 @@ class InferenceEngine:
         self.max_tokens = max_tokens
         self._injected = False
         self._compiled: Dict[str, Any] = {}
+        self._param_transform = None
 
         if replace_with_kernel_inject and model is not None:
             from ..module_inject.replace_module import replace_transformer_layer
@@ -58,6 +60,30 @@ class InferenceEngine:
         if self.params is None and checkpoint is not None:
             self._load_checkpoint(checkpoint)
 
+        if quantize_weights:
+            # Weight-only int8 serving (reference: module_quantize.py +
+            # the *_int8 inference gemms): big 2D+ params stored int8 with
+            # per-channel scales; dequant fuses into the decode matmuls.
+            if self.params is None:
+                raise ValueError(
+                    "quantize_weights=True needs params (pass params= or "
+                    "checkpoint=)")
+            from ..module_inject.module_quantize import (
+                quantize_param_tree, dequantize_param_tree, quantized_nbytes)
+            self.params = jax.jit(
+                lambda p: quantize_param_tree(
+                    p, min_size=quantize_min_size, dtype=dtype))(self.params)
+            dt = dtype
+
+            def _transform(p, _dt=dt):
+                return dequantize_param_tree(p, dtype=_dt)
+            self._param_transform = _transform
+            nb = quantized_nbytes(self.params)
+            log_dist(
+                f"int8 weight-only quantization: "
+                f"{nb['quantized']/1e6:.1f}MB vs "
+                f"{nb['dense_equivalent']/1e6:.1f}MB dense", ranks=[0])
+
     def _load_checkpoint(self, checkpoint):
         from ..module_inject.load_checkpoint import load_model_checkpoint
         self.params = load_model_checkpoint(self.module, checkpoint, self.mesh,
@@ -65,17 +91,21 @@ class InferenceEngine:
 
     def forward(self, *args, **kwargs):
         """Jitted module forward (compiled once per shape — the XLA analog
-        of CUDA-graph replay). Non-array kwargs (decode, deterministic, ...)
-        are compile-time constants: each combination gets its own cached
-        specialization."""
+        of CUDA-graph replay). Only genuinely structural kwargs (bools,
+        strings, None — decode, deterministic, ...) are compile-time
+        constants; numeric scalars like a temperature are TRACED so a
+        sweep of values reuses one executable (weak #10: the old
+        hasattr-shape heuristic recompiled per float)."""
         static = {k: v for k, v in kwargs.items()
-                  if not hasattr(v, "shape") and not isinstance(v, (list, dict))}
+                  if isinstance(v, (bool, str)) or v is None}
         arrays = {k: v for k, v in kwargs.items() if k not in static}
         key = ("forward", tuple(sorted(static.items())))
         if key not in self._compiled:
-            module = self.module
+            module, transform = self.module, self._param_transform
             self._compiled[key] = jax.jit(
-                lambda p, a, kw: module.apply({"params": p}, *a, **kw, **static))
+                lambda p, a, kw: module.apply(
+                    {"params": transform(p) if transform else p},
+                    *a, **kw, **static))
         return self._compiled[key](self.params, args, arrays)
 
     __call__ = forward
@@ -100,5 +130,6 @@ class InferenceEngine:
             # generation's informative max_seq_len error fires
             cache_len = min(cache_len, model_max)
         kwargs.setdefault("max_len", cache_len)
+        kwargs.setdefault("param_transform", self._param_transform)
         return _generate(self.module, self.params, input_ids,
                          max_new_tokens=max_new_tokens, **kwargs)

@@ -34,8 +34,11 @@ def init_cache(module, params, batch_size: int, max_len: int):
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shape)
 
 
-@partial(jax.jit, static_argnums=(0,))
-def _prefill(module, params, cache, input_ids, positions):
+@partial(jax.jit, static_argnums=(0, 5))
+def _prefill(module, params, cache, input_ids, positions,
+             param_transform=None):
+    if param_transform is not None:
+        params = param_transform(params)
     logits, vars_out = module.apply(
         {"params": params, "cache": cache}, input_ids, decode=True,
         positions=positions, mutable=["cache"])
@@ -62,15 +65,19 @@ def _sample(logits, rng, temperature, top_k, top_p):
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-@partial(jax.jit, static_argnums=(0, 5, 6, 7, 8))
+@partial(jax.jit, static_argnums=(0, 5, 6, 7, 8, 10))
 def _decode_loop(module, params, cache, last_token, start_pos,
-                 num_steps: int, temperature: float, top_k, top_p, rng):
+                 num_steps: int, temperature: float, top_k, top_p, rng,
+                 param_transform=None):
     """Scan num_steps single-token forwards; returns [batch, num_steps]."""
 
     def step(carry, i):
         cache, token, pos = carry
+        # transform INSIDE the body: int8 weights stay the resident copy;
+        # the dequantized operands are step-transient (fused into the dots)
+        p = param_transform(params) if param_transform is not None else params
         logits, vars_out = module.apply(
-            {"params": params, "cache": cache}, token[:, None], decode=True,
+            {"params": p, "cache": cache}, token[:, None], decode=True,
             positions=pos[None], mutable=["cache"])
         nxt = _sample(logits[:, -1, :], jax.random.fold_in(rng, i),
                       temperature, top_k, top_p)
@@ -84,7 +91,8 @@ def _decode_loop(module, params, cache, last_token, start_pos,
 def generate(module, params, input_ids, *, max_new_tokens: int = 32,
              temperature: float = 0.0, top_k: Optional[int] = None,
              top_p: Optional[float] = None, rng: Optional[jax.Array] = None,
-             eos_token_id: Optional[int] = None, max_len: Optional[int] = None):
+             eos_token_id: Optional[int] = None, max_len: Optional[int] = None,
+             param_transform=None):
     """Generate continuations for a batch of equal-length prompts.
 
     Returns [batch, prompt_len + max_new_tokens] token ids. ``eos_token_id``
@@ -115,14 +123,14 @@ def generate(module, params, input_ids, *, max_new_tokens: int = 32,
     cache_len = (total + 127) // 128 * 128
     cache = init_cache(module, params, b, cache_len)
     logits, cache = _prefill(module, params, cache, input_ids,
-                             jnp.arange(prompt_len))
+                             jnp.arange(prompt_len), param_transform)
     first = _sample(logits[:, -1, :], rng, temperature, top_k, top_p)
 
     if max_new_tokens > 1:
         rest, cache = _decode_loop(
             module, params, cache, first, jnp.int32(prompt_len),
             max_new_tokens - 1, temperature, top_k, top_p,
-            jax.random.fold_in(rng, 2**31))
+            jax.random.fold_in(rng, 2**31), param_transform)
         out = jnp.concatenate([input_ids, first[:, None], rest], axis=1)
     else:
         out = jnp.concatenate([input_ids, first[:, None]], axis=1)

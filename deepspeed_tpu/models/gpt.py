@@ -65,6 +65,9 @@ class GPTConfig:
     embed_ln: bool = False               # BLOOM word_embeddings_layernorm
     lm_head_bias: bool = False           # GPT-J untied head carries a bias
     seq_parallel: Optional[str] = None   # None=auto, "ulysses", "ring", "none"
+    offload_params: bool = False         # ZeRO-Infinity: block params live in
+                                         # host memory, streamed in per scan
+                                         # step (requires scan_layers)
 
     @property
     def ffn_dim(self):
@@ -149,6 +152,9 @@ class GPT(nn.Module):
 
         block_cls = Block
         policy = REMAT_POLICIES.get(cfg.remat)
+        if cfg.offload_params and not cfg.scan_layers:
+            raise ValueError("offload_params requires scan_layers (the "
+                             "scan step is the fetch granularity)")
         if cfg.remat != "none":
             # all-positional call below; deterministic (4) and decode (6)
             # are python bools and must stay static under remat
@@ -156,7 +162,43 @@ class GPT(nn.Module):
                 Block, policy=policy, prevent_cse=not cfg.scan_layers,
                 static_argnums=(4, 6))
 
-        if cfg.scan_layers:
+        if cfg.scan_layers and cfg.offload_params \
+                and not self.is_initializing():
+            # ZeRO-Infinity param streaming (reference:
+            # partitioned_param_coordinator.py per-layer fetch + NVMe
+            # prefetch :444): block params live HOST-side as the stacked
+            # "h" collection (created by the nn.scan init path below);
+            # apply drives an explicit lax.scan whose body fetches each
+            # block's slice h2d via stream_in — inside jax.checkpoint, so
+            # the backward recompute re-fetches instead of saving device
+            # copies. XLA overlaps block k+1's fetch with block k's math
+            # (the coordinator's prefetch, scheduled by the compiler).
+            if decode:
+                raise NotImplementedError(
+                    "offload_params is a training feature; serve with a "
+                    "non-offloaded config")
+            if (cfg.dropout_rate > 0 or cfg.attn_dropout_rate > 0) \
+                    and not deterministic:
+                raise NotImplementedError(
+                    "offload_params with dropout is unsupported (per-layer "
+                    "rng threading); set dropout rates to 0")
+            from ..utils.streaming import stream_in_tree
+            stacked = self.scope.get_variable("params", "h")
+            blk = Block(**block_kwargs, parent=None)
+
+            def call(p, x):
+                return blk.apply({"params": p}, x, mask, bias,
+                                 deterministic, layer_keep_prob, decode,
+                                 positions)
+
+            def step(carry, p):
+                p = stream_in_tree(p)
+                f = (jax.checkpoint(call, policy=policy)
+                     if cfg.remat != "none" else call)
+                return f(p, carry), None
+
+            h, _ = jax.lax.scan(step, h, stacked)
+        elif cfg.scan_layers:
             def body(block, carry):
                 x = block(carry, mask, bias, deterministic,
                           layer_keep_prob, decode, positions)

@@ -51,11 +51,16 @@ class _MoEAdapter(nn.Module):
 
 
 class MoEGPT(nn.Module):
-    """Returns (logits, total_aux_loss)."""
+    """Returns (logits, total_aux_loss) when training; plain logits under
+    ``decode=True`` so the generation stack serves it unchanged (reference:
+    DeepSpeedMoEInference, ops/transformer/inference/moe_inference.py:205 —
+    expert all-to-all at decode falls out of the same expert-axis sharding
+    constraints the training path uses)."""
     config: MoEGPTConfig
 
     @nn.compact
-    def __call__(self, input_ids, *, deterministic=True):
+    def __call__(self, input_ids, *, deterministic=True, decode=False,
+                 positions=None):
         cfg = self.config.base
         mcfg = self.config
         b, s = input_ids.shape
@@ -66,8 +71,10 @@ class MoEGPT(nn.Module):
         wpe = self.param("wpe", nn.with_logical_partitioning(
             nn.initializers.normal(0.02), ("pos", "embed")),
             (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
+        if positions is None:
+            positions = jnp.arange(s)
         h = (jnp.take(wte, input_ids, axis=0)
-             + jnp.take(wpe, jnp.arange(s), axis=0)[None]).astype(cfg.dtype)
+             + jnp.take(wpe, positions, axis=0)[None]).astype(cfg.dtype)
         h = activation_constraint(h, ("batch", "seq", "embed"))
 
         total_aux = jnp.float32(0.0)
@@ -82,7 +89,7 @@ class MoEGPT(nn.Module):
                 block_kwargs["mlp_factory"] = (
                     lambda name, _mcfg=mcfg: _MoEAdapter(_mcfg, name=name))
             out = Block(**block_kwargs, name=f"h_{i}")(
-                h, None, None, deterministic)
+                h, None, None, deterministic, None, decode, positions)
             if isinstance(out, tuple):
                 h, aux = out
                 total_aux = total_aux + aux
@@ -91,6 +98,8 @@ class MoEGPT(nn.Module):
 
         h = LayerNorm(epsilon=cfg.ln_epsilon, name="ln_f")(h)
         logits = jnp.einsum("bsd,vd->bsv", h, wte.astype(cfg.dtype))
+        if decode:
+            return logits
         return logits, total_aux
 
 

@@ -125,3 +125,41 @@ def load_model_checkpoint(module, checkpoint, mesh, dtype=None, policy=None,
     cfg = pol.build_config(hf_config, dtype)
     params = pol.convert(sd, cfg)
     return shard_params_for_inference(module, params, mesh, cfg)
+
+
+def load_megatron_checkpoint(checkpoint, n_heads=None, dtype=None, mesh=None):
+    """Serve a Megatron-LM GPT checkpoint (reference:
+    SDLoaderFactory.get_sd_loader_json + MegatronSDLoader merge,
+    state_dict_factory.py:17/:197). ``checkpoint``: a ds_inference json
+    descriptor ({"type": "Megatron", "checkpoints": [...], "version": V,
+    optionally "num_attention_heads": H}) or a list of mp-sharded state
+    dicts/paths. Returns (module, params) ready for generation at ANY
+    target mp degree — NamedSharding placement does the re-split the
+    reference implements by hand (use MegatronSDLoader.split_state_dict
+    directly to write Megatron-format shards back out)."""
+    from ..runtime.state_dict_factory import SDLoaderFactory, MegatronSDLoader
+    from .replace_policy import MegatronLayerPolicy
+    from ..models.gpt import GPT
+
+    if isinstance(checkpoint, (list, tuple)):
+        loader = MegatronSDLoader(list(checkpoint))
+    else:
+        if isinstance(checkpoint, str):
+            import json as _json
+            with open(checkpoint) as f:
+                desc = _json.load(f)
+        else:
+            desc = dict(checkpoint)
+        n_heads = n_heads or desc.get("num_attention_heads")
+        loader = SDLoaderFactory.get_sd_loader_json(checkpoint)
+    if n_heads is None:
+        raise ValueError("load_megatron_checkpoint needs num_attention_heads "
+                         "(descriptor key or n_heads=)")
+    sd = loader.load(mp_world_size=1)
+    cfg = MegatronLayerPolicy.config_from_state_dict(sd, n_heads, dtype)
+    params = MegatronLayerPolicy.convert(sd, cfg)
+    module = GPT(cfg)
+    if mesh is not None:
+        from .replace_module import shard_params_for_inference
+        params = shard_params_for_inference(module, params, mesh, cfg)
+    return module, params

@@ -1,0 +1,92 @@
+"""Weight-only int8 quantization for serving.
+
+Reference: deepspeed/module_inject/module_quantize.py (quantize during
+kernel injection) + the int8 inference gemms
+(csrc/transformer/inference/csrc/pt_binding.cpp:1197-1244
+softmax_context_int8 / qkv_gemm_int8 / mlp_gemm_int8).
+
+TPU-native: instead of int8 kernel variants, the PARAMS are stored int8
+(symmetric per-output-channel scales) and dequantized inside the jitted
+decode step right at the matmul operand — XLA fuses the convert+scale
+into the dot's operand read, so HBM holds (and streams) half the bytes.
+The model code is untouched: InferenceEngine composes
+``dequantize_param_tree`` in front of ``model.apply``.
+
+Storage layout per quantized leaf: the param subtree gains a dict node
+{"q": int8[...], "scale": f32[...broadcastable...]} in place of the raw
+array; everything else passes through unchanged.
+"""
+
+from typing import Any, Dict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+_QKEYS = ("q", "scale")
+
+
+def _is_qleaf(x) -> bool:
+    return isinstance(x, dict) and set(x.keys()) == set(_QKEYS)
+
+
+def _quantize_array(w, axis):
+    """Symmetric per-channel int8: scale = max|w| / 127 along all dims
+    except ``axis`` (the output-feature dim keeps its own scale)."""
+    w32 = jnp.asarray(w, jnp.float32)
+    reduce_dims = tuple(i for i in range(w32.ndim) if i != axis)
+    amax = jnp.max(jnp.abs(w32), axis=reduce_dims, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale.astype(jnp.float32)}
+
+
+def quantize_param_tree(params, *, min_size: int = 4096,
+                        dtype=jnp.bfloat16) -> Any:
+    """Quantize every floating >=2D leaf with numel >= min_size to int8
+    (weight-only). Embeddings/kernels qualify; biases, layernorm scales
+    and small tensors stay in ``dtype``.
+
+    Per-output-channel scales: the LAST dim is treated as the output
+    features (our DenseGeneral kernels are [in, out]; embeddings [V, D]
+    quantize per-embedding-dim which is equally fine)."""
+
+    def one(w):
+        if _is_qleaf(w):
+            return w
+        arr = jnp.asarray(w)
+        if (arr.ndim >= 2 and np.issubdtype(np.dtype(arr.dtype), np.floating)
+                and arr.size >= min_size):
+            return _quantize_array(arr, axis=arr.ndim - 1)
+        return arr.astype(dtype) if np.issubdtype(
+            np.dtype(arr.dtype), np.floating) else arr
+
+    return jax.tree.map(one, params, is_leaf=_is_qleaf)
+
+
+def dequantize_param_tree(params, dtype=jnp.bfloat16):
+    """Rebuild the dense param tree (traced: runs inside jit where XLA
+    fuses the int8->bf16 convert + scale into the consuming matmul)."""
+
+    def one(x):
+        if _is_qleaf(x):
+            return (x["q"].astype(jnp.float32) * x["scale"]).astype(dtype)
+        return x
+
+    return jax.tree.map(one, params, is_leaf=_is_qleaf)
+
+
+def quantized_nbytes(params) -> Dict[str, int]:
+    """{'quantized': bytes, 'dense_equivalent': bytes} for reporting."""
+    qb, db = 0, 0
+    for leaf in jax.tree.leaves(params, is_leaf=_is_qleaf):
+        if _is_qleaf(leaf):
+            qb += leaf["q"].size + leaf["scale"].size * 4
+            db += leaf["q"].size * 2
+        else:
+            n = np.prod(leaf.shape) if hasattr(leaf, "shape") else 0
+            sz = int(n) * np.dtype(leaf.dtype).itemsize
+            qb += sz
+            db += sz
+    return {"quantized": int(qb), "dense_equivalent": int(db)}

@@ -439,3 +439,76 @@ class HFBertLayerPolicy(InjectionPolicy):
 replace_policies = [HFGPT2LayerPolicy, HFGPTNEOLayerPolicy, HFGPTJLayerPolicy,
                     GPTNEOXLayerPolicy, BLOOMLayerPolicy, HFBertLayerPolicy]
 POLICY_REGISTRY = {p.model_type: p for p in replace_policies}
+
+
+class MegatronLayerPolicy(InjectionPolicy):
+    """Megatron-LM GPT checkpoints (reference: MegatronLayerPolicy,
+    replace_policy.py:203, fed by MegatronSDLoader's merged state dict —
+    runtime/state_dict_factory.py here). Flat key layout:
+    ``word_embeddings.weight``, ``position_embeddings.weight``,
+    ``transformer.layers.N.{input_layernorm, attention.query_key_value,
+    attention.dense, post_attention_layernorm, mlp.dense_h_to_4h,
+    mlp.dense_4h_to_h}``, ``transformer.final_layernorm``. Weights are
+    torch Linear [out, in] (transposed here); qkv rows are grouped
+    [q; k; v] (checkpoint version 1.0 — what the merge produces)."""
+    model_type = "megatron"
+
+    @classmethod
+    def build_config(cls, hf, dtype):
+        # hf may be a transformers config for megatron-exported models
+        return GPTConfig(
+            vocab_size=hf.vocab_size,
+            max_seq_len=getattr(hf, "max_position_embeddings", 1024),
+            d_model=hf.hidden_size, n_layers=hf.num_hidden_layers,
+            n_heads=hf.num_attention_heads, dtype=dtype,
+            tie_embeddings=True, learned_pos=True, scan_layers=True)
+
+    @classmethod
+    def config_from_state_dict(cls, sd, n_heads, dtype=None):
+        """Infer the GPTConfig directly from a merged state dict (no HF
+        config exists for raw Megatron checkpoints)."""
+        import re
+        vocab, d_model = sd["word_embeddings.weight"].shape
+        max_pos = sd["position_embeddings.weight"].shape[0]
+        layers = {int(m.group(1)) for k in sd
+                  if (m := re.match(r"transformer\.layers\.(\d+)\.", k))}
+        d_ff = sd["transformer.layers.0.mlp.dense_h_to_4h.weight"].shape[0]
+        import jax.numpy as jnp
+        return GPTConfig(
+            vocab_size=vocab, max_seq_len=max_pos, d_model=d_model,
+            n_layers=max(layers) + 1, n_heads=n_heads, d_ff=d_ff,
+            dtype=dtype or jnp.bfloat16, tie_embeddings=True,
+            learned_pos=True, scan_layers=True, activation="gelu")
+
+    @classmethod
+    def convert(cls, sd, cfg):
+        def lin(prefix):
+            w = np.asarray(sd[prefix + ".weight"], np.float32).T
+            b = sd.get(prefix + ".bias")
+            return _dense(w, None if b is None else b)
+
+        layers = []
+        for i in range(cfg.n_layers):
+            lp = f"transformer.layers.{i}."
+            layers.append({
+                "ln_1": _ln(sd, lp + "input_layernorm"),
+                "ln_2": _ln(sd, lp + "post_attention_layernorm"),
+                "attn": {
+                    "qkv": lin(lp + "attention.query_key_value"),
+                    "out": lin(lp + "attention.dense"),
+                },
+                "mlp": {
+                    "fc_in": lin(lp + "mlp.dense_h_to_4h"),
+                    "fc_out": lin(lp + "mlp.dense_4h_to_h"),
+                },
+            })
+        return {
+            "wte": np.asarray(sd["word_embeddings.weight"], np.float32),
+            "wpe": np.asarray(sd["position_embeddings.weight"], np.float32),
+            "h": _stack(layers),
+            "ln_f": _ln(sd, "transformer.final_layernorm"),
+        }
+
+
+replace_policies.append(MegatronLayerPolicy)
+POLICY_REGISTRY[MegatronLayerPolicy.model_type] = MegatronLayerPolicy

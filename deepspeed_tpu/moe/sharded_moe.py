@@ -22,14 +22,25 @@ from ..comm.mesh import get_global_mesh
 
 
 def _expert_constraint(x, spec_axes):
-    """with_sharding_constraint over the expert axis, no-op off-mesh."""
-    from jax.sharding import PartitionSpec as P
+    """with_sharding_constraint over the expert axis, no-op off-mesh.
+
+    Uses a concrete NamedSharding — a bare PartitionSpec under plain
+    ``jit`` has no mesh context and silently fails."""
+    from jax.sharding import PartitionSpec as P, NamedSharding
     try:
+        from jax.sharding import get_abstract_mesh
+        am = get_abstract_mesh()
+        if not am.empty and any("Manual" in str(t) for t in am.axis_types):
+            return x   # inside shard_map: constraint meshes don't mix
         mesh = get_global_mesh()
         if mesh.shape.get("expert", 1) == 1:
             return x
-        return jax.lax.with_sharding_constraint(x, P(*spec_axes))
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec_axes)))
     except Exception:
+        from ..utils.logging import warn_once
+        import sys
+        warn_once(f"expert sharding constraint skipped: {sys.exc_info()[1]}")
         return x
 
 

@@ -1,0 +1,61 @@
+"""Host-side fused Adagrad over numpy shards (ZeRO-Offload inner
+optimizer, Adagrad flavor).
+
+Reference: DeepSpeedCPUAdagrad (deepspeed/ops/adagrad/cpu_adagrad.py:10)
+backed by csrc/adagrad/cpu_adagrad.cpp. Same ctypes C-ABI pattern as
+ops/adam/cpu_adam.py; update math matches optax.adagrad (proven by
+test_native_ops.py).
+"""
+
+import itertools
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ..op_builder import CPUAdagradBuilder
+
+_ids = itertools.count()
+
+
+def _f32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdagrad:
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        self.lib = CPUAdagradBuilder.load()
+        self.opt_id = next(_ids)
+        self.defaults = dict(lr=lr, eps=eps, weight_decay=weight_decay)
+        rc = self.lib.ds_adagrad_create(self.opt_id, lr, eps, weight_decay)
+        if rc != 0:
+            raise RuntimeError("ds_adagrad_create failed")
+
+    def step(self, params: np.ndarray, grads: np.ndarray,
+             exp_avg_sq: np.ndarray, lr: Optional[float] = None,
+             out_bf16: Optional[np.ndarray] = None):
+        """One fused step over a flat fp32 shard, in place."""
+        for name, a in (("params", params), ("grads", grads),
+                        ("exp_avg_sq", exp_avg_sq)):
+            if a.dtype != np.float32 or not a.flags.c_contiguous:
+                raise ValueError(f"{name} must be contiguous float32")
+        n = params.size
+        if not (grads.size == exp_avg_sq.size == n):
+            raise ValueError("size mismatch")
+        out_ptr = None
+        if out_bf16 is not None:
+            if out_bf16.dtype != np.uint16 or out_bf16.size != n:
+                raise ValueError(
+                    "out_bf16 must be uint16 (bf16 bits) of same size")
+            out_ptr = out_bf16.ctypes.data_as(ctypes.c_void_p)
+        rc = self.lib.ds_adagrad_update(
+            self.opt_id, -1.0 if lr is None else float(lr), _f32ptr(grads),
+            _f32ptr(params), _f32ptr(exp_avg_sq), n, out_ptr)
+        if rc != 0:
+            raise RuntimeError("ds_adagrad_update failed")
+
+    def __del__(self):
+        try:
+            self.lib.ds_adagrad_destroy(self.opt_id)
+        except Exception:
+            pass

@@ -117,6 +117,25 @@ class CPUAdamBuilder(OpBuilder):
         return lib
 
 
+class CPUAdagradBuilder(OpBuilder):
+    """Reference: op_builder/cpu_adagrad.py + csrc/adagrad/cpu_adagrad.cpp."""
+    NAME = "cpu_adagrad"
+    SOURCES = ["cpu_adagrad.cpp"]
+    EXTRA_FLAGS = ["-march=native", "-fopenmp"]
+
+    @classmethod
+    def load(cls):
+        lib = super().load()
+        lib.ds_adagrad_create.argtypes = [
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_float]
+        lib.ds_adagrad_update.argtypes = [
+            ctypes.c_int, ctypes.c_float,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_void_p]
+        lib.ds_adagrad_destroy.argtypes = [ctypes.c_int]
+        return lib
+
+
 class AsyncIOBuilder(OpBuilder):
     """Reference: op_builder/async_io.py + csrc/aio/."""
     NAME = "async_io"
@@ -144,7 +163,7 @@ class AsyncIOBuilder(OpBuilder):
         return lib
 
 
-ALL_OPS = {b.NAME: b for b in (CPUAdamBuilder, AsyncIOBuilder)}
+ALL_OPS = {b.NAME: b for b in (CPUAdamBuilder, CPUAdagradBuilder, AsyncIOBuilder)}
 
 
 def op_report():

@@ -5,6 +5,7 @@
 | transformer attention + softmax kernels | flash_attention          |
 | inference softmax_context (KV cache)    | decode_attention         |
 | adam/multi_tensor_adam.cu               | fused_adam.fused_adamw   |
+| lamb/fused_lamb_cuda.cpp (trust ratios) | fused_lamb.fused_lamb    |
 | transformer/normalize_kernels.cu        | layernorm.fused_layer_norm |
 | quantization/quantizer.cu               | quantizer.quantize/dequantize |
 
@@ -15,5 +16,6 @@ tests on the CPU mesh.
 from .decode_attention import decode_attention
 from .flash_attention import flash_attention
 from .fused_adam import fused_adamw, FusedAdamState
+from .fused_lamb import fused_lamb, FusedLambState
 from .layernorm import fused_layer_norm
 from .quantizer import quantize, dequantize

@@ -67,7 +67,11 @@ def sparse_attention(q, k, v, sparsity_config: SparsityConfig, *,
                 "sparse_attention backend='pallas' does not support "
                 "key_padding_mask/attn_mask — drop them or use the dense "
                 "path")
-        if not extra_masks:
+        from ..pallas._common import on_tpu
+        # auto mode takes the kernel only on real TPUs — off-TPU it would
+        # run in interpret mode, orders of magnitude slower than the dense
+        # XLA path; backend="pallas" forces it anyway (tests)
+        if not extra_masks and (backend == "pallas" or on_tpu()):
             from .block_sparse_kernel import block_sparse_attention
             out = block_sparse_attention(q, k, v, sparsity_config,
                                          softmax_scale=softmax_scale)

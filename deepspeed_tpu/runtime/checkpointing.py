@@ -122,11 +122,12 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
             for k, v in engine.loss_scale_state._asdict().items()}
     native = getattr(engine, "native_offload", None)
     if load_optimizer_states and not load_module_only and native is None:
-        opt_shapes = jax.eval_shape(engine.optimizer.init, engine._param_shapes)
+        # template from the engine's LIVE optimizer-state structure — it
+        # differs by path (optax tree vs the streamed-offload {mu,nu,count}
+        # dict) but always pairs leaf-for-leaf with opt_shardings
         template["optimizer_state"] = jax.tree.map(
-            lambda sds, sh: jax.ShapeDtypeStruct(sds.shape, sds.dtype, sharding=sh),
-            opt_shapes, engine.opt_shardings,
-            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+            lambda x, sh: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh),
+            engine.optimizer_state, engine.opt_shardings)
 
     ckptr = _checkpointer()
     item_path = os.path.join(path, "state")

@@ -142,3 +142,79 @@ def zero_one_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
         return updates, new_state._replace(nu=nu)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+class OneBitLambState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates        # momentum (compressed after warmup)
+    nu: optax.Updates        # variance — frozen after warmup
+    error: optax.Updates     # error-feedback residual
+    frozen_ratio: optax.Updates  # per-leaf trust ratio captured at freeze
+
+
+def onebit_lamb(learning_rate, b1: float = 0.9, b2: float = 0.999,
+                eps: float = 1e-6, weight_decay: float = 0.0,
+                freeze_step: int = 100) -> optax.GradientTransformation:
+    """1-bit LAMB (reference: OnebitLamb, onebit/lamb.py:11): exact LAMB
+    during warmup while recording each layer's trust ratio; after
+    ``freeze_step`` the variance stops updating, the momentum passes
+    through error-feedback 1-bit quantization (the wire format of the
+    reference's compressed allreduce), and the per-layer trust ratios are
+    FROZEN at their last warmup value — the reference's 'fused scaling
+    coefficients', which cannot be recomputed from compressed momentum."""
+
+    def init_fn(params):
+        z = lambda: jax.tree.map(jnp.zeros_like, params)
+        ones = jax.tree.map(lambda p: jnp.ones((), jnp.float32), params)
+        return OneBitLambState(jnp.zeros((), jnp.int32), z(), z(), z(), ones)
+
+    def update_fn(grads, state, params=None):
+        assert params is not None, "onebit_lamb requires params"
+        count = state.count + 1
+        warm = count <= freeze_step
+
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)
+        nu = jax.tree.map(
+            lambda v, g: jnp.where(warm, b2 * v + (1 - b2) * g * g, v),
+            state.nu, grads)
+
+        def compress(m, e):
+            signs, scale, new_e = compress_1bit(m, e)
+            return scale * signs, new_e
+
+        pairs = jax.tree.map(
+            lambda m, e: jax.lax.cond(
+                warm, lambda me: (me[0], me[1]),
+                lambda me: compress(me[0], me[1]), (m, e)),
+            mu, state.error, is_leaf=lambda x: False)
+        mu_used = jax.tree.map(lambda p: p[0], pairs,
+                               is_leaf=lambda x: isinstance(x, tuple))
+        error = jax.tree.map(lambda p: p[1], pairs,
+                             is_leaf=lambda x: isinstance(x, tuple))
+
+        bc1 = 1 - b1 ** count.astype(jnp.float32)
+        bc2 = 1 - b2 ** jnp.minimum(count, freeze_step).astype(jnp.float32)
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+
+        def leaf_update(m, v, p, fr):
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if weight_decay:
+                u = u + weight_decay * p
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+            u_norm = jnp.sqrt(jnp.sum(jnp.square(u)))
+            live_ratio = jnp.where((p_norm > 0.0) & (u_norm > 0.0),
+                                   p_norm / jnp.maximum(u_norm, 1e-30), 1.0)
+            # the applied ratio IS the carried state: captured live while
+            # warm, frozen (reused) afterwards
+            ratio = jnp.where(warm, live_ratio, fr)
+            return -lr * ratio * u, ratio
+
+        outs = jax.tree.map(leaf_update, mu_used, nu, params,
+                            state.frozen_ratio)
+        updates = jax.tree.map(lambda o: o[0], outs,
+                               is_leaf=lambda x: isinstance(x, tuple))
+        frozen = jax.tree.map(lambda o: o[1], outs,
+                              is_leaf=lambda x: isinstance(x, tuple))
+        return updates, OneBitLambState(count, mu, nu, error, frozen)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -103,6 +103,7 @@ class DeepSpeedEngine:
         self._activation_rules = {"batch": DENSE_DP_AXES, "seq": None,
                                   "embed": None, "mlp": "model", "qkv": "model"}
         self._apply_activation_checkpointing_config()
+        self._apply_param_offload_config()
         self._warn_inert_zero_knobs()
         set_activation_rules(self._activation_rules)
 
@@ -267,6 +268,46 @@ class DeepSpeedEngine:
             log_dist(f"activation_checkpointing: model remat policy set to "
                      f"'{remat}'", ranks=[0])
 
+    def _apply_param_offload_config(self):
+        """ZeRO-Infinity parameter offload (reference: offload_param ->
+        params on CPU/NVMe swapped in per-layer with prefetch,
+        partitioned_param_swapper.py:36, partitioned_param_coordinator.py
+        :444). TPU-native: block params live in the accelerator host's
+        memory space; the model's scan step fetches each block's params
+        just-in-time (models/gpt.py offload_params + utils/streaming.py),
+        and XLA's latency-hiding scheduler overlaps block k+1's h2d with
+        block k's compute — the coordinator's prefetch, by compilation."""
+        off = self.config.zero_optimization.offload_param
+        if off is None or off.device not in ("cpu", "nvme"):
+            self._offload_params = False
+            return
+        if off.device == "nvme":
+            logger.warning("offload_param.device=nvme has no NVMe tier yet; "
+                           "params stream via host memory")
+        if self.config.fp16.enabled:
+            raise DeepSpeedConfigError(
+                "offload_param currently supports bf16/fp32 training only "
+                "(fp16 overflow checks would pull host grads to device)")
+        if self.config.zero_optimization.offload_optimizer_device not in (
+                "cpu", "nvme"):
+            raise DeepSpeedConfigError(
+                "offload_param requires offload_optimizer.device: cpu "
+                "(params and optimizer state offload together, like the "
+                "reference's ZeRO-Infinity configuration)")
+        mcfg = getattr(self.module, "config", None)
+        if mcfg is None or not hasattr(mcfg, "offload_params"):
+            raise DeepSpeedConfigError(
+                "offload_param needs a model with parameter-streaming "
+                "support (models from deepspeed_tpu.models with "
+                "scan_layers=True)")
+        if not getattr(mcfg, "offload_params", False):
+            import dataclasses
+            self.module = type(self.module)(
+                dataclasses.replace(mcfg, offload_params=True))
+        self._offload_params = True
+        log_dist("ZeRO-Infinity param offload: block params in host "
+                 "memory, streamed per scan step", ranks=[0])
+
     def _warn_inert_zero_knobs(self):
         """Stage-3 fetch-coordinator knobs are subsumed by the
         scan-over-layers design (one block's params live at a time; XLA
@@ -325,6 +366,16 @@ class DeepSpeedEngine:
         self.param_shardings = jax.tree.map(
             lambda spec: NamedSharding(self.mesh, spec),
             self.param_specs, is_leaf=lambda x: isinstance(x, P))
+        # ZeRO-Infinity: scan-stacked block params ("layers" leading axis)
+        # live in host memory; everything else stays in HBM
+        self._offload_mask = jax.tree.map(
+            lambda n: bool(n and "layers" in n),
+            self._param_names, is_leaf=_tree_names_is_leaf)
+        if getattr(self, "_offload_params", False):
+            self.param_shardings = jax.tree.map(
+                lambda sh, off: _host_kind(sh) if off else sh,
+                self.param_shardings, self._offload_mask,
+                is_leaf=lambda x: isinstance(x, NamedSharding))
 
     def _configure_optimizer(self, client_optimizer, client_scheduler):
         cfg = self.config
@@ -467,6 +518,7 @@ class DeepSpeedEngine:
         fp16 = self.fp16_enabled
         model = self.module
         loss_fn = self._loss_fn
+        offloaded = getattr(self, "_offload_params", False)
 
         # ZeRO stage >= 2: the grad-accum scan carry is pinned to the ZeRO
         # partition (same rule as the opt state), so full-shape fp32 grads
@@ -480,10 +532,19 @@ class DeepSpeedEngine:
                 self.param_specs, self._param_shapes,
                 is_leaf=lambda x: isinstance(x, P))
 
+            grad_shardings = jax.tree.map(
+                lambda spec: NamedSharding(self.mesh, spec), grad_specs,
+                is_leaf=lambda x: isinstance(x, P))
+
             def grad_constraint(g):
-                return jax.lax.with_sharding_constraint(g, jax.tree.map(
-                    lambda spec: NamedSharding(self.mesh, spec), grad_specs,
-                    is_leaf=lambda x: isinstance(x, P)))
+                if offloaded:
+                    # host-space grads keep their placement; the ZeRO
+                    # partition constraint applies to device leaves only
+                    return jax.tree.map(
+                        lambda x, sh, off: x if off
+                        else jax.lax.with_sharding_constraint(x, sh),
+                        g, grad_shardings, self._offload_mask)
+                return jax.lax.with_sharding_constraint(g, grad_shardings)
 
         def microbatch_loss(params, batch, rng, scale, extra):
             loss = loss_fn(model, params, batch, rng, True, **extra)
@@ -505,6 +566,14 @@ class DeepSpeedEngine:
 
             zero_grads = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, jnp.float32), self._param_shapes)
+            if offloaded:
+                # offloaded params produce host-space cotangents: their
+                # accumulation buffers must live host-side too (the param
+                # shardings already carry the host memory kind; SPMD needs
+                # memory transfers to have explicit shardings)
+                zero_grads = jax.tree.map(
+                    lambda z, off, sh: jax.device_put(z, sh) if off else z,
+                    zero_grads, self._offload_mask, self.param_shardings)
             if grad_constraint is not None:
                 zero_grads = grad_constraint(zero_grads)
             (grads, loss_sum, _), _ = jax.lax.scan(
@@ -517,8 +586,13 @@ class DeepSpeedEngine:
             # overflow; XLA reduces in fp32 here, so it is unnecessary.
             if fp16:
                 grads = jax.tree.map(lambda g: g * (1.0 / scale), grads)
-            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
-                                 for g in jax.tree.leaves(grads)))
+            # per-leaf partial norms: host-space leaves reduce host-side,
+            # only their scalars cross to device
+            rep_dev = NamedSharding(self.mesh, P())
+            gnorm = jnp.sqrt(sum(
+                jax.device_put(jnp.sum(jnp.square(g)), rep_dev) if offloaded
+                else jnp.sum(jnp.square(g))
+                for g in jax.tree.leaves(grads)))
             return grads, mean_loss, gnorm
 
         return accumulate
@@ -1018,6 +1092,17 @@ def _init_kwargs(sample_batch):
             raise DeepSpeedConfigError("sample_batch must contain 'input_ids'")
         return {"input_ids": jnp.asarray(ids)}
     return {"input_ids": jnp.asarray(sample_batch)}
+
+
+def _host_kind(sharding):
+    """One sharding moved to pinned host memory (no-op on CPU backends)."""
+    if jax.default_backend() == "cpu":
+        return sharding
+    try:
+        return sharding.with_memory_kind("pinned_host")
+    except Exception:
+        logger.warning("pinned_host unsupported; param offload inert")
+        return sharding
 
 
 def _with_host_memory(shardings):

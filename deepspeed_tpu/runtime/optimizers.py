@@ -77,6 +77,15 @@ def build_optimizer(opt_type: str, params: dict,
         return optax.adamw(lr, weight_decay=wd, **_adam_args(params))
 
     if name in (LAMB_OPTIMIZER, FUSED_LAMB):
+        if name == FUSED_LAMB and use_pallas:
+            try:
+                from ..ops.pallas.fused_lamb import fused_lamb
+                return fused_lamb(lr, weight_decay=wd,
+                                  eps=params.get("eps", 1e-6),
+                                  b1=params.get("betas", (0.9, 0.999))[0],
+                                  b2=params.get("betas", (0.9, 0.999))[1])
+            except Exception as e:  # pragma: no cover
+                logger.warning(f"Pallas fused lamb unavailable ({e}); using optax")
         return optax.lamb(lr, weight_decay=wd, **_adam_args(params))
 
     if name == ADAGRAD_OPTIMIZER:
@@ -100,10 +109,10 @@ def build_optimizer(opt_type: str, params: dict,
                            **kw)
 
     if name == ONEBIT_LAMB_OPTIMIZER:
-        logger.warning(f"{opt_type}: compressed-LAMB falls back to exact "
-                       "LAMB math (momentum compression for LAMB trust "
-                       "ratios is not implemented)")
-        return optax.lamb(lr, weight_decay=wd, **_adam_args(params))
+        from .comm_compression import onebit_lamb
+        return onebit_lamb(lr, weight_decay=wd,
+                           freeze_step=params.get("freeze_step", 100),
+                           **_adam_args(params))
 
     raise ValueError(f"Unknown optimizer type '{opt_type}' "
                      f"(valid: {DEEPSPEED_OPTIMIZERS})")

@@ -21,10 +21,11 @@ TPU-native: the whole pipelined step is ONE jitted SPMD program.
   their gradient is summed by autodiff — the reference's tied-grad
   allreduce (ReduceTiedGrads) is implicit.
 
-The 1F1B instruction stream itself lives in pipe/schedule.py for parity
-and for the host-driven fallback; XLA's scheduler overlaps the compute and
-ICI transfers of consecutive ticks, which is where 1F1B's benefit came
-from.
+The 1F1B instruction stream itself lives in pipe/schedule.py and is
+executed directly by the host-driven engine (pipe/host_engine.py) for
+heterogeneous LayerSpec stacks; here XLA's scheduler overlaps the compute
+and ICI transfers of consecutive ticks, which is where 1F1B's benefit
+came from.
 """
 
 from typing import Callable, Optional

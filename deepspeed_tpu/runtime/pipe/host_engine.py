@@ -1,0 +1,320 @@
+"""Host-driven pipeline schedule executor.
+
+Reference: PipelineEngine._exec_schedule (runtime/pipe/engine.py:1354)
+dispatching the TrainSchedule instruction stream through _INSTRUCTION_MAP
+(:1341) — LoadMicroBatch / ForwardPass / BackwardPass / Send*/Recv* /
+ReduceGrads / OptimizerStep.
+
+This engine executes that SAME instruction stream host-side, one jitted
+program per stage-compute instruction, which is what makes heterogeneous
+``LayerSpec`` stacks (different module types per stage — the reference's
+type:regex / parameters partitions, module.py:361) runnable: each stage
+is its own params/apply pair, no stacked-scan homogeneity required.
+
+Differences from the SPMD fast path (pipe/engine.py), by design:
+- Send/Recv are mailbox moves between host-tracked buffers — on one JAX
+  client the arrays already live on the right devices; the instructions
+  still execute so the schedule semantics (buffer lifetime, 1F1B
+  ordering) are faithfully exercised.
+- BackwardPass recomputes the stage forward (activation-checkpointing
+  semantics — the reference runs pipelines with AC enabled for the same
+  reason): device memory holds only each in-flight microbatch's stage
+  INPUT, not its residuals.
+
+The fused SPMD engine remains the fast path for homogeneous trunks.
+"""
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ... import comm as dist
+from ...utils.logging import log_dist
+from ..config import DeepSpeedConfig
+from ..config_utils import DeepSpeedConfigError
+from ..lr_schedules import get_lr_schedule
+from ..optimizers import build_optimizer
+from .module import PipelineModule
+from .schedule import (TrainSchedule, LoadMicroBatch, ForwardPass,
+                       BackwardPass, SendActivation, RecvActivation,
+                       SendGrad, RecvGrad, ReduceGrads, ReduceTiedGrads,
+                       OptimizerStep)
+
+
+class HostDrivenPipelineEngine:
+    """Executes TrainSchedule instruction streams for every stage on one
+    JAX client. Construct via ``deepspeed_tpu.initialize`` with a
+    heterogeneous ``PipelineModule``."""
+
+    def __init__(self, module: PipelineModule, config, *, loss_fn=None,
+                 sample_batch=None, rng=None, optimizer=None,
+                 lr_scheduler=None):
+        self.pipe = module
+        if isinstance(config, dict):
+            config = DeepSpeedConfig.from_dict(config)
+        dist.init_distributed()
+        config.resolve_batch_sizes(1)
+        self.config = config
+        self.loss_fn = loss_fn or module.loss_fn
+        if self.loss_fn is None:
+            raise DeepSpeedConfigError("PipelineModule requires a loss_fn")
+        self.num_stages = module.num_stages
+        self.micro_batches = config.gradient_accumulation_steps
+        self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.global_steps = 0
+        self.global_samples = 0
+
+        self.stage_layers = module.build_stage_layers()
+        self._init_params(sample_batch)
+        self._configure_optimizer(optimizer, lr_scheduler)
+        self._compiled: Dict[Any, Any] = {}
+        log_dist(
+            f"HostDrivenPipelineEngine: stages={self.num_stages} "
+            f"micro_batches={self.micro_batches} "
+            f"layers/stage={[len(s) for s in self.stage_layers]}", ranks=[0])
+
+    # -- setup ---------------------------------------------------------
+
+    def _init_params(self, sample_batch):
+        if sample_batch is None:
+            raise DeepSpeedConfigError("HostDrivenPipelineEngine needs "
+                                       "sample_batch")
+        ids = jnp.asarray(sample_batch["input_ids"]
+                          if isinstance(sample_batch, dict) else sample_batch)
+        from flax.core import meta as flax_meta
+        params: List[List[Any]] = []
+        x = ids
+        key = self.rng
+        for layers in self.stage_layers:
+            stage_params = []
+            for layer in layers:
+                key, sub = jax.random.split(key)
+                variables = flax_meta.unbox(layer.init(sub, x))
+                stage_params.append(variables)
+                x = layer.apply(variables, x)
+            params.append(stage_params)
+        self.params = params
+
+    def _stage_forward(self, s: int):
+        """fn(stage_params, x) -> y, jitted once per stage."""
+        layers = self.stage_layers[s]
+
+        def fwd(stage_params, x):
+            for layer, p in zip(layers, stage_params):
+                x = layer.apply(p, x)
+            return x
+        return fwd
+
+    def _configure_optimizer(self, client_optimizer, client_scheduler):
+        cfg = self.config
+        base_lr = (cfg.optimizer.params.get("lr", 1e-3)
+                   if cfg.optimizer else 1e-3)
+        if client_scheduler is not None:
+            self.lr_schedule = client_scheduler
+        elif cfg.scheduler and cfg.scheduler.type:
+            self.lr_schedule = get_lr_schedule(cfg.scheduler.type,
+                                               cfg.scheduler.params)
+        else:
+            self.lr_schedule = lambda step: base_lr
+        if client_optimizer is not None:
+            self.optimizer = client_optimizer
+        else:
+            opt_type = cfg.optimizer.type if cfg.optimizer else "Adam"
+            opt_params = dict(cfg.optimizer.params) if cfg.optimizer else {}
+            self.optimizer = build_optimizer(opt_type, opt_params,
+                                             lr_schedule=self.lr_schedule)
+        if cfg.gradient_clipping and cfg.gradient_clipping > 0:
+            import optax
+            self.optimizer = optax.chain(
+                optax.clip_by_global_norm(cfg.gradient_clipping),
+                self.optimizer)
+        self.optimizer_state = self.optimizer.init(self.params)
+
+    # -- jitted per-instruction programs -------------------------------
+
+    def _fwd_prog(self, s):
+        key = ("fwd", s)
+        if key not in self._compiled:
+            self._compiled[key] = jax.jit(self._stage_forward(s))
+        return self._compiled[key]
+
+    def _last_fwd_prog(self):
+        key = ("fwd_last",)
+        if key not in self._compiled:
+            fwd = self._stage_forward(self.num_stages - 1)
+            loss_fn = self.loss_fn
+
+            def run(stage_params, x, batch):
+                return loss_fn(fwd(stage_params, x), batch)
+            self._compiled[key] = jax.jit(run)
+        return self._compiled[key]
+
+    def _bwd_prog(self, s):
+        """Recompute-forward vjp: (params_s, x, cotangent) ->
+        (dparams_s, dx)."""
+        key = ("bwd", s)
+        if key not in self._compiled:
+            fwd = self._stage_forward(s)
+
+            def run(stage_params, x, cot):
+                _, vjp = jax.vjp(fwd, stage_params, x)
+                return vjp(cot)
+            self._compiled[key] = jax.jit(run)
+        return self._compiled[key]
+
+    def _last_bwd_prog(self):
+        key = ("bwd_last",)
+        if key not in self._compiled:
+            fwd = self._stage_forward(self.num_stages - 1)
+            loss_fn = self.loss_fn
+
+            def run(stage_params, x, batch):
+                def f(p, xx):
+                    return loss_fn(fwd(p, xx), batch)
+                _, vjp = jax.vjp(f, stage_params, x)
+                return vjp(jnp.float32(1.0 / self.micro_batches))
+            self._compiled[key] = jax.jit(run)
+        return self._compiled[key]
+
+    # -- the executor --------------------------------------------------
+
+    def train_batch(self, batch):
+        cfg = self.config
+        ids = jnp.asarray(batch["input_ids"])
+        B = ids.shape[0]
+        if B != cfg.train_batch_size:
+            raise ValueError(f"batch dim {B} != train_batch_size "
+                             f"{cfg.train_batch_size}")
+        n_micro = self.micro_batches
+        mb = B // n_micro
+        micro_ids = [jax.tree.map(lambda x: x[i * mb:(i + 1) * mb], batch)
+                     for i in range(n_micro)]
+
+        S = self.num_stages
+        schedules = [TrainSchedule(n_micro, S, s) for s in range(S)]
+        streams = [list(sched.steps()) for sched in schedules]
+        n_buf = max(sched.num_pipe_buffers() for sched in schedules)
+
+        # Buffer-id spaces are PER STAGE (each stage sizes its own ring,
+        # e.g. 3 buffers on stage 0 vs 2 on stage 1) — cross-stage mail is
+        # therefore keyed by MICRO id, recovered from the schedule step.
+        act_in = [[None] * n_buf for _ in range(S)]     # stage input, by buf
+        out_act = [[None] * n_buf for _ in range(S)]    # fwd output, by buf
+        out_micro = [[None] * n_buf for _ in range(S)]
+        dx_pending = [[None] * n_buf for _ in range(S)]
+        dx_micro = [[None] * n_buf for _ in range(S)]
+        grads_in = [[None] * n_buf for _ in range(S)]
+        act_mail: Dict[Any, Any] = {}                   # (stage, micro) -> act
+        grad_mail: Dict[Any, Any] = {}                  # (stage, micro) -> dx
+        grad_accum: List[Any] = [None] * S              # per-stage param grads
+        losses = []
+
+        def micro_of(s, t):
+            m, _ = schedules[s]._step_to_micro_batch(t)
+            return m
+
+        def add_grads(acc, new):
+            if acc is None:
+                return new
+            return jax.tree.map(jnp.add, acc, new)
+
+        total_steps = len(streams[0])
+        for t in range(total_steps):
+            # phase 1: sends (mailbox writes) across all stages
+            for s in range(S):
+                for cmd in streams[s][t]:
+                    b = getattr(cmd, "buffer_id", None)
+                    if isinstance(cmd, SendActivation):
+                        act_mail[(s + 1, out_micro[s][b])] = out_act[s][b]
+                        out_act[s][b] = None
+                    elif isinstance(cmd, SendGrad):
+                        grad_mail[(s - 1, dx_micro[s][b])] = dx_pending[s][b]
+                        dx_pending[s][b] = None
+            # phase 2: recv + compute per stage
+            for s in range(S):
+                for cmd in streams[s][t]:
+                    b = getattr(cmd, "buffer_id", None)
+                    if isinstance(cmd, LoadMicroBatch):
+                        if s == 0:
+                            m = micro_of(s, t)
+                            act_in[s][b] = micro_ids[m]["input_ids"]
+                    elif isinstance(cmd, RecvActivation):
+                        act_in[s][b] = act_mail.pop((s, micro_of(s, t)))
+                    elif isinstance(cmd, RecvGrad):
+                        grads_in[s][b] = grad_mail.pop((s, micro_of(s, t)))
+                    elif isinstance(cmd, ForwardPass):
+                        m = micro_of(s, t)
+                        x = act_in[s][b]
+                        if s == S - 1:
+                            loss = self._last_fwd_prog()(
+                                self.params[s], x, micro_ids[m])
+                            losses.append(loss)
+                        else:
+                            out_act[s][b] = self._fwd_prog(s)(
+                                self.params[s], x)
+                            out_micro[s][b] = m
+                    elif isinstance(cmd, BackwardPass):
+                        m = micro_of(s, t)
+                        x = act_in[s][b]
+                        if s == S - 1:
+                            dp, dx = self._last_bwd_prog()(
+                                self.params[s], x, micro_ids[m])
+                        else:
+                            cot = grads_in[s][b]
+                            grads_in[s][b] = None
+                            dp, dx = self._bwd_prog(s)(self.params[s], x, cot)
+                        grad_accum[s] = add_grads(grad_accum[s], dp)
+                        dx_pending[s][b] = dx
+                        dx_micro[s][b] = m
+                        act_in[s][b] = None
+                    elif isinstance(cmd, (ReduceGrads, ReduceTiedGrads)):
+                        pass   # single-client: grads already global sums
+                    elif isinstance(cmd, OptimizerStep):
+                        if s == S - 1:   # run the step exactly once
+                            self._take_step(grad_accum)
+                            grad_accum = [None] * S
+
+        self.global_steps += 1
+        self.global_samples += B
+        mean_loss = jnp.mean(jnp.stack(losses))
+        if self.global_steps % cfg.steps_per_print == 0:
+            log_dist(f"step={self.global_steps} loss={float(mean_loss):.4f}",
+                     ranks=[0])
+        return mean_loss
+
+    def _take_step(self, grad_accum):
+        grads = [acc if acc is not None
+                 else jax.tree.map(jnp.zeros_like, self.params[s])
+                 for s, acc in enumerate(grad_accum)]
+        self._apply_step(grads)
+
+    def _apply_step(self, grads):
+        if "opt_step" not in self._compiled:
+            optimizer = self.optimizer
+
+            def step(params, opt_state, grads):
+                import optax
+                updates, new_state = optimizer.update(grads, opt_state,
+                                                      params)
+                return optax.apply_updates(params, updates), new_state
+            self._compiled["opt_step"] = jax.jit(step, donate_argnums=(0, 1))
+        self.params, self.optimizer_state = self._compiled["opt_step"](
+            self.params, self.optimizer_state, grads)
+
+    # -- eval ----------------------------------------------------------
+
+    def eval_batch(self, batch):
+        if "eval" not in self._compiled:
+            stage_fns = [self._stage_forward(s)
+                         for s in range(self.num_stages)]
+            loss_fn = self.loss_fn
+
+            def run(params, batch):
+                x = batch["input_ids"]
+                for s, fn in enumerate(stage_fns[:-1]):
+                    x = fn(params[s], x)
+                return loss_fn(stage_fns[-1](params[-1], x), batch)
+            self._compiled["eval"] = jax.jit(run)
+        return self._compiled["eval"](self.params, batch)

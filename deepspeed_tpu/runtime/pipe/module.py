@@ -14,10 +14,10 @@ describes three sections:
            [n_blocks, ...] shard over the "stage" axis
   head   - last-stage epilogue (final LN + LM head) + loss_fn
 
-A generic LayerSpec list is still accepted and partitioned with the
-reference's methods (used for bookkeeping, checkpoint layout, and the
-host-driven fallback); homogeneous specs are auto-collapsed into the
-block form.
+A generic LayerSpec list is also accepted and partitioned with the
+reference's methods; homogeneous runs auto-collapse into the block form
+(the SPMD fast path), and heterogeneous stacks execute the 1F1B
+instruction stream on the host-driven engine (pipe/host_engine.py).
 """
 
 import re
@@ -137,15 +137,30 @@ class PipelineModule:
             if self.block is None:
                 self._try_collapse_homogeneous()
 
-        if self.block is None:
+        # Heterogeneous LayerSpec stacks keep their per-stage partitions and
+        # run on the host-driven schedule executor
+        # (pipe/host_engine.py HostDrivenPipelineEngine); homogeneous stacks
+        # collapse to the fused SPMD fast path (pipe/engine.py).
+        self.heterogeneous = self.block is None
+        if self.heterogeneous and self._layer_specs is None:
             raise ValueError(
-                "PipelineModule needs a homogeneous trunk: pass "
-                "embed=/block=/n_blocks=/head=, or a LayerSpec list whose "
-                "middle section repeats one layer type")
-        if self.n_blocks % self.num_stages != 0:
+                "PipelineModule needs either embed=/block=/n_blocks=/head= "
+                "or a LayerSpec list")
+        if not self.heterogeneous and self.n_blocks % self.num_stages != 0:
             raise ValueError(
                 f"n_blocks={self.n_blocks} must divide evenly over "
                 f"{self.num_stages} stages")
+
+    def build_stage_layers(self):
+        """Build every LayerSpec and group them per stage by the partition
+        boundaries (reference: _partition_layers' local layer build,
+        module.py:361). Returns list[stage] -> list of built modules."""
+        if self._layer_specs is None:
+            raise ValueError("build_stage_layers needs a LayerSpec list")
+        built = [s.build() if isinstance(s, LayerSpec) else s
+                 for s in self._layer_specs]
+        return [built[self.parts[s]:self.parts[s + 1]]
+                for s in range(self.num_stages)]
 
     # -- reference-parity partition bookkeeping ------------------------
 
@@ -183,13 +198,20 @@ class PipelineModule:
     def _try_collapse_homogeneous(self):
         """Detect [embed?] + N*Block + [head...] shape in a LayerSpec list."""
         specs = self._layer_specs
-        types = [s.typename for s in specs]
-        # longest run of one repeated type
+
+        def same(a, b):
+            # identical construction -> one shared module repeated (the
+            # stacked-scan representation requires equal param shapes)
+            return (a.typename is b.typename
+                    and a.module_args == b.module_args
+                    and a.module_kwargs == b.module_kwargs)
+
+        # longest run of one repeated (type, args) spec
         best_start, best_len = 0, 0
         i = 0
-        while i < len(types):
+        while i < len(specs):
             j = i
-            while j < len(types) and types[j] is types[i]:
+            while j < len(specs) and same(specs[j], specs[i]):
                 j += 1
             if j - i > best_len:
                 best_start, best_len = i, j - i

@@ -7,9 +7,10 @@ DataParallelSchedule (:292).
 
 On TPU the *hot path* does not interpret these instruction streams — the
 SPMD collective-permute program in pipe/engine.py bakes the schedule into
-one jitted computation. The classes are kept because (a) they document and
-test the schedule semantics (reference test_pipe_schedule.py), and (b) the
-host-driven fallback engine mode executes them directly.
+one jitted computation. The classes serve two real consumers: (a) schedule-semantics tests
+(reference test_pipe_schedule.py), and (b) the host-driven executor
+(pipe/host_engine.py HostDrivenPipelineEngine) which dispatches these
+exact instruction streams for heterogeneous LayerSpec stacks.
 """
 
 

@@ -260,6 +260,12 @@ class StreamedHostAdam:
             lambda spec: NamedSharding(mesh, spec), moment_specs,
             is_leaf=lambda x: isinstance(x, P))
         self.host_shardings = _with_host_memory_tree(self.dev_shardings)
+        # device-kind shardings for the params themselves (the h2d fetch
+        # target when offload_param keeps them host-side; the SPMD
+        # partitioner requires memory transfers to carry explicit shardings)
+        self.param_dev_shardings = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec), param_specs,
+            is_leaf=lambda x: isinstance(x, P))
         self._rep = NamedSharding(mesh, jax.sharding.PartitionSpec())
 
     def state_shardings(self):
@@ -275,12 +281,15 @@ class StreamedHostAdam:
         """apply() with the engine's global-norm clipping folded in —
         the ONE entry point for both the fused train step and the
         forward/backward/step convention, so clipping semantics cannot
-        drift between them."""
-        from ...utils.tree import clip_grads_by_global_norm
-        grads = clip_grads_by_global_norm(grads, gnorm, clip)
-        return self.apply(params, grads, state, lr)
+        drift between them. The clip factor is applied per leaf AFTER the
+        h2d fetch (host-space grad leaves cannot mix with the device
+        scalar); formula matches optax.clip_by_global_norm."""
+        factor = None
+        if clip and clip > 0:
+            factor = jnp.minimum(1.0, clip / jnp.maximum(gnorm, 1e-12))
+        return self.apply(params, grads, state, lr, grad_scale=factor)
 
-    def apply(self, params, grads, state, lr):
+    def apply(self, params, grads, state, lr, grad_scale=None):
         """Traced: one bias-corrected Adam step, streamed per leaf."""
         count = state["count"] + 1
         c = count.astype(jnp.float32)
@@ -293,13 +302,22 @@ class StreamedHostAdam:
         nu_flat = jax.tree.leaves(state["nu"])
         dev_sh = jax.tree.leaves(self.dev_shardings)
         host_sh = jax.tree.leaves(self.host_shardings)
+        pdev_sh = jax.tree.leaves(self.param_dev_shardings)
 
         new_p, new_mu, new_nu = [], [], []
-        for p, g, mu, nu, dsh, hsh in zip(p_flat, g_flat, mu_flat, nu_flat,
-                                          dev_sh, host_sh):
+        for p, g, mu, nu, dsh, hsh, psh in zip(p_flat, g_flat, mu_flat,
+                                               nu_flat, dev_sh, host_sh,
+                                               pdev_sh):
             mu_d = jax.device_put(mu, dsh)
             nu_d = jax.device_put(nu, dsh)
+            # with offload_param, p and g arrive host-space: fetch for the
+            # update math (no-op for device leaves); the train step's
+            # out_shardings place new_p back in its home space
+            g = jax.device_put(g, dsh)
+            p = jax.device_put(p, psh)
             g32 = g.astype(jnp.float32)
+            if grad_scale is not None:
+                g32 = g32 * grad_scale
             p32 = p.astype(jnp.float32)
             if not self.adamw and self.wd > 0.0:
                 g32 = g32 + self.wd * p32           # classic L2

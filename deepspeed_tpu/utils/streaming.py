@@ -1,0 +1,53 @@
+"""Host<->device parameter streaming primitives (ZeRO-Infinity analog).
+
+Reference: the ZeRO-3 parameter lifecycle — params live partitioned in
+CPU/NVMe and are fetched just-in-time per submodule
+(runtime/swap_tensor/partitioned_param_swapper.py:36,
+partitioned_param_coordinator.py:444 NVMe prefetch). TPU-native: params
+live in the accelerator host's pinned memory; ``stream_in`` is the
+just-in-time fetch, applied per scan block inside the jitted step so XLA
+overlaps block k+1's h2d with block k's compute (the coordinator's
+prefetch, scheduled by the compiler instead of hooks).
+
+Autodiff: the vjp of the h2d fetch moves the parameter cotangent back to
+host space, so gradient accumulation buffers for offloaded params live
+host-side too — device residency stays bounded by the live block.
+"""
+
+import jax
+
+
+@jax.custom_vjp
+def stream_in(x):
+    """Host -> device fetch (identity math). Under remat the fetch replays
+    in the backward recompute — the reference fetches params for the
+    backward walk the same way. The vjp returns the cotangent in the
+    PRIMAL's memory space (host params get host grads; no-op for
+    device-resident params, e.g. on the CPU test backend where memory
+    kinds don't exist)."""
+    return jax.device_put(x, jax.memory.Space.Device)
+
+
+def _stream_in_fwd(x):
+    # zero-sized residual carries the primal's memory space (aval-static)
+    return stream_in(x), x.ravel()[:0]
+
+
+def _stream_in_bwd(res, ct):
+    space = res.aval.memory_space
+    if ct.aval.memory_space == space:
+        return (ct,)
+    return (jax.device_put(ct, space),)
+
+
+stream_in.defvjp(_stream_in_fwd, _stream_in_bwd)
+
+
+def stream_in_tree(tree):
+    return jax.tree.map(stream_in, tree)
+
+
+def to_host_tree(tree):
+    """Place a pytree in host memory space (init-time placement)."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, jax.memory.Space.Host), tree)

@@ -235,3 +235,79 @@ class TestAutotuner:
         assert best.config["zero_optimization"]["stage"] != 3
         infeasible = [r for r in tuner.results if not r.feasible]
         assert len(infeasible) == 2  # both stage-3 points failed
+
+
+class TestLayerReduction:
+    """Layer reduction / distillation init (VERDICT missing #8;
+    reference: compress.py:182 student_initialization)."""
+
+    def test_scan_stacked_selection(self):
+        import dataclasses
+        from deepspeed_tpu.models import GPT, GPTConfig
+        from deepspeed_tpu.compression.compress import apply_layer_reduction
+        cfg = GPTConfig(vocab_size=64, max_seq_len=16, d_model=32,
+                        n_layers=4, n_heads=4, dtype=jnp.float32,
+                        scan_layers=True)
+        m = GPT(cfg)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        import flax.core.meta as meta
+        params = meta.unbox(m.init(jax.random.PRNGKey(0), ids))["params"]
+        student, kept = apply_layer_reduction(
+            params, {"enabled": True, "keep_number_layers": 2,
+                     "teacher_layer": [0, 3]})
+        assert kept == [0, 3]
+        for leaf_s, leaf_t in zip(jax.tree.leaves(student["h"]),
+                                  jax.tree.leaves(params["h"])):
+            assert leaf_s.shape[0] == 2
+            np.testing.assert_array_equal(np.asarray(leaf_s[1]),
+                                          np.asarray(leaf_t[3]))
+        # the student runs as a 2-layer model
+        scfg = dataclasses.replace(cfg, n_layers=2)
+        logits = GPT(scfg).apply({"params": student}, ids)
+        assert logits.shape == (1, 8, 64)
+
+    def test_unstacked_selection(self):
+        from deepspeed_tpu.compression.compress import apply_layer_reduction
+        params = {"wte": jnp.ones((8, 4)),
+                  "h_0": {"w": jnp.full((2,), 0.0)},
+                  "h_1": {"w": jnp.full((2,), 1.0)},
+                  "h_2": {"w": jnp.full((2,), 2.0)},
+                  "h_3": {"w": jnp.full((2,), 3.0)}}
+        student, kept = apply_layer_reduction(
+            params, {"enabled": True, "keep_number_layers": 2})
+        assert kept == [0, 3]
+        assert set(k for k in student if k.startswith("h_")) == {"h_0", "h_1"}
+        np.testing.assert_array_equal(np.asarray(student["h_1"]["w"]),
+                                      np.full((2,), 3.0))
+
+    def test_disabled_noop(self):
+        from deepspeed_tpu.compression.compress import apply_layer_reduction
+        p = {"h_0": {"w": jnp.ones(2)}}
+        out, kept = apply_layer_reduction(p, {})
+        assert out is p and kept is None
+
+
+def test_autotuner_persists_results(tmp_path):
+    """VERDICT weak #9: results survive the process for offline analysis
+    (reference: per-experiment jsons + the best-config file)."""
+    import json
+    from deepspeed_tpu.autotuning import Autotuner
+
+    class FakeEngine:
+        def __init__(self, cfg):
+            self.cfg = cfg
+
+        def train_batch(self, batch):
+            pass
+
+    tuner = Autotuner(make_engine=FakeEngine, make_batch=lambda c: None,
+                      warmup_steps=0, measure_steps=1,
+                      results_dir=str(tmp_path))
+    best = tuner.tune({"optimizer": {"type": "Adam", "params": {}}},
+                      zero_stages=(0, 1), micro_batches=(1,),
+                      tuner_type="gridsearch")
+    exps = sorted((tmp_path / "exps").glob("exp_*.json"))
+    assert len(exps) == 2
+    with open(tmp_path / "best_config.json") as f:
+        saved = json.load(f)
+    assert saved["config"] == best.config

@@ -207,3 +207,66 @@ def test_chunked_loss_matches_full():
     np.testing.assert_allclose(float(lc), float(lf), rtol=1e-6)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         a, b, rtol=1e-5, atol=1e-6), gc, gf)
+
+
+class TestCrossTopologyRestore:
+    """Universal checkpoint, live (VERDICT #6): save on mesh A, restore
+    into an engine on mesh B with different dp/tp factorization; loss and
+    optimizer state must carry over exactly (reference: engine.py:2472
+    dp/mp resize rules + :714 load_universal_checkpoint)."""
+
+    @staticmethod
+    def _engine(mesh_axes, zero_stage=2, offload=False):
+        from deepspeed_tpu.comm import MeshSpec, build_mesh
+        cfg = GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ, d_model=32,
+                        n_layers=2, n_heads=4, dtype=jnp.float32,
+                        scan_layers=True)
+        zcfg = {"stage": zero_stage}
+        if offload:
+            zcfg["offload_optimizer"] = {"device": "cpu"}
+        mesh = build_mesh(MeshSpec(**mesh_axes))
+        engine, _, _, _ = ds.initialize(
+            model=GPT(cfg), config={
+                "train_batch_size": 8,
+                "train_micro_batch_size_per_gpu": 8 // (
+                    mesh_axes.get("data", 1) * mesh_axes.get("fsdp", 1)),
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": zcfg, "steps_per_print": 1000},
+            loss_fn=loss_fn, sample_batch=make_batch(1),
+            rng=jax.random.PRNGKey(0), mesh=mesh)
+        return engine
+
+    @pytest.mark.parametrize("offload", [False, True],
+                             ids=["optax", "streamed_offload"])
+    def test_save_dp8_restore_dp4xtp2(self, tmp_path, offload):
+        batch = make_batch(8, seed=5)
+        a = self._engine({"data": 8}, offload=offload)
+        for _ in range(3):
+            a.train_batch(batch)
+        want_eval = float(a.eval_batch(batch))
+        a.save_checkpoint(str(tmp_path))
+
+        b = self._engine({"data": 4, "model": 2}, offload=offload)
+        path, _ = b.load_checkpoint(str(tmp_path))
+        assert path is not None
+        assert b.global_steps == 3
+        # same weights, new topology: identical eval loss
+        got_eval = float(b.eval_batch(batch))
+        np.testing.assert_allclose(got_eval, want_eval, rtol=1e-5)
+        # optimizer state carried over: the next step must match a
+        # continued run on mesh A step-for-step
+        la = float(a.train_batch(batch))
+        lb = float(b.train_batch(batch))
+        np.testing.assert_allclose(lb, la, rtol=1e-4)
+
+    def test_save_fsdp_restore_data(self, tmp_path):
+        batch = make_batch(8, seed=6)
+        a = self._engine({"fsdp": 4, "data": 2}, zero_stage=3)
+        for _ in range(2):
+            a.train_batch(batch)
+        a.save_checkpoint(str(tmp_path))
+        b = self._engine({"data": 8}, zero_stage=1)
+        b.load_checkpoint(str(tmp_path))
+        np.testing.assert_allclose(float(b.eval_batch(batch)),
+                                   float(a.eval_batch(batch)), rtol=1e-5)

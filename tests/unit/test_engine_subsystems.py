@@ -382,3 +382,83 @@ class TestStreamedHostOffload:
                 "optimizer": {"type": "SGD", "params": {"lr": 1e-3}},
                 "zero_optimization": {
                     "stage": 1, "offload_optimizer": {"device": "cpu"}}})
+
+
+class TestParamOffload:
+    """ZeRO-Infinity parameter offload (VERDICT #4; reference:
+    partitioned_param_swapper.py:36 + partitioned_param_coordinator.py:444).
+    On the CPU backend memory spaces are a no-op, so these prove the
+    streaming path (nn.map_variables fetch + host-space grad buffers +
+    streamed optimizer) computes EXACTLY what the resident path does; the
+    device-residency proof runs on real TPU memory kinds."""
+
+    @staticmethod
+    def _train(offload_param, steps=3):
+        cfg = GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ, d_model=32,
+                        n_layers=2, n_heads=4, dtype=jnp.float32,
+                        scan_layers=True, remat="full")
+        zcfg = {"stage": 2,
+                "offload_optimizer": {"device": "cpu"}}
+        if offload_param:
+            zcfg["offload_param"] = {"device": "cpu"}
+        engine = make_engine(extra={"zero_optimization": zcfg,
+                                    "gradient_clipping": 1.0},
+                             model_cfg=cfg)
+        batch = make_batch(16, seed=11)
+        losses = [float(engine.train_batch(batch)) for _ in range(steps)]
+        return engine, losses
+
+    def test_streamed_params_match_resident(self):
+        ea, la = self._train(False)
+        eb, lb = self._train(True)
+        assert eb.module.config.offload_params
+        np.testing.assert_allclose(lb, la, rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(ea.params),
+                        jax.tree.leaves(eb.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-6, atol=2e-6)
+
+    def test_requires_offload_optimizer(self):
+        from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+        with pytest.raises(DeepSpeedConfigError, match="offload_optimizer"):
+            make_engine(extra={"zero_optimization": {
+                "stage": 2, "offload_param": {"device": "cpu"}}})
+
+    def test_loss_decreases(self):
+        _, losses = self._train(True, steps=5)
+        assert losses[-1] < losses[0]
+
+
+@pytest.mark.skipif(jax.default_backend() == "cpu",
+                    reason="memory kinds need a real TPU")
+def test_param_offload_device_residency():
+    """On real TPU memory kinds: offloaded block params must not count
+    toward device argument bytes — device residency ~ one block + embeds
+    (VERDICT #4 'compiled-memory test')."""
+    cfg = GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ, d_model=64,
+                    n_layers=4, n_heads=4, dtype=jnp.float32,
+                    scan_layers=True, remat="full")
+    base = {"zero_optimization": {
+        "stage": 2, "offload_optimizer": {"device": "cpu"}}}
+    off = {"zero_optimization": {
+        "stage": 2, "offload_optimizer": {"device": "cpu"},
+        "offload_param": {"device": "cpu"}}}
+
+    def arg_bytes(extra):
+        engine = make_engine(extra=extra, model_cfg=cfg)
+        batch = make_batch(16, seed=0)
+        gas = engine.config.gradient_accumulation_steps
+        micro = (engine.config.train_micro_batch_size_per_gpu
+                 * engine.dp_world_size)
+        batch = {k: v.reshape(gas, micro, *v.shape[1:])
+                 for k, v in batch.items()}
+        placed = engine._place_batch(batch, with_gas_dim=True)
+        from deepspeed_tpu.runtime.fp16.loss_scaler import init_loss_scale
+        lowered = engine._make_train_step().lower(
+            engine.params, engine.optimizer_state, init_loss_scale(1.0),
+            placed, jax.random.fold_in(engine.rng, 1), {})
+        return lowered.compile().memory_analysis().argument_size_in_bytes
+
+    resident = arg_bytes(base)
+    offloaded = arg_bytes(off)
+    assert offloaded < 0.7 * resident, (offloaded, resident)

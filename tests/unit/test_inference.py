@@ -202,3 +202,176 @@ class TestCheckpointServing:
         params = m.init(jax.random.PRNGKey(0), ids)["params"]
         with pytest.raises(ValueError, match="max_seq_len"):
             generate(m, params, ids, max_new_tokens=8)
+
+
+class TestInt8Serving:
+    """Weight-only int8 serving path (VERDICT missing #3; reference:
+    module_quantize.py + the *_int8 inference gemms)."""
+
+    def _model(self):
+        cfg = GPTConfig(vocab_size=97, max_seq_len=64, d_model=64,
+                        n_layers=2, n_heads=2, dtype=jnp.float32)
+        m = GPT(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(0), (2, 10), 0, 97)
+        params = m.init(jax.random.PRNGKey(0), ids)["params"]
+        return m, params, ids
+
+    def test_quantize_roundtrip_error_bounded(self):
+        from deepspeed_tpu.module_inject.module_quantize import (
+            quantize_param_tree, dequantize_param_tree)
+        _, params, _ = self._model()
+        q = quantize_param_tree(params, min_size=256, dtype=jnp.float32)
+        deq = dequantize_param_tree(q, dtype=jnp.float32)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(deq)):
+            a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+            # symmetric per-channel int8: error <= scale/2 = max|w|/254
+            assert np.max(np.abs(a - b)) <= np.max(np.abs(a)) / 254 + 1e-6
+
+    def test_engine_generates_and_halves_bytes(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.module_inject.module_quantize import \
+            quantized_nbytes
+        m, params, ids = self._model()
+        dense = deepspeed_tpu.init_inference(m, params=params,
+                                             dtype=jnp.float32)
+        q = deepspeed_tpu.init_inference(m, params=params,
+                                         dtype=jnp.float32,
+                                         quantize_weights=True,
+                                         quantize_min_size=256)
+        nb = quantized_nbytes(q.params)
+        # int8 + scales must be well under the bf16-dense equivalent
+        assert nb["quantized"] < 0.6 * nb["dense_equivalent"], nb
+        out_d = dense.generate(ids, max_new_tokens=6)
+        out_q = q.generate(ids, max_new_tokens=6)
+        assert out_q.shape == out_d.shape
+        # int8 is lossy: require a majority of greedy tokens to agree
+        agree = (np.asarray(out_d) == np.asarray(out_q)).mean()
+        assert agree > 0.7, agree
+
+
+class TestMoEServing:
+    """MoE inference (VERDICT missing #2; reference:
+    DeepSpeedMoEInference, moe_inference.py:205): generate() on an
+    expert-parallel MoEGPT over the expert mesh axis."""
+
+    def test_moe_generate_matches_full_forward(self):
+        from deepspeed_tpu.comm import MeshSpec, build_mesh
+        from deepspeed_tpu.models.moe_gpt import MoEGPT, MoEGPTConfig
+        mesh = build_mesh(MeshSpec(expert=4, data=2))
+        cfg = MoEGPTConfig(
+            base=GPTConfig(vocab_size=97, max_seq_len=64, d_model=32,
+                           n_layers=2, n_heads=2, dtype=jnp.float32,
+                           scan_layers=False),
+            num_experts=4, k=1, capacity_factor=2.0,
+            eval_capacity_factor=2.0, moe_interval=2)
+        m = MoEGPT(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 97)
+        params = m.init(jax.random.PRNGKey(0), ids)["params"]
+        out = generate(m, params, ids, max_new_tokens=4, temperature=0.0)
+        cur = ids
+        for _ in range(4):
+            lg, _aux = m.apply({"params": params}, cur)
+            nxt = jnp.argmax(lg[:, -1, :], axis=-1)
+            cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+    def test_moe_engine_generate(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.comm import MeshSpec, build_mesh
+        from deepspeed_tpu.models.moe_gpt import MoEGPT, MoEGPTConfig
+        mesh = build_mesh(MeshSpec(expert=4, data=2))
+        cfg = MoEGPTConfig(
+            base=GPTConfig(vocab_size=97, max_seq_len=64, d_model=32,
+                           n_layers=2, n_heads=2, dtype=jnp.float32,
+                           scan_layers=False),
+            num_experts=4, k=2, capacity_factor=2.0,
+            eval_capacity_factor=2.0, moe_interval=1)
+        m = MoEGPT(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 97)
+        params = m.init(jax.random.PRNGKey(0), ids)["params"]
+        eng = deepspeed_tpu.init_inference(m, params=params,
+                                           dtype=jnp.float32, mesh=mesh)
+        out = eng.generate(ids, max_new_tokens=4)
+        assert out.shape == (4, 12)
+
+
+class TestMegatronLoader:
+    """Versioned Megatron state-dict loader with TP merge/split (VERDICT
+    missing #6; reference: state_dict_factory.py:17 SDLoaderFactory,
+    :197 MegatronSDLoader, qkv merge :252 / split :320)."""
+
+    @staticmethod
+    def _full_sd(rng, layers=2, d=32, ff=128, vocab=96, pos=64):
+        sd = {"word_embeddings.weight": rng.standard_normal((vocab, d)),
+              "position_embeddings.weight": rng.standard_normal((pos, d)),
+              "transformer.final_layernorm.weight": rng.standard_normal(d),
+              "transformer.final_layernorm.bias": rng.standard_normal(d)}
+        for i in range(layers):
+            lp = f"transformer.layers.{i}."
+            sd.update({
+                lp + "input_layernorm.weight": rng.standard_normal(d),
+                lp + "input_layernorm.bias": rng.standard_normal(d),
+                lp + "post_attention_layernorm.weight": rng.standard_normal(d),
+                lp + "post_attention_layernorm.bias": rng.standard_normal(d),
+                lp + "attention.query_key_value.weight":
+                    rng.standard_normal((3 * d, d)),
+                lp + "attention.query_key_value.bias":
+                    rng.standard_normal(3 * d),
+                lp + "attention.dense.weight": rng.standard_normal((d, d)),
+                lp + "attention.dense.bias": rng.standard_normal(d),
+                lp + "mlp.dense_h_to_4h.weight": rng.standard_normal((ff, d)),
+                lp + "mlp.dense_h_to_4h.bias": rng.standard_normal(ff),
+                lp + "mlp.dense_4h_to_h.weight": rng.standard_normal((d, ff)),
+                lp + "mlp.dense_4h_to_h.bias": rng.standard_normal(d),
+            })
+        return {k: np.asarray(v, np.float32) for k, v in sd.items()}
+
+    def test_split_merge_roundtrip_v1(self):
+        from deepspeed_tpu.runtime.state_dict_factory import MegatronSDLoader
+        rng = np.random.default_rng(0)
+        full = self._full_sd(rng)
+        loader = MegatronSDLoader([], version=1.0)
+        shards = [loader.split_state_dict(full, 4, r) for r in range(4)]
+        # v1.0 shard layout: each rank's qkv is [q_r; k_r; v_r]
+        qw = "transformer.layers.0.attention.query_key_value.weight"
+        d = full[qw].shape[1]
+        q_full = full[qw][:d]
+        np.testing.assert_array_equal(shards[1][qw][:d // 4],
+                                      q_full[d // 4: 2 * d // 4])
+        merged = MegatronSDLoader([], version=1.0).merge_state_dict(shards)
+        for k in full:
+            np.testing.assert_array_equal(merged[k], full[k], err_msg=k)
+
+    def test_split_merge_roundtrip_v2(self):
+        from deepspeed_tpu.runtime.state_dict_factory import MegatronSDLoader
+        rng = np.random.default_rng(1)
+        full = self._full_sd(rng)
+        loader = MegatronSDLoader([], version=2.0)
+        shards = [loader.split_state_dict(full, 2, r) for r in range(2)]
+        merged = loader.merge_state_dict(shards)
+        for k in full:
+            np.testing.assert_array_equal(merged[k], full[k], err_msg=k)
+
+    def test_loader_factory_and_serving(self, tmp_path):
+        """Merged Megatron shards serve through our GPT: mp=2 shards ==
+        the unsharded model's logits."""
+        from deepspeed_tpu.runtime.state_dict_factory import (
+            SDLoaderFactory, MegatronSDLoader)
+        from deepspeed_tpu.module_inject import load_megatron_checkpoint
+        rng = np.random.default_rng(2)
+        full = self._full_sd(rng)
+        splitter = MegatronSDLoader([], version=1.0)
+        shards = [splitter.split_state_dict(full, 2, r) for r in range(2)]
+
+        mod_a, params_a = load_megatron_checkpoint([full], n_heads=4,
+                                                   dtype=jnp.float32)
+        mod_b, params_b = load_megatron_checkpoint(shards, n_heads=4,
+                                                   dtype=jnp.float32)
+        ids = jnp.asarray(rng.integers(0, 96, (2, 10)), jnp.int32)
+        la = mod_a.apply({"params": params_a}, ids)
+        lb = mod_b.apply({"params": params_b}, ids)
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=1e-5, atol=1e-5)
+        # generation runs on the loaded model
+        out = generate(mod_b, params_b, ids, max_new_tokens=3)
+        assert out.shape == (2, 13)

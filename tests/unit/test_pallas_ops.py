@@ -236,3 +236,83 @@ class TestDecodeAttention:
         np.testing.assert_allclose(np.asarray(out_d[:, 0]),
                                    np.asarray(out_full[:, -1]),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestFusedLamb:
+    """Pallas fused LAMB (VERDICT #8; reference:
+    csrc/lamb/fused_lamb_cuda.cpp:108 in-kernel trust-ratio reductions)."""
+
+    def test_matches_optax_lamb(self):
+        from deepspeed_tpu.ops.pallas import fused_lamb
+        import optax
+        rng = np.random.default_rng(0)
+        params = {"w": jnp.asarray(rng.standard_normal((130, 33)),
+                                   jnp.float32),
+                  # >1 grid block with a ragged tail: the in-kernel norm
+                  # reductions must not fold block padding into the trust
+                  # ratio (1200*129 elems -> 1210 lanes-rows vs 1024/block)
+                  "big": jnp.asarray(rng.standard_normal((1200, 129)),
+                                     jnp.float32),
+                  "b": jnp.asarray(rng.standard_normal(17), jnp.float32)}
+        ref = optax.lamb(1e-2, weight_decay=0.01, eps=1e-6)
+        fus = fused_lamb(1e-2, weight_decay=0.01, eps=1e-6)
+        sr, sf = ref.init(params), fus.init(params)
+        pr = pf = params
+        for step in range(4):
+            g = jax.tree.map(
+                lambda p: jnp.asarray(
+                    rng.standard_normal(p.shape), jnp.float32), params)
+            ur, sr = ref.update(g, sr, pr)
+            pr = optax.apply_updates(pr, ur)
+            uf, sf = fus.update(g, sf, pf)
+            pf = optax.apply_updates(pf, uf)
+            for a, b in zip(jax.tree.leaves(pr), jax.tree.leaves(pf)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=2e-5, atol=2e-6)
+
+    def test_registry_resolves_fused_lamb(self):
+        from deepspeed_tpu.runtime.optimizers import build_optimizer
+        tx = build_optimizer("FusedLamb", {"lr": 1e-3})
+        p = {"w": jnp.ones((8, 8))}
+        s = tx.init(p)
+        u, s = tx.update({"w": jnp.ones((8, 8))}, s, p)
+        assert jnp.all(jnp.isfinite(u["w"]))
+
+
+class TestOneBitLamb:
+    def test_warmup_matches_exact_lamb(self):
+        from deepspeed_tpu.runtime.comm_compression import onebit_lamb
+        import optax
+        rng = np.random.default_rng(2)
+        p0 = {"w": jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)}
+        ob = onebit_lamb(1e-2, freeze_step=100, eps=1e-6)
+        ref = optax.lamb(1e-2, eps=1e-6)
+        so, sr = ob.init(p0), ref.init(p0)
+        po = pr = p0
+        for _ in range(3):
+            g = {"w": jnp.asarray(rng.standard_normal((64, 16)), jnp.float32)}
+            uo, so = ob.update(g, so, po)
+            po = optax.apply_updates(po, uo)
+            ur, sr = ref.update(g, sr, pr)
+            pr = optax.apply_updates(pr, ur)
+        np.testing.assert_allclose(np.asarray(po["w"]), np.asarray(pr["w"]),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_post_freeze_compresses_and_freezes(self):
+        from deepspeed_tpu.runtime.comm_compression import onebit_lamb
+        rng = np.random.default_rng(3)
+        p = {"w": jnp.asarray(rng.standard_normal((32, 8)), jnp.float32)}
+        ob = onebit_lamb(1e-2, freeze_step=2, eps=1e-6)
+        s = ob.init(p)
+        for i in range(5):
+            g = {"w": jnp.asarray(rng.standard_normal((32, 8)), jnp.float32)}
+            u, s = ob.update(g, s, p)
+            p = {"w": p["w"] + u["w"]}
+            if i == 1:
+                nu_frozen = np.asarray(s.nu["w"]).copy()
+                ratio_frozen = float(s.frozen_ratio["w"])
+        # variance and trust ratio frozen after step 2
+        np.testing.assert_array_equal(np.asarray(s.nu["w"]), nu_frozen)
+        assert float(s.frozen_ratio["w"]) == ratio_frozen
+        # error feedback is live (non-zero residual)
+        assert float(jnp.max(jnp.abs(s.error["w"]))) > 0

@@ -191,3 +191,109 @@ def test_pipeline_module_layer_spec_collapse():
     assert module.embed is not None and module.head is not None
     assert module.stage_of_layer(0) == 0
     assert module.stage_of_layer(3) == 1
+
+
+# ------------------------------------------- host-driven schedule executor
+
+class TestHostDrivenPipeline:
+    """The 1F1B instruction-stream executor (VERDICT #9: the host-driven
+    mode the docstrings promise; reference: _exec_schedule
+    pipe/engine.py:1354 + _INSTRUCTION_MAP :1341). Unlocks heterogeneous
+    LayerSpec stacks that the fused SPMD path cannot scan."""
+
+    @staticmethod
+    def _hetero_module(stages=2):
+        # middle blocks DIFFER (d_ff 64 vs 128): cannot collapse to a scan
+        specs = [LayerSpec(GPTEmbed, MCFG),
+                 LayerSpec(Block, n_heads=4, d_model=D, d_ff=64,
+                           causal=True, dtype=jnp.float32),
+                 LayerSpec(Block, n_heads=4, d_model=D, d_ff=128,
+                           causal=True, dtype=jnp.float32),
+                 LayerSpec(GPTHead, MCFG)]
+        return PipelineModule(layers=specs, num_stages=stages,
+                              loss_fn=pipe_loss_fn,
+                              partition_method="uniform")
+
+    def test_heterogeneous_module_flagged(self):
+        m = self._hetero_module()
+        assert m.heterogeneous
+        layers = m.build_stage_layers()
+        assert len(layers) == 2 and sum(len(l) for l in layers) == 4
+
+    def test_heterogeneous_trains(self):
+        module = self._hetero_module()
+        config = {"train_batch_size": 8, "gradient_accumulation_steps": 2,
+                  "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                  "steps_per_print": 1000}
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, VOCAB, size=(8, SEQ),
+                                           dtype=np.int32)}
+        engine, _, _, _ = ds.initialize(
+            model=module, config=config, loss_fn=pipe_loss_fn,
+            sample_batch={"input_ids": batch["input_ids"][:1]},
+            rng=jax.random.PRNGKey(3))
+        from deepspeed_tpu.runtime.pipe.host_engine import \
+            HostDrivenPipelineEngine
+        assert isinstance(engine, HostDrivenPipelineEngine)
+        losses = [float(engine.train_batch(batch)) for _ in range(8)]
+        assert losses[-1] < losses[0] - 0.05, losses
+
+    def test_executor_matches_sequential(self):
+        """Loss from the instruction-stream execution == running the same
+        stages sequentially with the same params."""
+        module = self._hetero_module()
+        config = {"train_batch_size": 8, "gradient_accumulation_steps": 4,
+                  "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                  "steps_per_print": 1000}
+        rng = np.random.default_rng(1)
+        batch = {"input_ids": rng.integers(0, VOCAB, size=(8, SEQ),
+                                           dtype=np.int32)}
+        engine, _, _, _ = ds.initialize(
+            model=module, config=config, loss_fn=pipe_loss_fn,
+            sample_batch={"input_ids": batch["input_ids"][:1]},
+            rng=jax.random.PRNGKey(3))
+        want = float(engine.eval_batch(batch))
+        got = float(engine.train_batch(batch))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_pp_zero_memory_composition():
+    """PP x ZeRO memory-analysis (VERDICT #9): with the stage axis active,
+    ZeRO-1 still shrinks per-device optimizer-state bytes vs stage 0
+    (mirrors the dense engine's test_engine_subsystems.py stage proof)."""
+    def compiled_stats(zero_stage):
+        engine, batch = make_pipe_engine(stages=2, n_micro=2)
+        if zero_stage:
+            # rebuild with the zero block set
+            module = PipelineModule(
+                embed=GPTEmbed(MCFG), block=Block(
+                    n_heads=MCFG.n_heads, d_model=MCFG.d_model,
+                    d_ff=MCFG.ffn_dim, causal=True, dtype=jnp.float32),
+                n_blocks=MCFG.n_layers, head=GPTHead(MCFG),
+                num_stages=2, loss_fn=pipe_loss_fn)
+            mesh = build_mesh(MeshSpec(stage=2, data=4))
+            config = {"train_batch_size": 16,
+                      "gradient_accumulation_steps": 2,
+                      "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                      "zero_optimization": {"stage": zero_stage},
+                      "steps_per_print": 1000, "mesh": {"stage": 2}}
+            rng = np.random.default_rng(0)
+            batch = {"input_ids": rng.integers(
+                0, VOCAB, size=(16, SEQ), dtype=np.int32)}
+            engine, _, _, _ = ds.initialize(
+                model=module, config=config, loss_fn=pipe_loss_fn,
+                sample_batch={"input_ids": batch["input_ids"][:1]},
+                rng=jax.random.PRNGKey(7), mesh=mesh)
+        from deepspeed_tpu.runtime.fp16.loss_scaler import init_loss_scale
+        placed = engine._place_batch(batch, with_gas_dim=False)
+        lowered = engine._make_train_step().lower(
+            engine.params, engine.optimizer_state,
+            init_loss_scale(1.0), placed,
+            jax.random.fold_in(engine.rng, 1))
+        return lowered.compile().memory_analysis()
+
+    m0 = compiled_stats(0)
+    m1 = compiled_stats(1)
+    assert m1.argument_size_in_bytes < m0.argument_size_in_bytes, (
+        f"PPxZeRO1 args {m1.argument_size_in_bytes} !< "
+        f"PP stage0 {m0.argument_size_in_bytes}")
