@@ -102,6 +102,42 @@ def init_inference(model=None, **kwargs):
     return InferenceEngine(model, **kwargs)
 
 
+def _lazy_exports():
+    """Reference facade names (deepspeed/__init__.py:27-49) resolved on
+    first use so importing the package stays light."""
+    return {
+        "zero": lambda: __import__(
+            "deepspeed_tpu.runtime.zero", fromlist=["zero"]),
+        "moe": lambda: __import__("deepspeed_tpu.moe", fromlist=["moe"]),
+        "PipelineModule": lambda: _from(
+            "deepspeed_tpu.runtime.pipe.module", "PipelineModule"),
+        "LayerSpec": lambda: _from(
+            "deepspeed_tpu.runtime.pipe.module", "LayerSpec"),
+        "TiedLayerSpec": lambda: _from(
+            "deepspeed_tpu.runtime.pipe.module", "TiedLayerSpec"),
+        "OnDevice": lambda: _from(
+            "deepspeed_tpu.utils.init_on_device", "OnDevice"),
+        "DeepSpeedTransformerLayer": lambda: _from(
+            "deepspeed_tpu.ops.transformer", "DeepSpeedTransformerLayer"),
+        "DeepSpeedTransformerConfig": lambda: _from(
+            "deepspeed_tpu.ops.transformer", "DeepSpeedTransformerConfig"),
+        "log_dist": lambda: _from("deepspeed_tpu.utils.logging", "log_dist"),
+    }
+
+
+def _from(mod, name):
+    return getattr(__import__(mod, fromlist=[name]), name)
+
+
+def __getattr__(name):
+    factory = _lazy_exports().get(name)
+    if factory is None:
+        raise AttributeError(f"module 'deepspeed_tpu' has no attribute {name!r}")
+    value = factory()
+    globals()[name] = value
+    return value
+
+
 def add_config_arguments(parser):
     """argparse integration (reference: deepspeed/__init__.py:206)."""
     group = parser.add_argument_group("DeepSpeed-TPU",

@@ -34,6 +34,9 @@ class BertConfig:
     remat: str = "none"
     attn_backend: Optional[str] = None
     activation: str = "gelu_exact"  # HF BERT uses exact GELU
+    # block-sparse attention pattern (set via SparseAttentionUtils.
+    # replace_model_self_attention_with_sparse_self_attention)
+    sparsity_config: Any = None
 
     @property
     def ffn_dim(self):
@@ -88,7 +91,8 @@ class BertEncoder(nn.Module):
             causal=False, pre_ln=cfg.pre_ln, dropout_rate=cfg.dropout_rate,
             attn_dropout_rate=cfg.attn_dropout_rate, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, ln_epsilon=cfg.ln_epsilon,
-            attn_backend=cfg.attn_backend, activation=cfg.activation)
+            attn_backend=cfg.attn_backend, activation=cfg.activation,
+            sparsity_config=cfg.sparsity_config)
 
         block_cls = Block
         if cfg.remat != "none":

@@ -129,6 +129,7 @@ class SelfAttention(nn.Module):
     attn_backend: Optional[str] = None
     alibi: bool = False
     seq_parallel: Optional[str] = None   # None=auto, "ulysses", "ring", "none"
+    sparsity_config: Any = None          # SparsityConfig -> block-sparse path
 
     @nn.compact
     def __call__(self, x, mask=None, bias=None, deterministic=True,
@@ -233,10 +234,30 @@ class SelfAttention(nn.Module):
         if self.dropout_rate > 0.0 and not deterministic:
             dropout_rng = self.make_rng("dropout")
 
-        out = attention(q, k, v, bias=bias, mask=mask, causal=causal,
-                        dropout_rate=self.dropout_rate, dropout_rng=dropout_rng,
-                        deterministic=deterministic, backend=self.attn_backend,
-                        seq_parallel=self.seq_parallel)
+        if self.sparsity_config is not None and not decode:
+            # Block-sparse pattern path (reference: SparseSelfAttention
+            # wired into BERT via SparseAttentionUtils). The layout encodes
+            # causality for unidirectional configs; additive bias (ALiBi)
+            # and attention dropout have no reference sparse analog.
+            if bias is not None:
+                raise ValueError("sparse attention does not take an additive "
+                                 "bias (disable alibi or sparsity_config)")
+            if causal and getattr(self.sparsity_config, "attention",
+                                  "bidirectional") != "unidirectional":
+                raise ValueError(
+                    "causal attention needs a sparsity config with "
+                    "attention='unidirectional' (the layout encodes "
+                    "causality)")
+            from ..ops.sparse_attention import sparse_attention
+            out = sparse_attention(q, k, v, self.sparsity_config,
+                                   attn_mask=mask)
+        else:
+            out = attention(q, k, v, bias=bias, mask=mask, causal=causal,
+                            dropout_rate=self.dropout_rate,
+                            dropout_rng=dropout_rng,
+                            deterministic=deterministic,
+                            backend=self.attn_backend,
+                            seq_parallel=self.seq_parallel)
         out = out.reshape(b, s, self.d_model)
         out = activation_constraint(out, ("batch", "seq", "embed"))
         return nn.DenseGeneral(
@@ -314,6 +335,7 @@ class Block(nn.Module):
     attn_use_bias: Optional[bool] = None  # None -> use_bias (GPT-J: False)
     alibi: bool = False
     seq_parallel: Optional[str] = None
+    sparsity_config: Any = None
 
     @nn.compact
     def __call__(self, x, mask=None, bias=None, deterministic=True,
@@ -326,6 +348,7 @@ class Block(nn.Module):
                              rotary_dim=self.rotary_dim,
                              attn_backend=self.attn_backend,
                              alibi=self.alibi, seq_parallel=self.seq_parallel,
+                             sparsity_config=self.sparsity_config,
                              name="attn")
         mlp_cls = self.mlp_factory or (lambda name: MLP(
             d_model=self.d_model, d_ff=self.d_ff, dtype=self.dtype,

@@ -36,6 +36,10 @@ from ._common import interpret_mode as _interpret
 def _softmax_tile(q, k, scale, causal, q_offset):
     """[Bq,d]x[S,d] -> probability tile [Bq,S] (fp32) and the row stats.
 
+    q/k stay in their native dtype (bf16 in the hot path) so the MXU runs
+    at its bf16 rate; accumulation is fp32 via preferred_element_type —
+    the same bf16-in/fp32-acc contract as the XLA einsum path.
+
     ``q_offset`` already includes the bottom-right causal alignment shift
     (sk - sq), matching the reference backend's ``tril(..., k_len - q_len)``
     so both backends agree when sk != sq (decode with KV cache)."""
@@ -51,24 +55,71 @@ def _softmax_tile(q, k, scale, causal, q_offset):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
-                causal_shift):
-    q = q_ref[0, 0].astype(jnp.float32)                # [Bq, d]
-    k = k_ref[0, 0].astype(jnp.float32)                # [S, d]
-    v = v_ref[0, 0].astype(jnp.float32)
-    p, l = _softmax_tile(q, k, scale, causal,
-                         pl.program_id(2) * block_q + causal_shift)
-    o = jnp.dot(p, v, preferred_element_type=jnp.float32) / l
-    o_ref[0, 0] = o.astype(o_ref.dtype)
+                block_k, causal_shift):
+    """Online-softmax flash forward: fori_loop over K blocks so the score
+    tile is [Bq, Bk] (VMEM-bounded for any S) and, in causal mode, blocks
+    strictly above the diagonal are never computed (dynamic trip count —
+    q rows near the top do ~1 block, the bottom does S/Bk)."""
+    q = q_ref[0, 0]                                    # [Bq, d] native dtype
+    d = q.shape[-1]
+    sk = k_ref.shape[2]
+    nkb = sk // block_k
+    q_off = pl.program_id(2) * block_q + causal_shift
+
+    def body(j, carry):
+        acc, m_acc, l_acc = carry
+        ks = pl.ds(j * block_k, block_k)
+        k = k_ref[0, 0, ks, :]
+        v = v_ref[0, 0, ks, :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_off
+            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
+                + j * block_k
+            s = jnp.where(col <= row, s, NEG_INF)
+        m_new = jnp.maximum(m_acc, jnp.max(s, axis=-1, keepdims=True))
+        # rows with no visible key yet (m still -inf, e.g. shifted-causal
+        # top rows) must contribute p=0, not exp(-inf - -inf) = 1
+        p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_acc - m_new)
+        l_new = l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # PV matmul in the value dtype (bf16 MXU rate); probs are in [0,1]
+        # so the downcast loses at most 2^-9 relative — inside bf16 noise
+        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                    preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    if causal:
+        # last k block the bottom row of this q tile can see
+        trips = jnp.clip((q_off + block_q - 1) // block_k + 1, 1, nkb)
+    else:
+        trips = nkb
+    acc, m, l = jax.lax.fori_loop(
+        0, trips, body,
+        (jnp.zeros((block_q, d), jnp.float32),
+         jnp.full((block_q, 1), NEG_INF, jnp.float32),
+         jnp.zeros((block_q, 1), jnp.float32)))
+    l = jnp.where(l > 0.0, l, 1.0)   # fully-masked rows (shifted causal)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def _pick_block_k(sk, want=512):
+    """Largest divisor of sk <= want keeping 128 alignment; whole-S rows
+    for ragged lengths."""
+    bk = math.gcd(sk, min(want, sk))
+    return bk if bk % 128 == 0 or bk == sk else sk
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, sq)
+    block_k = _pick_block_k(sk)
     grid = (b, h, pl.cdiv(sq, block_q))
     return pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, causal_shift=sk - sq),
+                          block_q=block_q, block_k=block_k,
+                          causal_shift=sk - sq),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -85,33 +136,37 @@ def _flash_fwd(q, k, v, scale, causal, block_q):
 def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref,
                 dq_ref, dk_ref, dv_ref, *, scale, causal, block_q, seq_q,
                 causal_shift):
-    k = k_ref[0, 0].astype(jnp.float32)                # [S, d]
-    v = v_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0]                                    # [S, d] native dtype
+    v = v_ref[0, 0]
 
     def body(i, carry):
         dk_acc, dv_acc = carry
         qs = pl.ds(i * block_q, block_q)
-        q = q_ref[0, 0, qs, :].astype(jnp.float32)     # [Bq, d]
+        q = q_ref[0, 0, qs, :]                         # [Bq, d]
         o = o_ref[0, 0, qs, :].astype(jnp.float32)
-        do = do_ref[0, 0, qs, :].astype(jnp.float32)
+        do = do_ref[0, 0, qs, :]
 
         p_un, l = _softmax_tile(q, k, scale, causal,
                                 i * block_q + causal_shift)
-        p = p_un / l                                   # [Bq, S]
+        p = p_un / l                                   # [Bq, S] fp32
 
-        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+        delta = jnp.sum(do.astype(jnp.float32) * o, axis=-1, keepdims=True)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
+        # operand downcast for the three grad matmuls (fp32 accumulate):
+        # the bf16-in/fp32-acc contract standard flash backwards use
+        dsl = ds.astype(q.dtype)
+        pl_ = p.astype(do.dtype)
 
         dq_ref[0, 0, qs, :] = jnp.dot(
-            ds, k, preferred_element_type=jnp.float32).astype(dq_ref.dtype)
-        dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        dv_acc = dv_acc + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+            dsl, k, preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        dk_acc = dk_acc + jnp.dot(dsl.T, q, preferred_element_type=jnp.float32)
+        dv_acc = dv_acc + jnp.dot(pl_.T, do, preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 
     dk_acc, dv_acc = jax.lax.fori_loop(
         0, seq_q // block_q, body,
-        (jnp.zeros_like(k), jnp.zeros_like(v)))
+        (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)))
     dk_ref[0, 0] = dk_acc.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
 

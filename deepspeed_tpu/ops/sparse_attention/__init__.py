@@ -3,8 +3,11 @@ from .sparsity_config import (SparsityConfig, DenseSparsityConfig,
                               BigBirdSparsityConfig,
                               BSLongformerSparsityConfig)
 from .sparse_self_attention import SparseSelfAttention, sparse_attention
+from .bert_sparse_self_attention import BertSparseSelfAttention
+from .sparse_attention_utils import SparseAttentionUtils
 
 __all__ = ["SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
            "VariableSparsityConfig", "BigBirdSparsityConfig",
            "BSLongformerSparsityConfig", "SparseSelfAttention",
-           "sparse_attention"]
+           "sparse_attention", "BertSparseSelfAttention",
+           "SparseAttentionUtils"]

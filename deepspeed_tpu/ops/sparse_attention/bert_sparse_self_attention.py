@@ -1,0 +1,76 @@
+"""BERT-style sparse self-attention layer.
+
+Reference: ``BertSparseSelfAttention`` (deepspeed/ops/sparse_attention/
+bert_sparse_self_attention.py:9) — separate q/k/v projections in BERT's
+naming, feeding SparseSelfAttention so a dense BERT checkpoint's
+attention weights carry over unchanged.
+"""
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .sparse_self_attention import sparse_attention
+from .sparsity_config import FixedSparsityConfig
+
+
+class BertSparseSelfAttention(nn.Module):
+    """Drop-in replacement for a BERT self-attention sub-layer
+    (projections named ``query``/``key``/``value`` like HF/reference BERT,
+    so existing weights load by name).
+
+    Call: ``layer(hidden_states, attention_mask)`` where attention_mask is
+    a [batch, seq] key-padding mask (1/True = attend). Returns the
+    [batch, seq, hidden] context (the caller keeps its own output
+    projection, as in the reference usage).
+    """
+    hidden_size: int
+    num_attention_heads: int
+    sparsity_config: Any = None
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @classmethod
+    def from_bert_config(cls, config, sparsity_config=None, **kwargs):
+        """Build from a BERT-ish config object exposing ``hidden_size`` and
+        ``num_attention_heads`` (HF) or ``d_model``/``n_heads`` (ours)."""
+        hidden = getattr(config, "hidden_size", None) or config.d_model
+        heads = getattr(config, "num_attention_heads", None) or config.n_heads
+        return cls(hidden_size=hidden, num_attention_heads=heads,
+                   sparsity_config=sparsity_config, **kwargs)
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None):
+        if self.hidden_size % self.num_attention_heads != 0:
+            raise ValueError(
+                f"The hidden size ({self.hidden_size}) is not a multiple of "
+                f"the number of attention heads ({self.num_attention_heads})")
+        head_dim = self.hidden_size // self.num_attention_heads
+        scfg = self.sparsity_config or FixedSparsityConfig(
+            num_heads=self.num_attention_heads)
+
+        def proj(name):
+            return nn.DenseGeneral(
+                features=self.hidden_size, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=name)(hidden_states)
+
+        b, s, _ = hidden_states.shape
+        q = proj("query").reshape(b, s, self.num_attention_heads, head_dim)
+        k = proj("key").reshape(b, s, self.num_attention_heads, head_dim)
+        v = proj("value").reshape(b, s, self.num_attention_heads, head_dim)
+
+        key_padding_mask = None
+        if attention_mask is not None:
+            m = attention_mask
+            if m.ndim > 2:          # [b,1,1,s] layout: additive when float
+                m = m.reshape(m.shape[0], m.shape[-1])
+                if jnp.issubdtype(m.dtype, jnp.floating):
+                    m = m > -1.0    # 0 keep / -1e4|-inf drop
+            elif jnp.issubdtype(m.dtype, jnp.floating):
+                m = m > 0.5         # 2-D masks are multiplicative (1=keep)
+            key_padding_mask = m.astype(bool)
+
+        ctx = sparse_attention(q, k, v, scfg,
+                               key_padding_mask=key_padding_mask)
+        return ctx.reshape(b, s, self.hidden_size)

@@ -185,8 +185,11 @@ def compile_layout(config, seq_len: int) -> Optional[LayoutPlan]:
 # ---------------------------------------------------------------------------
 
 def _masked_scores(q, k_ref, mask_ref, ki, pid, scale, tile):
-    """[tile,d]x[tile,d] scores for one active tile, fine-masked."""
-    k = k_ref[0, 0, pl.ds(ki * tile, tile), :].astype(jnp.float32)
+    """[tile,d]x[tile,d] scores for one active tile, fine-masked.
+
+    q/k stay in their native dtype (bf16 hot path) so the MXU runs at its
+    bf16 rate; scores accumulate fp32 via preferred_element_type."""
+    k = k_ref[0, 0, pl.ds(ki * tile, tile), :]
     live = mask_ref[pid] != 0
     s = jnp.where(live, jnp.dot(q, k.T,
                                 preferred_element_type=jnp.float32) * scale,
@@ -198,19 +201,20 @@ def _fwd_kernel(idx_ref, pid_ref, cnt_ref,                 # SMEM
                 q_ref, k_ref, v_ref, mask_ref,             # VMEM in
                 o_ref, m_ref, l_ref, *, scale, d, tile):
     hi, qi = pl.program_id(1), pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]
 
     def body(j, carry):
         acc, m_acc, l_acc = carry
         ki = idx_ref[hi, qi, j]
         pid = pid_ref[hi, qi, j]
         s, live, _ = _masked_scores(q, k_ref, mask_ref, ki, pid, scale, tile)
-        v = v_ref[0, 0, pl.ds(ki * tile, tile), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(ki * tile, tile), :]
         m_new = jnp.maximum(m_acc, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.where(live, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_acc - m_new)
         l_new = l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                    preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     acc, m, l = jax.lax.fori_loop(
@@ -228,8 +232,8 @@ def _dq_kernel(idx_ref, pid_ref, cnt_ref,
                q_ref, k_ref, v_ref, do_ref, dl_ref, m_ref, l_ref, mask_ref,
                dq_ref, *, scale, d, tile):
     hi, qi = pl.program_id(1), pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
     delta = dl_ref[0, 0]
     m, l = m_ref[0, 0], l_ref[0, 0]
 
@@ -237,10 +241,10 @@ def _dq_kernel(idx_ref, pid_ref, cnt_ref,
         ki = idx_ref[hi, qi, j]
         pid = pid_ref[hi, qi, j]
         s, live, k = _masked_scores(q, k_ref, mask_ref, ki, pid, scale, tile)
-        v = v_ref[0, 0, pl.ds(ki * tile, tile), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(ki * tile, tile), :]
         p = jnp.where(live, jnp.exp(s - m), 0.0) / l
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         return acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     acc = jax.lax.fori_loop(0, cnt_ref[hi, qi], body,
@@ -252,16 +256,16 @@ def _dkv_kernel(idx_ref, pid_ref, cnt_ref,
                 q_ref, k_ref, v_ref, do_ref, dl_ref, m_ref, l_ref, mask_ref,
                 dk_ref, dv_ref, *, scale, d, tile):
     hi, ki = pl.program_id(1), pl.program_id(2)
-    k = k_ref[0, 0].astype(jnp.float32)      # this column's k tile
-    v = v_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0]                          # this column's k tile
+    v = v_ref[0, 0]
 
     def body(j, carry):
         dk_acc, dv_acc = carry
         qi = idx_ref[hi, ki, j]
         pid = pid_ref[hi, ki, j]
         qs = pl.ds(qi * tile, tile)
-        q = q_ref[0, 0, qs, :].astype(jnp.float32)
-        do = do_ref[0, 0, qs, :].astype(jnp.float32)
+        q = q_ref[0, 0, qs, :]
+        do = do_ref[0, 0, qs, :]
         delta = dl_ref[0, 0, qs, :]
         m = m_ref[0, 0, qs, :]
         l = l_ref[0, 0, qs, :]
@@ -271,9 +275,10 @@ def _dkv_kernel(idx_ref, pid_ref, cnt_ref,
                       * scale, NEG_INF)
         p = jnp.where(live, jnp.exp(s - m), 0.0) / l
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        pl_ = p.astype(do.dtype)
         dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        dv_acc = dv_acc + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv_acc = dv_acc + jnp.dot(pl_.T, do, preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 
     dk_acc, dv_acc = jax.lax.fori_loop(

@@ -1,0 +1,166 @@
+"""Utilities for adapting pretrained models to sparse self-attention.
+
+Reference: ``SparseAttentionUtils`` (deepspeed/ops/sparse_attention/
+sparse_attention_utils.py:13): extend position embeddings, swap dense
+attention for sparse, pad/unpad sequences to the sparsity block size.
+
+TPU adaptation: models here are (module-def, param-pytree) pairs, so
+"replacing a layer" splits into two pure steps — rewrite the *config*
+(the module definition picks up sparse attention) and rewrite the
+*params* (position table extension). Both return new values; nothing is
+mutated in place.
+"""
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from .sparsity_config import SparsityConfig, FixedSparsityConfig
+
+
+def _is_mapping(x):
+    try:
+        return hasattr(x, "keys") and hasattr(x, "__getitem__")
+    except Exception:
+        return False
+
+
+class SparseAttentionUtils:
+    """Static helpers (reference: sparse_attention_utils.py:13)."""
+
+    @staticmethod
+    def extend_position_embedding(params, max_position,
+                                  key="position_embeddings",
+                                  reserved_rows=0):
+        """Tile a position-embedding table inside a param pytree up to
+        ``max_position`` rows (reference behavior: repeat the pretrained
+        table whole multiples; RoBERTa's 2 reserved rows -> reserved_rows=2).
+
+        Returns a NEW param tree; the input is untouched.
+        """
+        hits = []
+
+        def rewrite(tree):
+            if not _is_mapping(tree):
+                return tree
+            out = {}
+            for name, sub in tree.items():
+                # flax logical-partitioning boxes (nn.Partitioned /
+                # LogicallyPartitioned) wrap the array; unbox, rewrite,
+                # rebox so sharding metadata survives
+                val = sub.unbox() if hasattr(sub, "unbox") else sub
+                if name == key and hasattr(val, "shape") and val.ndim == 2:
+                    head = val[:reserved_rows]
+                    body = val[reserved_rows:]
+                    orig = body.shape[0]
+                    if max_position <= orig:
+                        raise ValueError(
+                            f"new max position {max_position} must exceed the "
+                            f"original {orig}")
+                    reps = -(-max_position // orig)   # ceil: never short
+                    ext = jnp.concatenate([body] * reps, axis=0)[:max_position]
+                    new_val = jnp.concatenate([head, ext], axis=0)
+                    out[name] = (sub.replace_boxed(new_val)
+                                 if hasattr(sub, "replace_boxed") else new_val)
+                    hits.append(orig * reps)
+                else:
+                    out[name] = rewrite(sub)
+            return out
+
+        new_params = rewrite(params)
+        if not hits:
+            raise ValueError(
+                f"no 2-D '{key}' table found in the param tree — pass the "
+                f"embedding param name via key=")
+        return new_params
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position):
+        """Reference: sparse_attention_utils.py:69 — same contract; works
+        on any HF tokenizer object."""
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            config, params, max_position,
+            sparsity_config: Optional[SparsityConfig] = None):
+        """Reference: sparse_attention_utils.py:85. Dense attention ->
+        sparse attention on a model built from ``deepspeed_tpu.models``
+        configs (BertConfig/GPTConfig): returns ``(new_config, new_params)``
+        where the config carries the sparsity pattern (every Block routes
+        through the block-sparse kernel) and the params have the position
+        table extended to ``max_position``.
+
+        The q/k/v/output projection weights are untouched — sparsity only
+        changes which score blocks are computed, exactly like the
+        reference's layer swap that reuses query/key/value modules.
+        """
+        import dataclasses
+        if sparsity_config is None:
+            sparsity_config = FixedSparsityConfig(num_heads=config.n_heads)
+        field_names = {f.name for f in dataclasses.fields(config)}
+        if "sparsity_config" not in field_names:
+            raise ValueError(
+                f"{type(config).__name__} does not support sparse attention")
+        updates = {"sparsity_config": sparsity_config}
+        if "max_seq_len" in field_names:
+            updates["max_seq_len"] = max_position
+        new_config = dataclasses.replace(config, **updates)
+        new_params = SparseAttentionUtils.extend_position_embedding(
+            params, max_position)
+        return new_config, new_params
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids, attention_mask,
+                          token_type_ids, position_ids, inputs_embeds,
+                          pad_token_id, model_embeddings=None):
+        """Pad the seq dim of every given input to a multiple of
+        ``block_size`` (reference: sparse_attention_utils.py:154). Returns
+        ``(pad_len, input_ids, attention_mask, token_type_ids,
+        position_ids, inputs_embeds)`` with None passed through.
+
+        Note: under jit the same callable recompiles per distinct padded
+        length — bucket your batch lengths (the reference has the same
+        dynamic-shape cost on CUDA kernel launch shape).
+        """
+        if input_ids is not None:
+            seq_len = input_ids.shape[1]
+        else:
+            seq_len = inputs_embeds.shape[1]
+        pad_len = (block_size - seq_len % block_size) % block_size
+        if pad_len == 0:
+            return (0, input_ids, attention_mask, token_type_ids,
+                    position_ids, inputs_embeds)
+
+        def pad2d(x, value):
+            if x is None:
+                return None
+            return jnp.pad(x, ((0, 0), (0, pad_len)), constant_values=value)
+
+        if inputs_embeds is not None:
+            if model_embeddings is not None:
+                pad_ids = jnp.full((inputs_embeds.shape[0], pad_len),
+                                   pad_token_id, dtype=jnp.int32)
+                pad_embeds = model_embeddings(pad_ids)
+            else:
+                pad_embeds = jnp.zeros(
+                    inputs_embeds.shape[:1] + (pad_len,)
+                    + inputs_embeds.shape[2:], inputs_embeds.dtype)
+            inputs_embeds = jnp.concatenate([inputs_embeds, pad_embeds],
+                                            axis=1)
+        input_ids = pad2d(input_ids, pad_token_id)
+        position_ids = pad2d(position_ids, pad_token_id)
+        attention_mask = pad2d(attention_mask, 0)
+        token_type_ids = pad2d(token_type_ids, 0)
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """Reference: sparse_attention_utils.py:214."""
+        if pad_len > 0:
+            sequence_output = sequence_output[:, :-pad_len]
+        return sequence_output

@@ -1,0 +1,6 @@
+from .attention import attention
+from .transformer import (DeepSpeedTransformerConfig,
+                          DeepSpeedTransformerLayer, TransformerConfig)
+
+__all__ = ["attention", "DeepSpeedTransformerConfig",
+           "DeepSpeedTransformerLayer", "TransformerConfig"]

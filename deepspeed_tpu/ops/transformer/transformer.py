@@ -1,0 +1,237 @@
+"""User-facing fused transformer layer, the drop-in analog of the
+reference's CUDA training kernel surface.
+
+Reference: ``DeepSpeedTransformerConfig`` / ``DeepSpeedTransformerLayer``
+(deepspeed/ops/transformer/transformer.py:36,459), whose forward/backward is
+the hand-fused BERT layer in csrc/transformer/ds_transformer_cuda.cpp:1034
+(QKV gemm -> attention softmax+dropout -> projection -> residual+LN ->
+GELU FFN -> residual+LN, pre- or post-LN).
+
+TPU design: one flax module holding the reference's *flat parameter
+surface* (attn_qkvw/attn_qkvb/attn_ow/attn_ob/attn_nw/attn_nb/inter_w/
+inter_b/output_w/output_b/norm_w/norm_b, torch [out, in] weight layout so
+reference checkpoints port 1:1) executed as jnp matmuls + the shared
+attention op (flash-attention Pallas kernel when eligible). XLA fuses the
+bias/GELU/dropout/residual epilogues into the matmuls — the fusions the
+reference wrote by hand in gelu_kernels.cu / dropout_kernels.cu /
+normalize_kernels.cu.
+
+Memory knobs map to rematerialization instead of kernel variants:
+- ``gelu_checkpoint``     -> recompute the FFN in backward
+  (reference: drops the intermediate GELU activation buffer)
+- ``attn_dropout_checkpoint`` -> recompute attention in backward
+  (reference: drops the attention-dropout buffer; the flash kernel never
+  materializes [b,h,s,s] probs in the first place)
+- ``normalize_invertible``    -> recompute both LN sub-blocks in backward
+  (reference: recovers LN inputs from outputs)
+- ``stochastic_mode``         -> accepted, no-op: its CUDA meaning
+  (non-deterministic fast reductions) has no TPU analog; XLA reductions
+  are deterministic at equal speed.
+"""
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from .attention import attention
+
+
+@dataclass
+class TransformerConfig:
+    """Base config (reference: TransformerConfig, transformer.py:17)."""
+    batch_size: int = -1
+    hidden_size: int = -1
+    intermediate_size: int = -1
+    heads: int = -1
+    attn_dropout_ratio: float = -1.0
+    hidden_dropout_ratio: float = -1.0
+    num_hidden_layers: int = -1
+    initializer_range: float = -1.0
+    layer_id: int = field(default=-1, init=False)
+
+
+@dataclass
+class DeepSpeedTransformerConfig(TransformerConfig):
+    """Reference: DeepSpeedTransformerConfig (transformer.py:37) — same
+    knob names; TPU interpretations documented in the module docstring.
+
+    ``fp16=True`` selects bfloat16 compute (the TPU-native half format;
+    fp16 has no hardware advantage on the MXU). ``batch_size`` and
+    ``local_rank``/``seed`` are accepted for signature parity: shapes are
+    taken from the inputs at trace time and RNG comes from flax rngs.
+    """
+    layer_norm_eps: float = 1e-12
+    local_rank: int = -1
+    seed: int = -1
+    fp16: bool = False
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False
+    gelu_checkpoint: bool = False
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False
+    return_tuple: bool = False
+    training: bool = True
+
+    def __post_init__(self):
+        if self.intermediate_size <= 0 < self.hidden_size:
+            self.intermediate_size = 4 * self.hidden_size
+        if self.attn_dropout_ratio < 0:
+            self.attn_dropout_ratio = 0.0
+        if self.hidden_dropout_ratio < 0:
+            self.hidden_dropout_ratio = 0.0
+
+    @classmethod
+    def from_dict(cls, json_object):
+        cfg = cls()
+        for key, value in json_object.items():
+            if hasattr(cfg, key):
+                setattr(cfg, key, value)
+        cfg.__post_init__()
+        return cfg
+
+    @classmethod
+    def from_json_file(cls, json_file):
+        import json
+        with open(json_file, "r", encoding="utf-8") as f:
+            return cls.from_dict(json.loads(f.read()))
+
+    @property
+    def dtype(self):
+        return jnp.bfloat16 if self.fp16 else jnp.float32
+
+
+def _normal(std):
+    return nn.initializers.normal(stddev=std)
+
+
+class DeepSpeedTransformerLayer(nn.Module):
+    """Drop-in fused transformer layer (reference:
+    DeepSpeedTransformerLayer, transformer.py:459).
+
+    Parameters carry the reference's exact names and torch ``[out, in]``
+    weight layout, so state dicts exported from the reference layer load
+    directly (transpose-free). Forward signature mirrors the reference:
+    ``layer(hidden_states, attention_mask)`` with an additive or boolean
+    [batch, 1, 1, seq] (or [batch, seq]) mask.
+
+    Unlike the CUDA layer there is no per-layer global registry or
+    max-batch preallocation: jit re-specializes on shapes, and layer_id
+    bookkeeping is unnecessary (kept as a config field for parity).
+    """
+    config: DeepSpeedTransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None, *,
+                 deterministic: Optional[bool] = None, grads=None):
+        cfg = self.config
+        if deterministic is None:
+            deterministic = not cfg.training
+        H, I = cfg.hidden_size, cfg.intermediate_size
+        n_layers = max(cfg.num_hidden_layers, 1)
+        std = cfg.initializer_range if cfg.initializer_range > 0 else 0.02
+        out_std = std / math.sqrt(2.0 * n_layers) if cfg.adjust_init_range else std
+
+        # --- the reference's flat parameter surface, torch [out, in] layout
+        p = self.param
+        attn_qkvw = p("attn_qkvw", _normal(std), (3 * H, H), jnp.float32)
+        attn_qkvb = p("attn_qkvb", nn.initializers.zeros, (3 * H,), jnp.float32)
+        attn_ow = p("attn_ow", _normal(out_std), (H, H), jnp.float32)
+        attn_ob = p("attn_ob", nn.initializers.zeros, (H,), jnp.float32)
+        attn_nw = p("attn_nw", nn.initializers.ones, (H,), jnp.float32)
+        attn_nb = p("attn_nb", nn.initializers.zeros, (H,), jnp.float32)
+        inter_w = p("inter_w", _normal(std), (I, H), jnp.float32)
+        inter_b = p("inter_b", nn.initializers.zeros, (I,), jnp.float32)
+        output_w = p("output_w", _normal(out_std), (H, I), jnp.float32)
+        output_b = p("output_b", nn.initializers.zeros, (H,), jnp.float32)
+        norm_w = p("norm_w", nn.initializers.ones, (H,), jnp.float32)
+        norm_b = p("norm_b", nn.initializers.zeros, (H,), jnp.float32)
+
+        dtype = cfg.dtype
+        x = hidden_states.astype(dtype)
+        mask = _canonical_mask(attention_mask)
+
+        rngs = {}
+        needs_rng = (not deterministic and
+                     (cfg.attn_dropout_ratio > 0 or cfg.hidden_dropout_ratio > 0))
+        if needs_rng:
+            rngs["attn"], rngs["hidden1"], rngs["hidden2"] = \
+                jax.random.split(self.make_rng("dropout"), 3)
+
+        def ln(y, scale, bias):
+            y32 = y.astype(jnp.float32)
+            mean = jnp.mean(y32, axis=-1, keepdims=True)
+            var = jnp.mean(jnp.square(y32 - mean), axis=-1, keepdims=True)
+            out = (y32 - mean) * jax.lax.rsqrt(var + cfg.layer_norm_eps)
+            return (out * scale + bias).astype(dtype)
+
+        if cfg.normalize_invertible:
+            # recompute LN (and everything downstream of it inside each
+            # sub-block) in backward instead of saving LN inputs
+            ln = jax.checkpoint(ln, static_argnums=())
+
+        def attn_block(y):
+            qkv = y @ attn_qkvw.astype(dtype).T + attn_qkvb.astype(dtype)
+            b, s, _ = qkv.shape
+            head_dim = H // cfg.heads
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, s, cfg.heads, head_dim)
+            k = k.reshape(b, s, cfg.heads, head_dim)
+            v = v.reshape(b, s, cfg.heads, head_dim)
+            ctx = attention(
+                q, k, v, mask=mask, causal=False,
+                dropout_rate=cfg.attn_dropout_ratio,
+                dropout_rng=rngs.get("attn"),
+                deterministic=deterministic, seq_parallel="none")
+            ctx = ctx.reshape(b, s, H)
+            out = ctx @ attn_ow.astype(dtype).T + attn_ob.astype(dtype)
+            return _dropout(out, cfg.hidden_dropout_ratio, rngs.get("hidden1"),
+                            deterministic)
+
+        def ffn_block(y):
+            h = y @ inter_w.astype(dtype).T + inter_b.astype(dtype)
+            h = jax.nn.gelu(h, approximate=False)
+            h = h @ output_w.astype(dtype).T + output_b.astype(dtype)
+            return _dropout(h, cfg.hidden_dropout_ratio, rngs.get("hidden2"),
+                            deterministic)
+
+        if cfg.attn_dropout_checkpoint:
+            attn_block = jax.checkpoint(attn_block)
+        if cfg.gelu_checkpoint:
+            ffn_block = jax.checkpoint(ffn_block)
+
+        if cfg.pre_layer_norm:
+            x = x + attn_block(ln(x, attn_nw, attn_nb))
+            x = x + ffn_block(ln(x, norm_w, norm_b))
+        else:
+            x = ln(x + attn_block(x), attn_nw, attn_nb)
+            x = ln(x + ffn_block(x), norm_w, norm_b)
+
+        return (x,) if cfg.return_tuple else x
+
+
+def _canonical_mask(attention_mask):
+    """Accept [b, s] multiplicative masks (1=keep, 0=drop), [b, 1, 1, s]
+    boolean, or HF-style additive float masks (0 keep / large-negative
+    drop); emit the boolean layout the attention op expects, or None."""
+    if attention_mask is None:
+        return None
+    m = attention_mask
+    if m.ndim == 2:
+        # 2-D masks are multiplicative by convention regardless of dtype
+        return (m > 0.5 if jnp.issubdtype(m.dtype, jnp.floating)
+                else m.astype(bool))[:, None, None, :]
+    if jnp.issubdtype(m.dtype, jnp.floating):
+        return m > -1.0   # additive masks use ~-1e4/-inf for "drop"
+    return m.astype(bool)
+
+
+def _dropout(x, rate, rng, deterministic):
+    if rate <= 0.0 or deterministic or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0)

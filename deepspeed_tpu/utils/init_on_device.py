@@ -1,0 +1,71 @@
+"""Construction-device control (reference: deepspeed/utils/init_on_device.py
+OnDevice — monkey-patches torch tensor constructors so a model is built as
+meta tensors or directly on a target device).
+
+JAX analog: flax module construction NEVER allocates (modules are
+dataclasses; tensors only exist once ``init`` runs), so "meta" is the
+default and only construction mode — the patching machinery has nothing
+to patch. What remains useful is the materialization side: initialize a
+model's params abstractly (shapes only) or directly on a chosen device /
+sharding in a chosen dtype, without a host round-trip.
+"""
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    """``with OnDevice(dtype=jnp.bfloat16, device="meta"): model = GPT(cfg)``
+
+    API-parity context (construction inside the block is already
+    allocation-free) plus explicit init helpers:
+
+    - ``abstract_init(module, rng, *args)`` -> ShapeDtypeStruct pytree
+      (the 'meta' materialization; reference's device='meta' use case)
+    - ``init(module, rng, *args)`` -> params on ``device`` (a jax.Device,
+      a Sharding, or None for the default device), floating leaves cast
+      to ``dtype``.
+    """
+
+    def __init__(self, dtype=None, device="meta", enabled=True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _cast(self, tree):
+        if self.dtype is None:
+            return tree
+        return jax.tree.map(
+            lambda x: x.astype(self.dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x, tree)
+
+    def abstract_init(self, module, rng, *args, **kwargs):
+        out = jax.eval_shape(lambda r: module.init(r, *args, **kwargs), rng)
+        if self.dtype is None:
+            return out
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, self.dtype if jnp.issubdtype(x.dtype, jnp.floating)
+                else x.dtype), out)
+
+    def init(self, module, rng, *args, **kwargs):
+        if self.device == "meta":
+            return self.abstract_init(module, rng, *args, **kwargs)
+        fn = lambda r: self._cast(module.init(r, *args, **kwargs))
+        if self.device is None:
+            return jax.jit(fn)(rng)
+        if isinstance(self.device, jax.sharding.Sharding) or hasattr(
+                self.device, "memory_kind"):
+            return jax.jit(fn, out_shardings=self.device)(rng)
+        with contextlib.ExitStack() as stack:
+            stack.enter_context(jax.default_device(self.device))
+            return jax.jit(fn)(rng)

@@ -1,0 +1,86 @@
+"""Facade-surface tests: zero.Init, OnDevice, top-level exports.
+
+Reference surface: deepspeed/__init__.py:27-49 (zero, OnDevice,
+PipelineModule, DeepSpeedTransformerLayer exports), zero.Init
+(runtime/zero/partition_parameters.py:525), OnDevice
+(utils/init_on_device.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.comm import MeshSpec, build_mesh
+from deepspeed_tpu.models.gpt import GPT, GPTConfig
+
+
+def _tiny_cfg(**kw):
+    return GPTConfig(vocab_size=64, max_seq_len=16, d_model=32, n_layers=2,
+                     n_heads=4, scan_layers=False, dtype=jnp.float32, **kw)
+
+
+def test_facade_exports_resolve():
+    assert ds.PipelineModule.__name__ == "PipelineModule"
+    assert ds.LayerSpec.__name__ == "LayerSpec"
+    assert ds.TiedLayerSpec.__name__ == "TiedLayerSpec"
+    assert ds.OnDevice.__name__ == "OnDevice"
+    assert ds.DeepSpeedTransformerLayer.__name__ == "DeepSpeedTransformerLayer"
+    assert ds.zero.Init is not None
+    assert callable(ds.log_dist)
+    with pytest.raises(AttributeError):
+        ds.not_a_real_export
+
+
+def test_zero_init_materializes_sharded():
+    mesh = build_mesh(MeshSpec(fsdp=8), set_global=False)
+    with ds.zero.Init(mesh=mesh, stage=3) as zinit:
+        model = GPT(_tiny_cfg())
+    ids = jnp.zeros((1, 16), jnp.int32)
+    params = zinit.materialize(model, jax.random.PRNGKey(0), ids)
+    leaves = jax.tree.leaves(params)
+    assert leaves, "no params materialized"
+    # at least one big param actually sharded over fsdp (not replicated)
+    sharded = [l for l in leaves
+               if not l.sharding.is_fully_replicated and l.size >= 8]
+    assert sharded, "stage-3 Init produced only replicated params"
+    for l in sharded:
+        shard = l.addressable_shards[0]
+        assert shard.data.size < l.size  # each device holds a strict shard
+    # model runs from the sharded variables (materialize returns the full
+    # unboxed variables tree, {"params": ...})
+    out = model.apply(params, ids)
+    assert out.shape == (1, 16, 64)
+
+
+def test_zero_init_from_config_dict():
+    mesh = build_mesh(MeshSpec(fsdp=8), set_global=False)
+    zinit = ds.zero.Init(mesh=mesh, config={
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 3,
+                              "stage3_param_persistence_threshold": 10 ** 9}})
+    assert zinit.stage == 3
+    # giant persistence threshold -> everything stays replicated
+    model = GPT(_tiny_cfg())
+    ids = jnp.zeros((1, 16), jnp.int32)
+    params = zinit.materialize(model, jax.random.PRNGKey(0), ids)
+    assert all(l.sharding.is_fully_replicated for l in jax.tree.leaves(params))
+
+
+def test_on_device_meta_and_real():
+    model = GPT(_tiny_cfg())
+    ids = jnp.zeros((1, 16), jnp.int32)
+    with ds.OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+        abstract = ctx.init(model, jax.random.PRNGKey(0), ids)
+    leaves = jax.tree.leaves(
+        abstract, is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    assert leaves and all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+    floats = [l for l in leaves if jnp.issubdtype(l.dtype, jnp.floating)]
+    assert floats and all(l.dtype == jnp.bfloat16 for l in floats)
+
+    dev = jax.devices()[0]
+    with ds.OnDevice(dtype=jnp.float32, device=dev) as ctx:
+        real = ctx.init(model, jax.random.PRNGKey(0), ids)
+    leaf = jax.tree.leaves(real)[0]
+    assert dev in leaf.devices()

@@ -1,0 +1,70 @@
+"""runtime/utils.py tests (reference surface: deepspeed/runtime/utils.py
+clip_grad_norm_/get_global_norm/CheckOverflow/see_memory_usage)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.utils import (CheckOverflow, clip_grad_norm_,
+                                         get_global_norm, get_grad_norm,
+                                         get_weight_norm, see_memory_usage,
+                                         call_to_str)
+
+
+def test_get_grad_norm_and_global_norm():
+    grads = {"a": jnp.full((3,), 2.0), "b": {"c": jnp.full((4,), 1.0)}}
+    n = float(get_grad_norm(grads))
+    np.testing.assert_allclose(n, math.sqrt(4 * 3 + 4), rtol=1e-6)
+    assert get_global_norm([3.0, 4.0]) == pytest.approx(5.0)
+    assert float(get_weight_norm(grads)) == pytest.approx(n)
+
+
+def test_clip_grad_norm_scales_down_only_when_needed():
+    grads = {"w": jnp.asarray([3.0, 4.0])}
+    clipped, norm = clip_grad_norm_(grads, max_norm=1.0)
+    assert float(norm) == pytest.approx(5.0)
+    np.testing.assert_allclose(np.asarray(clipped["w"]),
+                               [3.0 / 5, 4.0 / 5], rtol=1e-4)
+    # under the bound: untouched
+    same, norm2 = clip_grad_norm_(grads, max_norm=10.0)
+    np.testing.assert_allclose(np.asarray(same["w"]), [3.0, 4.0], rtol=1e-5)
+    # dtype preserved for bf16 grads
+    g16 = {"w": jnp.asarray([30.0, 40.0], jnp.bfloat16)}
+    c16, _ = clip_grad_norm_(g16, max_norm=1.0)
+    assert c16["w"].dtype == jnp.bfloat16
+
+
+def test_check_overflow_traced_and_eager():
+    ok = {"w": jnp.ones((4,))}
+    bad = {"w": jnp.asarray([1.0, jnp.inf]), "b": jnp.ones(2)}
+    nan = {"w": jnp.asarray([jnp.nan, 1.0])}
+    chk = CheckOverflow()
+    assert not bool(chk.check(ok))
+    assert bool(chk.check(bad))
+    assert bool(chk.check(nan))
+    # jit-safe
+    f = jax.jit(CheckOverflow.has_overflow_serial)
+    assert bool(f(bad)) and not bool(f(ok))
+
+
+def test_see_memory_usage_logs_only_when_forced(caplog):
+    import logging
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    ds_logger.propagate = True   # our logger is propagate=False by default
+    try:
+        with caplog.at_level(logging.INFO, logger=ds_logger.name):
+            see_memory_usage("quiet", force=False)
+            assert not [r for r in caplog.records if "MEM quiet" in r.message]
+            see_memory_usage("loud", force=True)
+            assert [r for r in caplog.records if "MEM loud" in r.message]
+    finally:
+        ds_logger.propagate = False
+
+
+def test_call_to_str():
+    assert call_to_str("SendActivation", 1, dest=2) == \
+        "SendActivation(1, dest=2)"
+    assert call_to_str("Step") == "Step()"

@@ -1,0 +1,161 @@
+"""SparseAttentionUtils + BertSparseSelfAttention tests.
+
+Reference surface: deepspeed/ops/sparse_attention/sparse_attention_utils.py
+and bert_sparse_self_attention.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BertSparseSelfAttention, DenseSparsityConfig, FixedSparsityConfig,
+    SparseAttentionUtils)
+
+
+def test_pad_to_block_size_and_unpad():
+    ids = jnp.arange(2 * 10, dtype=jnp.int32).reshape(2, 10)
+    mask = jnp.ones((2, 10), jnp.int32)
+    tt = jnp.zeros((2, 10), jnp.int32)
+    (pad_len, ids2, mask2, tt2, pos2, emb2) = \
+        SparseAttentionUtils.pad_to_block_size(
+            16, ids, mask, tt, None, None, pad_token_id=0)
+    assert pad_len == 6
+    assert ids2.shape == (2, 16) and mask2.shape == (2, 16)
+    assert pos2 is None and emb2 is None
+    assert np.all(np.asarray(mask2[:, 10:]) == 0)
+    assert np.all(np.asarray(ids2[:, 10:]) == 0)
+    seq_out = jnp.ones((2, 16, 8))
+    unpadded = SparseAttentionUtils.unpad_sequence_output(pad_len, seq_out)
+    assert unpadded.shape == (2, 10, 8)
+    # already-aligned input: no-op
+    out = SparseAttentionUtils.pad_to_block_size(
+        16, ids2, mask2, None, None, None, pad_token_id=0)
+    assert out[0] == 0 and out[1] is ids2
+
+
+def test_pad_to_block_size_embeds():
+    emb = jnp.ones((2, 10, 8))
+    called = {}
+
+    def model_embeddings(pad_ids):
+        called["shape"] = pad_ids.shape
+        return jnp.zeros(pad_ids.shape + (8,))
+
+    pad_len, _, _, _, _, emb2 = SparseAttentionUtils.pad_to_block_size(
+        8, None, jnp.ones((2, 10), jnp.int32), None, None, emb,
+        pad_token_id=3, model_embeddings=model_embeddings)
+    assert pad_len == 6
+    assert emb2.shape == (2, 16, 8)
+    assert called["shape"] == (2, 6)
+
+
+def test_extend_position_embedding_tiles_rows():
+    table = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+    params = {"bert": {"position_embeddings": table,
+                       "word_embeddings": jnp.zeros((11, 4))}}
+    new = SparseAttentionUtils.extend_position_embedding(params, 16)
+    ext = np.asarray(new["bert"]["position_embeddings"])
+    assert ext.shape == (16, 4)
+    np.testing.assert_allclose(ext[:8], np.asarray(table))
+    np.testing.assert_allclose(ext[8:], np.asarray(table))
+    # original untouched, other leaves preserved
+    assert params["bert"]["position_embeddings"].shape == (8, 4)
+    assert new["bert"]["word_embeddings"].shape == (11, 4)
+    with pytest.raises(ValueError):
+        SparseAttentionUtils.extend_position_embedding(params, 4)
+    with pytest.raises(ValueError):
+        SparseAttentionUtils.extend_position_embedding({"a": table}, 16,
+                                                       key="missing")
+
+
+def test_extend_position_embedding_reserved_rows():
+    # RoBERTa-style: rows 0-1 reserved, body tiled
+    table = jnp.concatenate([jnp.full((2, 4), -1.0),
+                             jnp.arange(6 * 4, dtype=jnp.float32).reshape(6, 4)])
+    params = {"position_embeddings": table}
+    new = SparseAttentionUtils.extend_position_embedding(params, 12,
+                                                         reserved_rows=2)
+    ext = np.asarray(new["position_embeddings"])
+    assert ext.shape == (14, 4)
+    np.testing.assert_allclose(ext[:2], -1.0)
+    np.testing.assert_allclose(ext[2:8], np.asarray(table[2:]))
+    np.testing.assert_allclose(ext[8:14], np.asarray(table[2:]))
+
+
+def test_bert_sparse_self_attention_dense_config_matches_softmax():
+    b, s, H, nh = 2, 32, 32, 4
+    layer = BertSparseSelfAttention(
+        hidden_size=H, num_attention_heads=nh,
+        sparsity_config=DenseSparsityConfig(num_heads=nh, block=16))
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, s, H))
+    mask = np.ones((b, s), np.int32)
+    mask[1, 20:] = 0
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    out = layer.apply({"params": params}, x, jnp.asarray(mask))
+    assert out.shape == (b, s, H)
+
+    # manual dense attention with the same projections
+    def proj(name):
+        k = np.asarray(params[name]["kernel"], np.float64)
+        bi = np.asarray(params[name]["bias"], np.float64)
+        return np.asarray(x, np.float64) @ k + bi
+
+    hd = H // nh
+    q = proj("query").reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+    k = proj("key").reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+    v = proj("value").reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+    logits = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd)
+    logits = np.where(mask[:, None, None, :].astype(bool), logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = (p @ v).transpose(0, 2, 1, 3).reshape(b, s, H)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_bert_sparse_self_attention_from_bert_config():
+    class HFish:
+        hidden_size = 32
+        num_attention_heads = 4
+
+    layer = BertSparseSelfAttention.from_bert_config(HFish())
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 64, 32))
+    params = layer.init(jax.random.PRNGKey(1), x)["params"]
+    out = layer.apply({"params": params}, x)
+    assert out.shape == (1, 64, 32)
+
+
+def test_replace_model_self_attention_with_sparse():
+    from deepspeed_tpu.models.bert import BertConfig, BertEncoder
+    cfg = BertConfig(vocab_size=64, max_seq_len=32, d_model=32, n_layers=2,
+                     n_heads=4, scan_layers=False, dtype=jnp.float32)
+    enc = BertEncoder(cfg)
+    ids = jnp.zeros((1, 32), jnp.int32)
+    params = enc.init(jax.random.PRNGKey(0), ids)["params"]
+
+    new_cfg, new_params = \
+        SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+            cfg, params, 64,
+            sparsity_config=FixedSparsityConfig(num_heads=4, block=16,
+                                                attention="bidirectional"))
+    assert new_cfg.max_seq_len == 64
+    assert new_cfg.sparsity_config is not None
+    pe = new_params["position_embeddings"]
+    pe = pe.unbox() if hasattr(pe, "unbox") else pe
+    assert pe.shape[0] == 64
+    # the sparse model runs at the extended length with the old weights
+    enc2 = BertEncoder(new_cfg)
+    ids2 = jnp.zeros((1, 64), jnp.int32)
+    seq_out, pooled = enc2.apply({"params": new_params}, ids2)
+    assert seq_out.shape == (1, 64, 32)
+    # and degenerates to the dense result at dense patterns
+    dense_cfg, dense_params = \
+        SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+            cfg, params, 64,
+            sparsity_config=DenseSparsityConfig(num_heads=4, block=16))
+    out_dense, _ = BertEncoder(dense_cfg).apply({"params": dense_params}, ids2)
+    base_cfg = __import__("dataclasses").replace(cfg, max_seq_len=64)
+    out_base, _ = BertEncoder(base_cfg).apply({"params": dense_params}, ids2)
+    np.testing.assert_allclose(np.asarray(out_dense), np.asarray(out_base),
+                               rtol=2e-4, atol=2e-4)

@@ -1,0 +1,94 @@
+"""WeightQuantization tests (reference: runtime/weight_quantizer.py,
+exercised by the inference quantization path)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
+
+
+def test_quantize_data_roundtrip_error():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+    wq = WeightQuantization()
+    q, scale = wq.quantize_data(w, quantize_bits=8, groups=4)
+    assert q.dtype == jnp.int8 and q.shape == w.shape
+    assert scale.shape == (4,)
+    deq = (np.asarray(q, np.float32).reshape(4, -1)
+           / np.asarray(scale)[:, None]).reshape(w.shape)
+    err = np.abs(deq - np.asarray(w)).max()
+    # 8-bit symmetric: worst-case step = absmax/127-ish
+    assert err < float(jnp.abs(w).max()) / 100
+
+
+def test_more_groups_reduce_error():
+    rng = np.random.default_rng(1)
+    # heterogeneous ranges across rows make grouping matter
+    w = jnp.asarray(rng.standard_normal((8, 128))
+                    * (10.0 ** np.arange(8))[:, None], jnp.float32)
+    wq = WeightQuantization()
+
+    row_max = np.abs(np.asarray(w)).max(axis=1, keepdims=True)
+
+    def rel_rms(groups):
+        q, scale = wq.quantize_data(w, 8, groups)
+        deq = (np.asarray(q, np.float32).reshape(groups, -1)
+               / np.asarray(scale)[:, None]).reshape(w.shape)
+        rel = (deq - np.asarray(w)) / row_max   # error relative to row range
+        return float(np.sqrt((rel ** 2).mean()))
+
+    # one group per row: every row quantized at its own scale -> small
+    # relative error everywhere; one global group: small-magnitude rows
+    # collapse to the global grid
+    assert rel_rms(8) < rel_rms(1) / 10
+
+
+def test_shape_heuristics():
+    wq = WeightQuantization(mp_size=1)
+    assert wq.is_mlp(jnp.zeros((4096, 1024)))
+    assert wq.is_mlp(jnp.zeros((1024, 4096)))
+    assert not wq.is_mlp(jnp.zeros((1024, 1024)))
+    assert wq.is_qkv(jnp.zeros((3072, 1024)))
+    assert not wq.is_qkv(jnp.zeros((1024, 1024)))
+    # TP-sliced halves still detected at mp_size=2
+    wq2 = WeightQuantization(mp_size=2)
+    assert wq2.is_mlp(jnp.zeros((2048, 1024)))
+    assert wq2.is_qkv(jnp.zeros((1536, 1024)))
+
+
+def test_sd_quantize_and_merge_scales():
+    rng = np.random.default_rng(2)
+    d = 64
+    sd = {}
+    for layer in range(2):
+        pre = f"transformer.layers.{layer}."
+        sd[pre + "attention.query_key_value.weight"] = \
+            jnp.asarray(rng.standard_normal((3 * d, d)), jnp.float32)
+        sd[pre + "attention.dense.weight"] = \
+            jnp.asarray(rng.standard_normal((d, d)), jnp.float32)
+        sd[pre + "mlp.dense_h_to_4h.weight"] = \
+            jnp.asarray(rng.standard_normal((4 * d, d)), jnp.float32)
+        sd[pre + "mlp.dense_4h_to_h.weight"] = \
+            jnp.asarray(rng.standard_normal((d, 4 * d)), jnp.float32)
+        sd[pre + "input_layernorm.weight"] = jnp.ones((d,))
+    wq = WeightQuantization()
+    qsd, scales = wq.sd_quantize(dict(sd), quantize_bits=8, groups=2)
+    for k, v in qsd.items():
+        if "layernorm" in k:
+            assert v.dtype != jnp.int8
+        else:
+            assert v.dtype == jnp.int8, k
+    # [layers, families=4, width]; mlp weights got 2x groups
+    assert scales.shape[0] == 2 and scales.shape[1] == 4
+    assert scales.shape[2] == 4  # mlp extra grouping: 2 groups *2
+
+
+def test_model_quantize_delegates_to_param_tree():
+    rng = np.random.default_rng(3)
+    params = {"wte": jnp.asarray(rng.standard_normal((256, 64)), jnp.float32),
+              "ln": {"scale": jnp.ones((64,))}}
+    wq = WeightQuantization()
+    qp = wq.model_quantize(params, quantize_bits=8)
+    assert isinstance(qp["wte"], dict) and qp["wte"]["q"].dtype == jnp.int8
+    assert qp["ln"]["scale"].dtype != jnp.int8
