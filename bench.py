@@ -134,17 +134,22 @@ def bench_decode(np, jax, jnp, models, preset="gpt2-2.7b", prompt=128,
     model = GPT(mcfg)
     ids = jnp.ones((1, 16), jnp.int32)
     import flax.core.meta as flax_meta
-    params = jax.jit(
-        lambda r: flax_meta.unbox(model.init(r, ids))["params"])(
-            jax.random.PRNGKey(0))
     transform = None
     if int8:
-        from deepspeed_tpu.module_inject.module_quantize import (
-            quantize_param_tree, dequantize_param_tree)
-        params = jax.jit(quantize_param_tree)(params)
-
-        def transform(p):
-            return dequantize_param_tree(p, dtype=jnp.bfloat16)
+        # direct consumption: kernels stay int8 dicts, QDense runs the
+        # fused-dequant matmul — no per-step dequantized bf16 copy.
+        # Quantize INSIDE the init jit: each bf16 leaf dies right after
+        # its quantize, so peak HBM ~ int8 model + largest bf16 leaf —
+        # how 6.7B (13.4GB bf16) initializes on a 16GB chip at all.
+        from deepspeed_tpu.module_inject.module_quantize import \
+            quantize_param_tree
+        params = jax.jit(lambda r: quantize_param_tree(
+            flax_meta.unbox(model.init(r, ids))["params"],
+            only_kernels=True))(jax.random.PRNGKey(0))
+    else:
+        params = jax.jit(
+            lambda r: flax_meta.unbox(model.init(r, ids))["params"])(
+                jax.random.PRNGKey(0))
 
     cache_len = 1024
     cache = init_cache(model, params, 1, cache_len)
@@ -213,8 +218,13 @@ def bench_sparse_kernel(np, jax, jnp, seq=8192, heads=8, d=64, batch=2):
     the active-tile bookkeeping cancels the FLOP savings (~1.0x).
 
     Timing method: ONE kernel launch covering `batch` samples (the grid's
-    leading dim), minus the measured null-dispatch latency — per-launch
-    overhead on tunneled rigs would otherwise swamp the kernel time."""
+    leading dim).
+
+    Timing: REPS independent applications UNROLLED inside one jit (each on
+    a perturbed input, one scalar reduced per application) — per-dispatch
+    tunnel latency amortizes away and, unlike a lax.scan-with-carry
+    harness, there is no per-iteration loop overhead polluting ms-scale
+    kernels on this rig."""
     from deepspeed_tpu.ops.sparse_attention import (BSLongformerSparsityConfig,
                                                     sparse_attention)
     from deepspeed_tpu.ops.sparse_attention.block_sparse_kernel import \
@@ -228,30 +238,28 @@ def bench_sparse_kernel(np, jax, jnp, seq=8192, heads=8, d=64, batch=2):
     mk = lambda: jnp.asarray(rng.standard_normal((batch, seq, heads, d)),
                              jnp.bfloat16)
     q, k, v = mk(), mk(), mk()
-
-    null = jax.jit(lambda q: q[0, 0, 0, 0] * 1.0)
-    _ = np.asarray(null(q))
-    t0 = time.time()
-    for _i in range(5):
-        _ = np.asarray(null(q))
-    overhead = (time.time() - t0) / 5
-
-    sp = jax.jit(lambda q, k, v: sparse_attention(q, k, v, cfg,
-                                                  backend="pallas"))
-    fl = jax.jit(lambda q, k, v: attention(q, k, v, causal=False,
-                                           seq_parallel="none"))
+    REPS = 8
 
     def clock(f):
-        _ = np.asarray(f(q, k, v)[0, 0, 0, 0])
+        @jax.jit
+        def g(q, k, v):
+            tot = jnp.float32(0)
+            for i in range(REPS):
+                o = f(q + jnp.asarray(i, q.dtype) * 1e-6, k, v)
+                tot = tot + o.reshape(-1)[0].astype(jnp.float32)
+            return tot
+        _ = np.asarray(g(q, k, v))
         best = float("inf")
         for _i in range(3):
             t0 = time.time()
-            out = f(q, k, v)
-            _ = np.asarray(out[0, 0, 0, 0])
+            _ = np.asarray(g(q, k, v))
             best = min(best, time.time() - t0)
-        return max(best - overhead, 1e-6) / batch * 1e3
+        return best / REPS * 1e3
 
-    t_sparse, t_dense = clock(sp), clock(fl)
+    t_sparse = clock(lambda q, k, v: sparse_attention(q, k, v, cfg,
+                                                      backend="pallas"))
+    t_dense = clock(lambda q, k, v: attention(q, k, v, causal=False,
+                                              seq_parallel="none"))
     return {"seq": seq, "layout_density": round(plan.density, 3),
             "sparse_ms": round(t_sparse, 2), "dense_ms": round(t_dense, 2),
             "speedup": round(t_dense / t_sparse, 2)}
@@ -303,10 +311,17 @@ def main():
             extra[name] = {"error": f"{type(e).__name__}: {e}"}
         print(f"# {name}: {extra[name]}", file=sys.stderr, flush=True)
 
-    run("gpt2_1p3b_zero_offload", bench_1p3b, np, jax, jnp, ds, models)
-    run("gpt2_125m_zero1", bench_125m, np, jax, jnp, ds, models)
+    # decode first: serving latency wants clean HBM (training engines'
+    # buffers linger through allocator high-water effects otherwise)
     run("decode", bench_decode, np, jax, jnp, models)
     run("decode_int8", bench_decode, np, jax, jnp, models, int8=True)
+    # the capability headline: 6.7B (GPT-3-class, the BLOOM-7B-class
+    # BASELINE #5 analog) on ONE 16GB chip — only possible int8 (13.4GB
+    # bf16 weights + cache exceed HBM; 6.7GB int8 + bf16 embeddings fit)
+    run("decode_int8_6p7b", bench_decode, np, jax, jnp, models,
+        preset="gpt2-6.7b", int8=True)
+    run("gpt2_1p3b_zero_offload", bench_1p3b, np, jax, jnp, ds, models)
+    run("gpt2_125m_zero1", bench_125m, np, jax, jnp, ds, models)
     run("sparse_attention_8k", bench_sparse_kernel, np, jax, jnp)
     run("fused_epilogue", bench_fused_epilogue, np, jax, jnp)
 

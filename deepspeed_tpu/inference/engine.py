@@ -70,14 +70,30 @@ class InferenceEngine:
                     "checkpoint=)")
             from ..module_inject.module_quantize import (
                 quantize_param_tree, dequantize_param_tree, quantized_nbytes)
-            self.params = jax.jit(
+            # Two consumption modes:
+            # - direct (deepspeed_tpu models, whose dense layers are QDense):
+            #   only matmul kernels quantize; the int8 {"q","scale"} nodes
+            #   flow straight into the fused-dequant Pallas matmul. Weights
+            #   stay int8 in HBM for the whole decode loop — XLA cannot
+            #   hoist a dequantized bf16 copy out of the scan (which would
+            #   double weight memory and erase the bandwidth win).
+            # - transform (arbitrary user flax modules): quantize the full
+            #   tree and dequantize per step in front of model.apply.
+            direct = type(self.module).__module__.startswith("deepspeed_tpu.")
+            from flax.core import meta as _meta
+            self.params = _meta.unbox(self.params)  # boxed leaves would hide
+            self.params = jax.jit(                  # the "kernel" path names
                 lambda p: quantize_param_tree(
-                    p, min_size=quantize_min_size, dtype=dtype))(self.params)
-            dt = dtype
+                    p, min_size=quantize_min_size, dtype=dtype,
+                    only_kernels=direct))(self.params)
+            if direct:
+                self._param_transform = None
+            else:
+                dt = dtype
 
-            def _transform(p, _dt=dt):
-                return dequantize_param_tree(p, dtype=_dt)
-            self._param_transform = _transform
+                def _transform(p, _dt=dt):
+                    return dequantize_param_tree(p, dtype=_dt)
+                self._param_transform = _transform
             nb = quantized_nbytes(self.params)
             log_dist(
                 f"int8 weight-only quantization: "

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from .layers import Block, LayerNorm, activation_constraint
+from .layers import QDense, Block, LayerNorm, activation_constraint
 from .gpt import REMAT_POLICIES
 
 
@@ -115,7 +115,7 @@ class BertEncoder(nn.Module):
                 h = block_cls(**block_kwargs, name=f"layer_{i}")(
                     h, mask, None, deterministic, layer_keep_prob=layer_keep_prob)
 
-        pooled = nn.tanh(nn.DenseGeneral(
+        pooled = nn.tanh(QDense(
             features=cfg.d_model, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             kernel_init=nn.with_logical_partitioning(
                 nn.initializers.normal(0.02), ("embed", "embed_out")),
@@ -135,7 +135,7 @@ class BertForPreTraining(nn.Module):
             input_ids, token_type_ids=token_type_ids,
             attention_mask=attention_mask, deterministic=deterministic)
         # MLM head: transform + tied decoder
-        h = nn.DenseGeneral(features=cfg.d_model, dtype=cfg.dtype,
+        h = QDense(features=cfg.d_model, dtype=cfg.dtype,
                             param_dtype=cfg.param_dtype,
                             kernel_init=nn.with_logical_partitioning(
                                 nn.initializers.normal(0.02), ("embed", "embed_out")),
@@ -145,7 +145,7 @@ class BertForPreTraining(nn.Module):
         wte = self.variables["params"]["bert"]["word_embeddings"]
         wte_val = wte.value if hasattr(wte, "value") else wte
         mlm_logits = jnp.einsum("bsd,vd->bsv", h, wte_val.astype(cfg.dtype))
-        nsp_logits = nn.DenseGeneral(
+        nsp_logits = QDense(
             features=2, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             name="nsp_head")(pooled)
         return mlm_logits, nsp_logits

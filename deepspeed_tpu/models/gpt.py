@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from .layers import Block, LayerNorm, activation_constraint
+from .layers import Block, LayerNorm, QDense, activation_constraint
 
 # jax.checkpoint policies keyed by config string (reference analog: the
 # activation_checkpointing config block,
@@ -92,6 +92,10 @@ GPT2_PRESETS = {
     "gpt2-760m": GPTConfig(d_model=1536, n_layers=24, n_heads=16),
     "gpt2-1.3b": GPTConfig(d_model=2048, n_layers=24, n_heads=16),
     "gpt2-2.7b": GPTConfig(d_model=2560, n_layers=32, n_heads=32),
+    # GPT-3 6.7B layout — the BLOOM-7B-class serving target (BASELINE #5):
+    # bf16 weights (13.4GB) don't fit a 16GB chip beside the KV cache, the
+    # int8 weight-only path (6.7GB + bf16 embeddings) does.
+    "gpt2-6.7b": GPTConfig(d_model=4096, n_layers=32, n_heads=32),
 }
 
 
@@ -228,7 +232,7 @@ class GPT(nn.Module):
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", h, wte.astype(cfg.dtype))
         else:
-            logits = nn.DenseGeneral(
+            logits = QDense(
                 features=cfg.vocab_size, use_bias=cfg.lm_head_bias,
                 dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                 kernel_init=nn.with_logical_partitioning(

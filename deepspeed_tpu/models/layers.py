@@ -79,6 +79,50 @@ def dense_init(names, scale=1.0):
     return nn.with_logical_partitioning(init, names)
 
 
+def _is_qleaf(x):
+    return isinstance(x, dict) and set(x.keys()) == {"q", "scale"}
+
+
+class QDense(nn.Module):
+    """DenseGeneral twin that can consume weight-only int8 params.
+
+    Identical param surface to ``nn.DenseGeneral`` ("kernel" [in, out],
+    "bias" [out]) and identical math for dense weights. When the bound
+    kernel is a ``{"q": int8, "scale": f32}`` node (module_inject/
+    module_quantize.py, the analog of the reference's int8 serving gemms,
+    pt_binding.cpp:1197-1244), the matmul consumes the int8 weights
+    directly via the fused-dequant Pallas kernel — weights stay int8 in
+    HBM across the whole decode loop instead of being re-materialized
+    bf16 (which XLA's loop hoisting would otherwise do).
+    """
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Any = None
+    bias_init: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        kinit = self.kernel_init or nn.initializers.lecun_normal()
+        kernel = self.param("kernel", kinit, (jnp.shape(x)[-1], self.features),
+                            self.param_dtype)
+        bias = None
+        if self.use_bias:
+            binit = self.bias_init or nn.initializers.zeros
+            bias = self.param("bias", binit, (self.features,), self.param_dtype)
+        x = x.astype(self.dtype)
+        if _is_qleaf(kernel):
+            from ..ops.pallas.wo_int8_matmul import wo_int8_matmul
+            y = wo_int8_matmul(x, kernel["q"], kernel["scale"],
+                               out_dtype=self.dtype)
+        else:
+            y = jnp.dot(x, kernel.astype(self.dtype))
+        if bias is not None:
+            y = y + bias.astype(self.dtype)
+        return y
+
+
 class LayerNorm(nn.Module):
     """LayerNorm with fp32 accumulation (reference: normalize_kernels.cu
     fused layernorm; XLA fuses this chain on TPU without a custom kernel)."""
@@ -135,7 +179,7 @@ class SelfAttention(nn.Module):
     def __call__(self, x, mask=None, bias=None, deterministic=True,
                  decode=False, positions=None):
         head_dim = self.d_model // self.n_heads
-        qkv = nn.DenseGeneral(
+        qkv = QDense(
             features=3 * self.d_model, use_bias=self.use_bias, dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=dense_init(("embed", "qkv")),
@@ -214,7 +258,7 @@ class SelfAttention(nn.Module):
         if decode_out is not None:
             out = decode_out.reshape(b, s, self.d_model)
             out = activation_constraint(out, ("batch", "seq", "embed"))
-            return nn.DenseGeneral(
+            return QDense(
                 features=self.d_model, use_bias=self.use_bias,
                 dtype=self.dtype, param_dtype=self.param_dtype,
                 kernel_init=dense_init(("qkv", "embed")),
@@ -234,7 +278,15 @@ class SelfAttention(nn.Module):
         if self.dropout_rate > 0.0 and not deterministic:
             dropout_rng = self.make_rng("dropout")
 
-        if self.sparsity_config is not None and not decode:
+        if self.sparsity_config is not None and decode:
+            # decoding against the KV cache with dense attention would
+            # silently change semantics vs the sparse pattern the model
+            # trained with — refuse rather than mismatch
+            raise NotImplementedError(
+                "KV-cache decoding with a sparsity_config is not "
+                "supported; serve with the dense model or generate via "
+                "full re-forward")
+        if self.sparsity_config is not None:
             # Block-sparse pattern path (reference: SparseSelfAttention
             # wired into BERT via SparseAttentionUtils). The layout encodes
             # causality for unidirectional configs; additive bias (ALiBi)
@@ -260,7 +312,7 @@ class SelfAttention(nn.Module):
                             seq_parallel=self.seq_parallel)
         out = out.reshape(b, s, self.d_model)
         out = activation_constraint(out, ("batch", "seq", "embed"))
-        return nn.DenseGeneral(
+        return QDense(
             features=self.d_model, use_bias=self.use_bias, dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=dense_init(("qkv", "embed")),
@@ -281,7 +333,7 @@ class MLP(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic=True):
-        h = nn.DenseGeneral(
+        h = QDense(
             features=self.d_ff, use_bias=self.use_bias, dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=dense_init(("embed", "mlp")),
@@ -298,7 +350,7 @@ class MLP(nn.Module):
         else:
             raise ValueError(f"unknown activation {self.activation}")
         h = activation_constraint(h, ("batch", "seq", "mlp"))
-        h = nn.DenseGeneral(
+        h = QDense(
             features=self.d_model, use_bias=self.use_bias, dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=dense_init(("mlp", "embed")),

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from .layers import LayerNorm
+from .layers import QDense, LayerNorm
 from .gpt import GPTConfig
 
 
@@ -42,7 +42,7 @@ class GPTHead(nn.Module):
     def __call__(self, h):
         cfg = self.config
         h = LayerNorm(epsilon=cfg.ln_epsilon, name="ln_f")(h)
-        return nn.DenseGeneral(
+        return QDense(
             features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=nn.with_logical_partitioning(
@@ -76,11 +76,11 @@ class BertMLMHead(nn.Module):
     @nn.compact
     def __call__(self, h):
         cfg = self.config
-        h = nn.DenseGeneral(features=cfg.d_model, dtype=cfg.dtype,
+        h = QDense(features=cfg.d_model, dtype=cfg.dtype,
                             param_dtype=cfg.param_dtype, name="transform")(h)
         h = jax.nn.gelu(h, approximate=True)
         h = LayerNorm(epsilon=cfg.ln_epsilon, name="ln")(h)
-        return nn.DenseGeneral(
+        return QDense(
             features=cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=nn.with_logical_partitioning(

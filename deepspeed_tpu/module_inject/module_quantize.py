@@ -32,10 +32,13 @@ def _is_qleaf(x) -> bool:
 
 
 def _quantize_array(w, axis):
-    """Symmetric per-channel int8: scale = max|w| / 127 along all dims
-    except ``axis`` (the output-feature dim keeps its own scale)."""
+    """Symmetric per-channel int8: scale = max|w| / 127 reduced over the
+    CONTRACTION dim only (the dim just before ``axis``). Every other dim
+    keeps its own scales — in particular a scan-stacked layer dim
+    [L, in, out] yields [L, 1, out] scales, so nn.scan slices q and scale
+    together and each layer keeps per-channel granularity."""
     w32 = jnp.asarray(w, jnp.float32)
-    reduce_dims = tuple(i for i in range(w32.ndim) if i != axis)
+    reduce_dims = (axis - 1 if axis > 0 else axis + 1,)
     amax = jnp.max(jnp.abs(w32), axis=reduce_dims, keepdims=True)
     scale = jnp.maximum(amax / 127.0, 1e-12)
     q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
@@ -43,26 +46,34 @@ def _quantize_array(w, axis):
 
 
 def quantize_param_tree(params, *, min_size: int = 4096,
-                        dtype=jnp.bfloat16) -> Any:
+                        dtype=jnp.bfloat16, only_kernels: bool = False) -> Any:
     """Quantize every floating >=2D leaf with numel >= min_size to int8
     (weight-only). Embeddings/kernels qualify; biases, layernorm scales
     and small tensors stay in ``dtype``.
 
+    ``only_kernels=True`` restricts quantization to leaves NAMED "kernel"
+    (the matmul weights QDense consumes directly) — the mode for
+    dequant-free serving where embeddings must stay dense arrays because
+    they are gathered, not matmul'd.
+
     Per-output-channel scales: the LAST dim is treated as the output
-    features (our DenseGeneral kernels are [in, out]; embeddings [V, D]
+    features (our dense kernels are [in, out]; embeddings [V, D]
     quantize per-embedding-dim which is equally fine)."""
 
-    def one(w):
+    def one(path, w):
         if _is_qleaf(w):
             return w
         arr = jnp.asarray(w)
-        if (arr.ndim >= 2 and np.issubdtype(np.dtype(arr.dtype), np.floating)
+        name_ok = (not only_kernels) or (
+            path and getattr(path[-1], "key", None) == "kernel")
+        if (name_ok and arr.ndim >= 2
+                and np.issubdtype(np.dtype(arr.dtype), np.floating)
                 and arr.size >= min_size):
             return _quantize_array(arr, axis=arr.ndim - 1)
         return arr.astype(dtype) if np.issubdtype(
             np.dtype(arr.dtype), np.floating) else arr
 
-    return jax.tree.map(one, params, is_leaf=_is_qleaf)
+    return jax.tree_util.tree_map_with_path(one, params, is_leaf=_is_qleaf)
 
 
 def dequantize_param_tree(params, dtype=jnp.bfloat16):

@@ -9,6 +9,8 @@ creating process groups (deepspeed/utils/groups.py).
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
+
+from deepspeed_tpu.models.layers import QDense
 import jax.numpy as jnp
 
 from ..comm.mesh import get_global_mesh
@@ -28,7 +30,7 @@ class ExpertMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         import jax
-        h = nn.DenseGeneral(features=self.d_ff, dtype=self.dtype,
+        h = QDense(features=self.d_ff, dtype=self.dtype,
                             param_dtype=self.param_dtype,
                             kernel_init=nn.with_logical_partitioning(
                                 nn.initializers.variance_scaling(
@@ -39,7 +41,7 @@ class ExpertMLP(nn.Module):
                             name="fc_in")(x)
         h = jax.nn.gelu(h, approximate=True) if self.activation == "gelu" \
             else jax.nn.relu(h)
-        return nn.DenseGeneral(features=self.d_model, dtype=self.dtype,
+        return QDense(features=self.d_model, dtype=self.dtype,
                                param_dtype=self.param_dtype,
                                kernel_init=nn.with_logical_partitioning(
                                    nn.initializers.variance_scaling(

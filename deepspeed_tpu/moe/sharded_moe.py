@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+from deepspeed_tpu.models.layers import QDense
+
 from ..comm.mesh import get_global_mesh
 
 
@@ -176,7 +178,7 @@ class TopKGate(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic=True):
         # gate weights kept fp32 (reference keeps wg in fp32)
-        logits = nn.DenseGeneral(
+        logits = QDense(
             features=self.num_experts, use_bias=False, dtype=jnp.float32,
             param_dtype=jnp.float32, name="wg")(x.astype(jnp.float32))
         rng = None

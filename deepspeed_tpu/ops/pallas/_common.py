@@ -15,3 +15,12 @@ def on_tpu() -> bool:
 def interpret_mode() -> bool:
     """Pallas kernels interpret off-TPU so the suite runs on the CPU mesh."""
     return not on_tpu()
+
+
+def pick_block(dim: int, want: int) -> int:
+    """Largest divisor of ``dim`` <= ``want`` keeping 128-lane alignment
+    (whole dim for small/ragged sizes) — the shared tiling heuristic for
+    the flash / int8-matmul kernels."""
+    import math
+    b = math.gcd(dim, min(want, dim))
+    return b if b % 128 == 0 or b == dim else dim

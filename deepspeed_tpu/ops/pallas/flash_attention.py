@@ -8,12 +8,22 @@ csrc/transformer/inference/). Design:
 - layout: kernels run in BHSD ([batch, heads, seq, head_dim]) so block
   shapes keep the (sublane, lane)-aligned last two dims the Mosaic lowering
   requires; the public API takes BSHD and transposes at dispatch.
-- forward: grid (batch, heads, q_blocks); one q block [Bq, d] against the
-  full K/V [S, d] resident in VMEM (S·d·2B ≤ ~0.5 MB for S≤4096, d≤128 —
-  comfortably inside the ~16 MB VMEM budget), fp32 softmax.
-- backward: grid (batch, heads); fori_loop over q blocks *recomputing* the
-  softmax (flash-style recompute — no S×S matrix and no saved LSE),
-  accumulating dK/dV in registers/VMEM.
+- TWO kernel structures, selected by whether K/V (lane-padded to 128) fit
+  VMEM comfortably (~12MB → seq <= ~8k at head_dim 64):
+  * resident: grid (b, h, q_blocks) with K/V whole in VMEM and a
+    dynamic-trip fori_loop over [Bq, Bk] score tiles — fastest at
+    training lengths (measured 82 TFLOPS fwd+bwd @ s1024 on v5e vs 62
+    for the streamed form);
+  * streamed: grid (b, h, q_blocks, k_blocks) with K/V blocks flowing
+    through the grid and the online-softmax state in VMEM scratch —
+    compiles and runs at any length (16k/32k+).
+- causal mode never computes blocks above the diagonal (dynamic trip
+  counts in resident form, compute-predication in streamed form).
+- forward emits the log-sum-exp rows; backward is two passes sharing that
+  LSE (no softmax recompute pass): q-major for dQ, k-major for dK/dV.
+- all matmuls run in the operand dtype (bf16 hot path) with fp32
+  accumulation via preferred_element_type — the same bf16-in/fp32-acc
+  contract as the XLA einsum path.
 - autodiff via jax.custom_vjp (the reference wires fwd/bwd kernels through
   torch.autograd.Function the same way).
 """
@@ -27,115 +37,170 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 512
+RESIDENT_BLOCK_K = 512   # swept on v5e: resident fori prefers 512,
+STREAMED_BLOCK_K = 1024  # the streamed grid prefers 1024
 NEG_INF = -1e30
-
 
 from ._common import interpret_mode as _interpret
 
 
-def _softmax_tile(q, k, scale, causal, q_offset):
-    """[Bq,d]x[S,d] -> probability tile [Bq,S] (fp32) and the row stats.
+def _causal_mask(s, q_off, k_off):
+    row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_off
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + k_off
+    return jnp.where(col <= row, s, NEG_INF)
 
-    q/k stay in their native dtype (bf16 in the hot path) so the MXU runs
-    at its bf16 rate; accumulation is fp32 via preferred_element_type —
-    the same bf16-in/fp32-acc contract as the XLA einsum path.
 
-    ``q_offset`` already includes the bottom-right causal alignment shift
-    (sk - sq), matching the reference backend's ``tril(..., k_len - q_len)``
-    so both backends agree when sk != sq (decode with KV cache)."""
+from ._common import pick_block as _block
+
+# training-length gate for the single-pass resident backward (its [Bq, S]
+# fp32 tiles + fp32 dK/dV accumulators outgrow VMEM beyond this); module
+# constant so tests can lower it to exercise the long-seq structures
+MONOLITHIC_BWD_MAX_SEQ = 4096
+
+
+def _kv_fits_vmem(s, d, itemsize=2):
+    """Lane-padded, double-buffered K+V bytes within a ~12MB budget."""
+    return s * max(d, 128) * itemsize * 2 * 2 <= 12 * 2 ** 20
+
+
+def _probs(q, k, lse, scale, causal, q_off, k_off):
+    """Probability tile from the saved LSE (one matmul, no running
+    softmax): p = exp(s - lse); causal-masked and fully-masked
+    (lse = -inf) entries come out exactly 0."""
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     if causal:
-        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_offset
-        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(col <= row, s, NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    return p, l
+        s = _causal_mask(s, q_off, k_off)
+    return jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
-                block_k, causal_shift):
-    """Online-softmax flash forward: fori_loop over K blocks so the score
-    tile is [Bq, Bk] (VMEM-bounded for any S) and, in causal mode, blocks
-    strictly above the diagonal are never computed (dynamic trip count —
-    q rows near the top do ~1 block, the bottom does S/Bk)."""
+def _online_step(q, k, v, scale, causal, q_off, k_off, acc, m_acc, l_acc):
+    """One [Bq, Bk] online-softmax update (shared by both structures)."""
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = _causal_mask(s, q_off, k_off)
+    m_new = jnp.maximum(m_acc, jnp.max(s, axis=-1, keepdims=True))
+    # rows with no visible key yet (m still -inf, e.g. shifted-causal top
+    # rows) must contribute p=0, not exp(-inf - -inf) = 1
+    p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
+    alpha = jnp.exp(m_acc - m_new)
+    # PV matmul in the value dtype (bf16 MXU rate); probs are in [0,1] so
+    # the downcast loses at most 2^-9 relative — inside bf16 output noise
+    acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
+                                preferred_element_type=jnp.float32)
+    return acc, m_new, l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
+
+
+def _emit_o_lse(acc, m, l, o_ref, lse_ref):
+    safe_l = jnp.where(l > 0.0, l, 1.0)   # fully-masked rows -> zeros
+    o_ref[0, 0] = (acc / safe_l).astype(o_ref.dtype)
+    # LSE residual for backward; -inf rows stay -inf so bwd re-zeroes them
+    lse_ref[0, 0] = jnp.where(l > 0.0, m + jnp.log(safe_l), NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# resident structure: K/V whole in VMEM, fori over k tiles
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                         causal, block_q, block_k, causal_shift):
     q = q_ref[0, 0]                                    # [Bq, d] native dtype
     d = q.shape[-1]
-    sk = k_ref.shape[2]
-    nkb = sk // block_k
+    nkb = k_ref.shape[2] // block_k
     q_off = pl.program_id(2) * block_q + causal_shift
 
     def body(j, carry):
-        acc, m_acc, l_acc = carry
         ks = pl.ds(j * block_k, block_k)
-        k = k_ref[0, 0, ks, :]
-        v = v_ref[0, 0, ks, :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_off
-            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) \
-                + j * block_k
-            s = jnp.where(col <= row, s, NEG_INF)
-        m_new = jnp.maximum(m_acc, jnp.max(s, axis=-1, keepdims=True))
-        # rows with no visible key yet (m still -inf, e.g. shifted-causal
-        # top rows) must contribute p=0, not exp(-inf - -inf) = 1
-        p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
-        alpha = jnp.exp(m_acc - m_new)
-        l_new = l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        # PV matmul in the value dtype (bf16 MXU rate); probs are in [0,1]
-        # so the downcast loses at most 2^-9 relative — inside bf16 noise
-        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
-                                    preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        return _online_step(q, k_ref[0, 0, ks, :], v_ref[0, 0, ks, :],
+                            scale, causal, q_off, j * block_k, *carry)
 
-    if causal:
-        # last k block the bottom row of this q tile can see
-        trips = jnp.clip((q_off + block_q - 1) // block_k + 1, 1, nkb)
-    else:
-        trips = nkb
+    trips = (jnp.clip((q_off + block_q - 1) // block_k + 1, 1, nkb)
+             if causal else nkb)
     acc, m, l = jax.lax.fori_loop(
         0, trips, body,
         (jnp.zeros((block_q, d), jnp.float32),
          jnp.full((block_q, 1), NEG_INF, jnp.float32),
          jnp.zeros((block_q, 1), jnp.float32)))
-    l = jnp.where(l > 0.0, l, 1.0)   # fully-masked rows (shifted causal)
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    _emit_o_lse(acc, m, l, o_ref, lse_ref)
 
 
-def _pick_block_k(sk, want=512):
-    """Largest divisor of sk <= want keeping 128 alignment; whole-S rows
-    for ragged lengths."""
-    bk = math.gcd(sk, min(want, sk))
-    return bk if bk % 128 == 0 or bk == sk else sk
+def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
+                        dq_ref, *, scale, causal, block_q, block_k,
+                        causal_shift):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    delta = delta_ref[0, 0]
+    lse = lse_ref[0, 0]
+    d = q.shape[-1]
+    nkb = k_ref.shape[2] // block_k
+    q_off = qi * block_q + causal_shift
+
+    def body(j, acc):
+        ks = pl.ds(j * block_k, block_k)
+        k = k_ref[0, 0, ks, :]
+        v = v_ref[0, 0, ks, :]
+        p = _probs(q, k, lse, scale, causal, q_off, j * block_k)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        return acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    trips = (jnp.clip((q_off + block_q - 1) // block_k + 1, 1, nkb)
+             if causal else nkb)
+    acc = jax.lax.fori_loop(0, trips, body,
+                            jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0, 0] = acc.astype(dq_ref.dtype)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q):
-    b, h, sq, d = q.shape
-    sk = k.shape[2]
-    block_q = min(block_q, sq)
-    block_k = _pick_block_k(sk)
-    grid = (b, h, pl.cdiv(sq, block_q))
-    return pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          causal_shift=sk - sq),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        interpret=_interpret(),
-    )(q, k, v)
+def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
+                         dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                         seq_q, causal_shift):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0]                                    # [Bk, d] this block
+    v = v_ref[0, 0]
+    d = k.shape[-1]
+    nqb = seq_q // block_q
+    k_off = ki * block_k
+
+    if causal:
+        # first q block whose bottom row reaches this k block
+        q_lo = jnp.clip((k_off - causal_shift) // block_q, 0, nqb - 1)
+        trips = nqb - q_lo
+    else:
+        q_lo = 0
+        trips = nqb
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        j = q_lo + i
+        qs = pl.ds(j * block_q, block_q)
+        q = q_ref[0, 0, qs, :]
+        do = do_ref[0, 0, qs, :]
+        delta = delta_ref[0, 0, qs, :]
+        lse = lse_ref[0, 0, qs, :]
+        p = _probs(q, k, lse, scale, causal,
+                   j * block_q + causal_shift, k_off)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        dv_acc = dv_acc + jnp.dot(p.astype(do.dtype).T, do,
+                                  preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    dk_acc, dv_acc = jax.lax.fori_loop(
+        0, trips, body,
+        (jnp.zeros((k.shape[0], d), jnp.float32),
+         jnp.zeros((k.shape[0], d), jnp.float32)))
+    dk_ref[0, 0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
 
 
-def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref,
-                dq_ref, dk_ref, dv_ref, *, scale, causal, block_q, seq_q,
-                causal_shift):
+def _bwd_kernel_monolithic(q_ref, k_ref, v_ref, o_ref, do_ref,
+                           dq_ref, dk_ref, dv_ref, *, scale, causal, block_q,
+                           seq_q, causal_shift):
+    """Single-pass resident backward: grid (b, h); K/V (and dK/dV fp32
+    accumulators) whole in VMEM, one fori over q blocks recomputing the
+    [Bq, S] softmax from (q, k, o). Measured fastest at training lengths
+    (one kernel launch, K/V and q/do each loaded once)."""
     k = k_ref[0, 0]                                    # [S, d] native dtype
     v = v_ref[0, 0]
 
@@ -146,21 +211,22 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref,
         o = o_ref[0, 0, qs, :].astype(jnp.float32)
         do = do_ref[0, 0, qs, :]
 
-        p_un, l = _softmax_tile(q, k, scale, causal,
-                                i * block_q + causal_shift)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, i * block_q + causal_shift, 0)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p_un = jnp.exp(s - m)
+        l = jnp.sum(p_un, axis=-1, keepdims=True)
         p = p_un / l                                   # [Bq, S] fp32
 
         delta = jnp.sum(do.astype(jnp.float32) * o, axis=-1, keepdims=True)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
-        # operand downcast for the three grad matmuls (fp32 accumulate):
-        # the bf16-in/fp32-acc contract standard flash backwards use
-        dsl = ds.astype(q.dtype)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         pl_ = p.astype(do.dtype)
 
         dq_ref[0, 0, qs, :] = jnp.dot(
-            dsl, k, preferred_element_type=jnp.float32).astype(dq_ref.dtype)
-        dk_acc = dk_acc + jnp.dot(dsl.T, q, preferred_element_type=jnp.float32)
+            ds, k, preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         dv_acc = dv_acc + jnp.dot(pl_.T, do, preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 
@@ -171,44 +237,284 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref,
     dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
 
 
-def _flash_bwd(scale, causal, block_q, res, g):
-    q, k, v, o = res
+# ---------------------------------------------------------------------------
+# streamed structure: K/V blocks flow through the grid, scratch accumulators
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                         m_ref, l_ref, *, scale, causal, block_q, block_k,
+                         causal_shift, nkb):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    q_off = qi * block_q + causal_shift
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    live = True if not causal else ki * block_k <= q_off + block_q - 1
+
+    @pl.when(live)
+    def _compute():
+        acc, m, l = _online_step(
+            q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], scale, causal, q_off,
+            ki * block_k, acc_ref[...], m_ref[...], l_ref[...])
+        acc_ref[...], m_ref[...], l_ref[...] = acc, m, l
+
+    @pl.when(ki == nkb - 1)
+    def _emit():
+        _emit_o_lse(acc_ref[...], m_ref[...], l_ref[...], o_ref, lse_ref)
+
+
+def _dq_kernel_streamed(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
+                        dq_ref, acc_ref, *, scale, causal, block_q, block_k,
+                        causal_shift, nkb):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+    q_off = qi * block_q + causal_shift
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live = True if not causal else ki * block_k <= q_off + block_q - 1
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        p = _probs(q, k_ref[0, 0], lse_ref[0, 0], scale, causal, q_off,
+                   ki * block_k)
+        dp = jnp.dot(do, v_ref[0, 0].T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, 0]) * scale).astype(q.dtype)
+        acc_ref[...] += jnp.dot(ds, k_ref[0, 0],
+                                preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nkb - 1)
+    def _emit():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel_streamed(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
+                         dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                         block_q, block_k, causal_shift, nqb):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+    q_off = qi * block_q + causal_shift
+    k_off = ki * block_k
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    live = True if not causal else q_off + block_q - 1 >= k_off
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
+        p = _probs(q, k_ref[0, 0], lse_ref[0, 0], scale, causal, q_off,
+                   k_off)
+        dp = jnp.dot(do, v_ref[0, 0].T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, 0]) * scale).astype(q.dtype)
+        dk_acc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        dv_acc[...] += jnp.dot(p.astype(do.dtype).T, do,
+                               preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nqb - 1)
+    def _emit():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+def _flash_fwd(q, k, v, scale, causal, block_q):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    # Smaller q block than fwd: bwd holds three [Bq, S] fp32 tiles
-    # (p, dp, ds) plus fp32 dK/dV accumulators in VMEM. Bound the tiles to
-    # ~6 MB: Bq*S*4B*3 <= 6MB  =>  Bq <= 2^19/S, floored to a 128 multiple.
-    cap = max(128, (2 ** 19 // max(sk, 1)) // 128 * 128)
-    # Largest block <= cap that divides sq: gcd keeps the 128-alignment
-    # whenever sq is itself a multiple of 128 (the pallas-path requirement),
-    # avoiding a degenerate halving spiral for seqs like 1280.
-    block_q = math.gcd(sq, min(block_q, sq, cap))
-    if block_q % 8 != 0:  # non-128-multiple seq: fall back to full rows
-        block_q = sq
-    full_q = pl.BlockSpec((1, 1, sq, d), lambda bi, hi: (bi, hi, 0, 0))
-    full_k = pl.BlockSpec((1, 1, sk, d), lambda bi, hi: (bi, hi, 0, 0))
-    dq, dk, dv = pl.pallas_call(
-        functools.partial(_bwd_kernel, scale=scale, causal=causal,
-                          block_q=block_q, seq_q=sq, causal_shift=sk - sq),
-        grid=(b, h),
-        in_specs=[full_q, full_k, full_k, full_q, full_q],
-        out_specs=(full_q, full_k, full_k),
-        out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
-                   jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+    block_q = _block(sq, min(block_q, sq))
+    out_shape = (jax.ShapeDtypeStruct(q.shape, q.dtype),
+                 jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32))
+    q_blk3 = pl.BlockSpec((1, 1, block_q, d),
+                          lambda bi, hi, qi: (bi, hi, qi, 0))
+    lse_blk3 = pl.BlockSpec((1, 1, block_q, 1),
+                            lambda bi, hi, qi: (bi, hi, qi, 0))
+    if _kv_fits_vmem(sk, d, q.dtype.itemsize):
+        kv_full = pl.BlockSpec((1, 1, sk, d),
+                               lambda bi, hi, qi: (bi, hi, 0, 0))
+        o, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_resident, scale=scale,
+                              causal=causal, block_q=block_q,
+                              block_k=_block(sk, RESIDENT_BLOCK_K),
+                              causal_shift=sk - sq),
+            grid=(b, h, sq // block_q),
+            in_specs=[q_blk3, kv_full, kv_full],
+            out_specs=(q_blk3, lse_blk3),
+            out_shape=out_shape,
+            interpret=_interpret(),
+        )(q, k, v)
+        return o, lse
+    block_k = _block(sk, STREAMED_BLOCK_K)
+    nkb = sk // block_k
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_streamed, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          causal_shift=sk - sq, nkb=nkb),
+        grid=(b, h, sq // block_q, nkb),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ),
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32),
+                        pltpu.VMEM((block_q, 1), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, o, g)
+    )(q, k, v)
+    return o, lse
+
+
+def _flash_bwd(scale, causal, block_q, res, g):
+    q, k, v, o, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+
+    # Training lengths: the single-pass resident backward wins (one
+    # launch; K/V, q, do each read once; measured best 125M e2e on v5e).
+    # Its VMEM budget: K/V + fp32 dK/dV accumulators + 3 [Bq, S] fp32
+    # tiles — comfortable through 4k.
+    if sk <= MONOLITHIC_BWD_MAX_SEQ and sq <= MONOLITHIC_BWD_MAX_SEQ:
+        cap = max(128, (2 ** 19 // max(sk, 1)) // 128 * 128)
+        bq = math.gcd(sq, min(block_q, sq, cap))
+        if bq % 8 != 0:
+            bq = sq
+        full_q = pl.BlockSpec((1, 1, sq, d), lambda bi, hi: (bi, hi, 0, 0))
+        full_k = pl.BlockSpec((1, 1, sk, d), lambda bi, hi: (bi, hi, 0, 0))
+        return pl.pallas_call(
+            functools.partial(_bwd_kernel_monolithic, scale=scale,
+                              causal=causal, block_q=bq, seq_q=sq,
+                              causal_shift=sk - sq),
+            grid=(b, h),
+            in_specs=[full_q, full_k, full_k, full_q, full_q],
+            out_specs=(full_q, full_k, full_k),
+            out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
+                       jax.ShapeDtypeStruct(k.shape, k.dtype),
+                       jax.ShapeDtypeStruct(v.shape, v.dtype)),
+            interpret=_interpret(),
+        )(q, k, v, o, g)
+
+    block_q = _block(sq, min(block_q, sq))
+    resident = (_kv_fits_vmem(sk, d, q.dtype.itemsize)
+                and _kv_fits_vmem(sq, d, q.dtype.itemsize))
+    block_k = _block(sk, RESIDENT_BLOCK_K if resident else STREAMED_BLOCK_K)
+    nqb, nkb = sq // block_q, sk // block_k
+    # delta = rowsum(do * o): cheap elementwise outside the kernels
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    if resident:
+        q_blk = pl.BlockSpec((1, 1, block_q, d),
+                             lambda bi, hi, qi: (bi, hi, qi, 0))
+        q_stat = pl.BlockSpec((1, 1, block_q, 1),
+                              lambda bi, hi, qi: (bi, hi, qi, 0))
+        kv_full = pl.BlockSpec((1, 1, sk, d),
+                               lambda bi, hi, qi: (bi, hi, 0, 0))
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel_resident, scale=scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k,
+                              causal_shift=sk - sq),
+            grid=(b, h, nqb),
+            in_specs=[q_blk, kv_full, kv_full, q_blk, q_stat, q_stat],
+            out_specs=q_blk,
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            interpret=_interpret(),
+        )(q, k, v, g, delta, lse)
+
+        k_blk = pl.BlockSpec((1, 1, block_k, d),
+                             lambda bi, hi, ki: (bi, hi, ki, 0))
+        q_full = pl.BlockSpec((1, 1, sq, d),
+                              lambda bi, hi, ki: (bi, hi, 0, 0))
+        stat_full = pl.BlockSpec((1, 1, sq, 1),
+                                 lambda bi, hi, ki: (bi, hi, 0, 0))
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel_resident, scale=scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k, seq_q=sq,
+                              causal_shift=sk - sq),
+            grid=(b, h, nkb),
+            in_specs=[q_full, k_blk, k_blk, q_full, stat_full, stat_full],
+            out_specs=(k_blk, k_blk),
+            out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                       jax.ShapeDtypeStruct(v.shape, v.dtype)),
+            interpret=_interpret(),
+        )(q, k, v, g, delta, lse)
+        return dq, dk, dv
+
+    q_blk = lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+    k_blk = lambda bi, hi, qi, ki: (bi, hi, ki, 0)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel_streamed, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          causal_shift=sk - sq, nkb=nkb),
+        grid=(b, h, nqb, nkb),
+        in_specs=[pl.BlockSpec((1, 1, block_q, d), q_blk),
+                  pl.BlockSpec((1, 1, block_k, d), k_blk),
+                  pl.BlockSpec((1, 1, block_k, d), k_blk),
+                  pl.BlockSpec((1, 1, block_q, d), q_blk),
+                  pl.BlockSpec((1, 1, block_q, 1), q_blk),
+                  pl.BlockSpec((1, 1, block_q, 1), q_blk)],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), q_blk),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, g, delta, lse)
+
+    kq_k = lambda bi, hi, ki, qi: (bi, hi, ki, 0)
+    kq_q = lambda bi, hi, ki, qi: (bi, hi, qi, 0)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel_streamed, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          causal_shift=sk - sq, nqb=nqb),
+        grid=(b, h, nkb, nqb),
+        in_specs=[pl.BlockSpec((1, 1, block_q, d), kq_q),
+                  pl.BlockSpec((1, 1, block_k, d), kq_k),
+                  pl.BlockSpec((1, 1, block_k, d), kq_k),
+                  pl.BlockSpec((1, 1, block_q, d), kq_q),
+                  pl.BlockSpec((1, 1, block_q, 1), kq_q),
+                  pl.BlockSpec((1, 1, block_q, 1), kq_q)],
+        out_specs=(pl.BlockSpec((1, 1, block_k, d), kq_k),
+                   pl.BlockSpec((1, 1, block_k, d), kq_k)),
+        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, g, delta, lse)
     return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_attention_bhsd(q, k, v, scale, causal, block_q):
-    return _flash_fwd(q, k, v, scale, causal, block_q)
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q)
+    return o
 
 
 def _fwd_rule(q, k, v, scale, causal, block_q):
-    o = _flash_fwd(q, k, v, scale, causal, block_q)
-    return o, (q, k, v, o)
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q)
+    return o, (q, k, v, o, lse)
 
 
 _flash_attention_bhsd.defvjp(_fwd_rule, _flash_bwd)

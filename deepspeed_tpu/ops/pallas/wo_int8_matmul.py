@@ -1,0 +1,112 @@
+"""Weight-only int8 matmul: fused in-kernel dequantization.
+
+Reference: the int8 inference gemms (csrc/transformer/inference/csrc/
+pt_binding.cpp:1197-1244 qkv_gemm_int8 / mlp_gemm_int8 / vector_matmul_int8)
+— activations stay half precision, weights are stored int8 with
+per-output-channel scales and dequantized inside the gemm.
+
+Why a kernel instead of `x @ (q * scale).astype(bf16)`: inside a jitted
+decode loop XLA hoists that loop-invariant dequantization out of the
+`lax.scan`, materializing the full bf16 weight copy in HBM — doubling
+weight memory (fatal for 6.7B-class serving on a 16 GB chip) and reading
+bf16 bytes every step. This kernel reads int8 HBM bytes (half the
+bandwidth of bf16 — decode is weight-bandwidth-bound) and converts
+tile-by-tile in VMEM.
+
+Grid (n_blocks, k_blocks), k innermost; fp32 accumulator scratch persists
+across the k walk; the per-channel scale multiplies the accumulated tile
+once at the end (x @ (q·s) == (x @ q)·s for per-n scales).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import interpret_mode as _interpret
+
+DEFAULT_BLOCK_N = 512
+DEFAULT_BLOCK_K = 1024
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_kb, out_dtype):
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                       # [m, bk] activation dtype
+    w = q_ref[...].astype(x.dtype)       # int8 -> activation dtype (VPU)
+    acc_ref[...] += jnp.dot(x, w, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kb - 1)
+    def _emit():
+        o_ref[...] = (acc_ref[...] * s_ref[...].astype(jnp.float32)) \
+            .astype(out_dtype)
+
+
+MAX_M = 512   # beyond this (prefill), the matmul is compute-bound and the
+              # XLA dequant-fused dot is the right tool; the kernel's edge
+              # is the weight-bandwidth-bound small-m decode case
+
+
+def _wo_int8_2d(x, q, scale, block_n, block_k, out_dtype):
+    from ._common import pick_block
+    m, k = x.shape
+    _, n = q.shape
+    if m > MAX_M:
+        return None   # x tile + fp32 accumulator would scale with m (VMEM)
+    block_n = pick_block(n, block_n)
+    block_k = pick_block(k, block_k)
+    if n % block_n or k % block_k:
+        return None   # caller falls back
+    if block_n * block_k > 8 * 2 ** 20:
+        return None   # ragged dims forced a >8MB VMEM weight tile
+    n_kb = k // block_k
+    grid = (n // block_n, n_kb)
+    return pl.pallas_call(
+        functools.partial(_kernel, n_kb=n_kb, out_dtype=out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m, block_k), lambda ni, ki: (0, ki)),
+            pl.BlockSpec((block_k, block_n), lambda ni, ki: (ki, ni)),
+            pl.BlockSpec((1, block_n), lambda ni, ki: (0, ni)),
+        ],
+        out_specs=pl.BlockSpec((m, block_n), lambda ni, ki: (0, ni)),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((m, block_n), jnp.float32)],
+        interpret=_interpret(),
+    )(x, q, scale.reshape(1, n))
+
+
+def wo_int8_matmul(x, q, scale, *, block_n=DEFAULT_BLOCK_N,
+                   block_k=DEFAULT_BLOCK_K, out_dtype=None):
+    """``x @ (q * scale)`` with int8 ``q`` dequantized in-kernel.
+
+    x: [..., k] activations (bf16/f32); q: [k, n] int8; scale: per-output
+    -channel, any shape broadcastable to [1, n] (module_quantize stores
+    [1, n]). Returns [..., n] in ``out_dtype`` (default: x.dtype).
+
+    Shapes the kernel cannot tile (n or k not divisible by the block
+    size) fall back to the jnp dequant matmul — numerically identical,
+    but subject to XLA's loop hoisting; serving-size models are always
+    128-aligned in practice.
+    """
+    out_dtype = out_dtype or x.dtype
+    k, n = q.shape
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, k)
+    scale = jnp.asarray(scale).reshape(-1)
+    if scale.size == 1:
+        scale = jnp.broadcast_to(scale, (n,))
+    if scale.size != n:
+        raise ValueError(f"scale has {scale.size} elements for n={n}")
+    out = _wo_int8_2d(x2, q, scale, block_n, block_k, out_dtype)
+    if out is None:
+        w = (q.astype(jnp.float32) * scale[None, :]).astype(x.dtype)
+        out = jnp.dot(x2, w, preferred_element_type=jnp.float32) \
+            .astype(out_dtype)
+    return out.reshape(*lead, n)

@@ -50,6 +50,10 @@ class WeightQuantization:
         equal ranges, scale each by its absmax to the signed
         ``quantize_bits`` grid. Returns (int8 array in data's shape,
         per-group scale vector [groups])."""
+        if not 1 <= quantize_bits <= 8:
+            raise ValueError(
+                f"quantize_bits must be in [1, 8] (int8 storage); got "
+                f"{quantize_bits}")
         arr = jnp.asarray(data, jnp.float32)
         n = arr.size
         if n % groups != 0:
@@ -135,7 +139,7 @@ class WeightQuantization:
         if quantize_bits != 8:
             raise NotImplementedError(
                 f"model_quantize supports quantize_bits=8 only (got "
-                f"{quantize_bits}); use sd_quantize for arbitrary widths")
+                f"{quantize_bits}); sd_quantize supports widths 1-8")
         if quantize_policy is not None:
             raise NotImplementedError(
                 "quantize_policy is a torch-module concept; the param-tree "

@@ -1,0 +1,99 @@
+"""End-to-end convergence sanity (reference analog: tests/model/
+Megatron_GPT2 + BingBertSquad run_sanity_check.py — real-model training
+checked for loss movement, scaled down to CI size).
+
+Full engine path: config spine, warmup schedule, grad clipping, bf16
+compute, monitor off, 8-device virtual mesh.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
+from deepspeed_tpu.models.bert import (BertConfig, BertForPreTraining,
+                                       bert_pretrain_loss)
+
+
+def gpt_engine_loss(model, params, batch, rng, train):
+    ids = batch["input_ids"]
+    logits = model.apply(params, ids, deterministic=not train)
+    return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
+
+
+def _avg(xs):
+    return sum(xs) / len(xs)
+
+
+def test_tiny_gpt_converges_through_engine():
+    cfg = GPTConfig(vocab_size=128, max_seq_len=32, d_model=64, n_layers=2,
+                    n_heads=4, dtype=jnp.float32, scan_layers=False)
+    config = {
+        "train_batch_size": 16,       # micro 2 x gas 1 x dp 8 (virtual mesh)
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-3}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 5,
+                                 "warmup_max_lr": 3e-3}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(
+        model=GPT(cfg), config=config, loss_fn=gpt_engine_loss,
+        sample_batch={"input_ids": np.zeros((1, 32), np.int32)},
+        rng=jax.random.PRNGKey(0))
+    # a memorizable stream: fixed batch of random sequences
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, size=(16, 32), dtype=np.int32)}
+    losses = [float(engine.train_batch(batch)) for _ in range(40)]
+    first, last = _avg(losses[:5]), _avg(losses[-5:])
+    assert last < first * 0.7, (first, last)
+    assert np.isfinite(losses).all()
+
+
+def test_tiny_bert_pretraining_converges_through_engine():
+    cfg = BertConfig(vocab_size=96, max_seq_len=24, d_model=48, n_layers=2,
+                     n_heads=4, dtype=jnp.float32, scan_layers=False)
+
+    def loss_fn(model, params, batch, rng, train):
+        mlm_logits, nsp_logits = model.apply(
+            params, batch["input_ids"],
+            token_type_ids=batch["token_type_ids"],
+            attention_mask=batch["attention_mask"],
+            deterministic=not train)
+        return bert_pretrain_loss(mlm_logits, nsp_logits,
+                                  batch["mlm_labels"], batch["nsp_labels"])
+
+    config = {
+        "train_batch_size": 16,       # micro 1 x gas 2 x dp 8 (virtual mesh)
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 2e-3}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10_000,
+    }
+    rng = np.random.default_rng(1)
+    ids = rng.integers(4, 96, size=(16, 24), dtype=np.int32)
+    mlm_labels = np.full((16, 24), -1, np.int32)
+    mask_pos = rng.random((16, 24)) < 0.25
+    mlm_labels[mask_pos] = ids[mask_pos]
+    masked = ids.copy()
+    masked[mask_pos] = 3   # [MASK]
+    batch = {"input_ids": masked,
+             "token_type_ids": np.zeros_like(ids),
+             "attention_mask": np.ones_like(ids),
+             "mlm_labels": mlm_labels,
+             "nsp_labels": rng.integers(0, 2, size=(16,), dtype=np.int32)}
+    engine, _, _, _ = ds.initialize(
+        model=BertForPreTraining(cfg), config=config, loss_fn=loss_fn,
+        sample_batch={k: v[:1] for k, v in batch.items()},
+        rng=jax.random.PRNGKey(0))
+    losses = [float(engine.train_batch(batch)) for _ in range(40)]
+    first, last = _avg(losses[:5]), _avg(losses[-5:])
+    assert last < first * 0.8, (first, last)
+    assert np.isfinite(losses).all()
