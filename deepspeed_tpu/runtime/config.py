@@ -149,6 +149,27 @@ class SchedulerConfig:
 # ---------------------------------------------------------------------------
 
 @dataclass
+class DataTypesConfig:
+    """``data_types`` block (reference: the grad_accum_dtype knob of
+    DeepSpeed's data-type config). ``grad_accum_dtype`` selects the
+    microbatch gradient-accumulation buffer dtype: None/"fp32" (default,
+    the reference's reduce-in-fp32 semantics) or "bf16" — halves the
+    resident grad-buffer HBM at a small accumulation-precision cost
+    (meaningful over large gradient_accumulation_steps)."""
+    grad_accum_dtype: Optional[str] = None
+
+    def resolve(self):
+        v = (self.grad_accum_dtype or "fp32").lower()
+        if v in ("fp32", "float32"):
+            return "float32"
+        if v in ("bf16", "bfloat16"):
+            return "bfloat16"
+        raise DeepSpeedConfigError(
+            f"data_types.grad_accum_dtype must be fp32 or bf16, got "
+            f"{self.grad_accum_dtype!r}")
+
+
+@dataclass
 class ActivationCheckpointingConfig:
     partition_activations: bool = False
     cpu_checkpointing: bool = False
@@ -315,6 +336,7 @@ class DeepSpeedConfig:
 
     activation_checkpointing: ActivationCheckpointingConfig = field(
         default_factory=ActivationCheckpointingConfig)
+    data_types: DataTypesConfig = field(default_factory=DataTypesConfig)
 
     tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = field(default_factory=WandbConfig)
@@ -352,6 +374,7 @@ class DeepSpeedConfig:
         "bf16": BF16Config,
         "zero_optimization": ZeroConfig,
         "activation_checkpointing": ActivationCheckpointingConfig,
+        "data_types": DataTypesConfig,
         "tensorboard": TensorBoardConfig,
         "wandb": WandbConfig,
         "csv_monitor": CSVConfig,
@@ -427,6 +450,10 @@ class DeepSpeedConfig:
     def validate(self):
         if self.fp16.enabled and self.bf16.enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        if self.fp16.enabled and self.data_types.resolve() != "float32":
+            raise DeepSpeedConfigError(
+                "data_types.grad_accum_dtype=bf16 is incompatible with fp16 "
+                "loss scaling (unscale needs fp32 headroom)")
         if self.gradient_clipping < 0:
             raise DeepSpeedConfigError("gradient_clipping must be >= 0")
         if self.zero_optimization.stage > 0 and not (self.fp16.enabled or self.bf16.enabled):

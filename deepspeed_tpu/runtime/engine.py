@@ -519,6 +519,13 @@ class DeepSpeedEngine:
         model = self.module
         loss_fn = self._loss_fn
         offloaded = getattr(self, "_offload_params", False)
+        # reference data_types.grad_accum_dtype: fp32 (default) keeps the
+        # reduce-in-fp32 semantics; bf16 halves the resident grad buffer
+        accum_dtype = jnp.dtype(self.config.data_types.resolve())
+        if fp16 and accum_dtype != jnp.float32:
+            raise DeepSpeedConfigError(
+                "data_types.grad_accum_dtype=bf16 is incompatible with "
+                "fp16 loss scaling (unscale needs fp32 headroom)")
 
         # ZeRO stage >= 2: the grad-accum scan carry is pinned to the ZeRO
         # partition (same rule as the opt state), so full-shape fp32 grads
@@ -559,13 +566,14 @@ class DeepSpeedEngine:
                 mrng = jax.random.fold_in(rng, i)
                 (_, loss), grads = jax.value_and_grad(
                     microbatch_loss, has_aux=True)(params, mb, mrng, scale, extra)
-                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                grads_acc = jax.tree.map(
+                    lambda a, g: a + g.astype(a.dtype), grads_acc, grads)
                 if grad_constraint is not None:
                     grads_acc = grad_constraint(grads_acc)
                 return (grads_acc, loss_acc + loss, i + 1), None
 
             zero_grads = jax.tree.map(
-                lambda s: jnp.zeros(s.shape, jnp.float32), self._param_shapes)
+                lambda s: jnp.zeros(s.shape, accum_dtype), self._param_shapes)
             if offloaded:
                 # offloaded params produce host-space cotangents: their
                 # accumulation buffers must live host-side too (the param
@@ -590,8 +598,9 @@ class DeepSpeedEngine:
             # only their scalars cross to device
             rep_dev = NamedSharding(self.mesh, P())
             gnorm = jnp.sqrt(sum(
-                jax.device_put(jnp.sum(jnp.square(g)), rep_dev) if offloaded
-                else jnp.sum(jnp.square(g))
+                jax.device_put(jnp.sum(jnp.square(g.astype(jnp.float32))),
+                               rep_dev) if offloaded
+                else jnp.sum(jnp.square(g.astype(jnp.float32)))
                 for g in jax.tree.leaves(grads)))
             return grads, mean_loss, gnorm
 

@@ -105,3 +105,55 @@ def get_lr_schedule(name: str, params: dict) -> Callable:
     if name not in SCHEDULE_REGISTRY:
         raise ValueError(f"Unknown scheduler '{name}'. Valid: {VALID_LR_SCHEDULES}")
     return SCHEDULE_REGISTRY[name](**params)
+
+
+def add_tuning_arguments(parser):
+    """Reference: lr_schedules.py:55 — argparse surface for schedule
+    tuning (used by the convergence-tuning workflow and ds CLI)."""
+    group = parser.add_argument_group(
+        "Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training")
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    group.add_argument("--lr_range_test_staircase", type=bool, default=False)
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_first_stair_count", type=int, default=-1)
+    group.add_argument("--cycle_second_step_size", type=int, default=-1)
+    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    group.add_argument("--decay_step_size", type=int, default=1000)
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--warmup_min_lr", type=float, default=0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    group.add_argument("--warmup_type", type=str, default="log")
+    return parser
+
+
+def parse_arguments():
+    """Reference: lr_schedules.py:159."""
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser = add_tuning_arguments(parser)
+    args, unknown = parser.parse_known_args()
+    return args, unknown
+
+
+def get_lr_from_config(config: dict):
+    """Reference: lr_schedules.py:269 — (initial_lr, reason) from a
+    scheduler config dict."""
+    if "type" not in config:
+        return None, "LR schedule type not defined in config"
+    if "params" not in config:
+        return None, "LR schedule params not defined in config"
+    name, params = config["type"], config["params"]
+    if name not in VALID_LR_SCHEDULES:
+        return None, f"{name} is not a valid LR schedule"
+    if name == "LRRangeTest":
+        return params.get("lr_range_test_min_lr", 1e-3), ""
+    if name == "OneCycle":
+        return params.get("cycle_max_lr", 0.1), ""
+    return params.get("warmup_max_lr", 0.001), ""

@@ -1,0 +1,73 @@
+"""data_types.grad_accum_dtype tests (reference: DeepSpeed's data_types
+config block — grad accumulation buffer dtype)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+from deepspeed_tpu.models.gpt import GPT, GPTConfig, gpt_loss_fn
+
+
+def _loss(model, params, batch, rng, train):
+    ids = batch["input_ids"]
+    logits = model.apply(params, ids, deterministic=not train)
+    return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
+
+
+def _engine(accum=None, gas=4):
+    cfg = GPTConfig(vocab_size=128, max_seq_len=16, d_model=32, n_layers=2,
+                    n_heads=4, dtype=jnp.bfloat16, scan_layers=False)
+    config = {"train_batch_size": 8 * gas,
+              "train_micro_batch_size_per_gpu": 1,
+              "gradient_accumulation_steps": gas,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "bf16": {"enabled": True}, "steps_per_print": 10_000}
+    if accum:
+        config["data_types"] = {"grad_accum_dtype": accum}
+    engine, _, _, _ = ds.initialize(
+        model=GPT(cfg), config=config, loss_fn=_loss,
+        sample_batch={"input_ids": np.zeros((1, 16), np.int32)},
+        rng=jax.random.PRNGKey(0))
+    return engine
+
+
+def test_config_parse_and_validation():
+    c = DeepSpeedConfig.from_dict({"train_batch_size": 8,
+                                   "data_types": {"grad_accum_dtype": "bf16"}})
+    assert c.data_types.resolve() == "bfloat16"
+    assert DeepSpeedConfig.from_dict(
+        {"train_batch_size": 8}).data_types.resolve() == "float32"
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig.from_dict(
+            {"train_batch_size": 8,
+             "data_types": {"grad_accum_dtype": "int8"}}).data_types.resolve()
+
+
+def test_bf16_accum_trajectory_close_to_fp32():
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, size=(32, 16), dtype=np.int32)}
+    e32 = _engine(None)
+    e16 = _engine("bf16")
+    l32 = [float(e32.train_batch(batch)) for _ in range(5)]
+    l16 = [float(e16.train_batch(batch)) for _ in range(5)]
+    np.testing.assert_allclose(l32, l16, rtol=2e-2)
+
+
+def test_fp16_rejects_bf16_accum():
+    cfg = GPTConfig(vocab_size=128, max_seq_len=16, d_model=32, n_layers=2,
+                    n_heads=4, dtype=jnp.float16, scan_layers=False)
+    config = {"train_batch_size": 8,
+              "train_micro_batch_size_per_gpu": 1,
+              "gradient_accumulation_steps": 1,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "fp16": {"enabled": True},
+              "data_types": {"grad_accum_dtype": "bf16"},
+              "steps_per_print": 10_000}
+    with pytest.raises(DeepSpeedConfigError, match="grad_accum_dtype"):
+        ds.initialize(model=GPT(cfg), config=config, loss_fn=_loss,
+                      sample_batch={"input_ids": np.zeros((1, 16), np.int32)},
+                      rng=jax.random.PRNGKey(0))

@@ -52,3 +52,23 @@ def test_registry_and_unknown():
     assert callable(s)
     with pytest.raises(ValueError):
         get_lr_schedule("Bogus", {})
+
+
+def test_tuning_arguments_and_get_lr_from_config():
+    """Reference surface: add_tuning_arguments (:55), parse_arguments
+    (:159), get_lr_from_config (:269)."""
+    import argparse
+    from deepspeed_tpu.runtime.lr_schedules import (add_tuning_arguments,
+                                                    get_lr_from_config)
+    p = argparse.ArgumentParser()
+    add_tuning_arguments(p)
+    a = p.parse_args(["--lr_schedule", "OneCycle", "--cycle_max_lr", "0.2"])
+    assert a.lr_schedule == "OneCycle" and a.cycle_max_lr == 0.2
+    lr, msg = get_lr_from_config({"type": "OneCycle",
+                                  "params": {"cycle_max_lr": 0.2}})
+    assert lr == 0.2 and msg == ""
+    lr, msg = get_lr_from_config({"type": "LRRangeTest",
+                                  "params": {"lr_range_test_min_lr": 1e-4}})
+    assert lr == 1e-4
+    assert get_lr_from_config({"type": "Nope", "params": {}})[0] is None
+    assert get_lr_from_config({})[0] is None
