@@ -295,7 +295,31 @@ def bench_fused_epilogue(np, jax, jnp, d=4096, reps=30):
             "epilogue_overhead_pct": round((t_full / t_mm - 1) * 100, 1)}
 
 
+def _device_watchdog(timeout_s=240):
+    """Fail fast (with an honest artifact) instead of hanging forever when
+    the tunneled TPU backend is unreachable — jax backend init blocks
+    indefinitely in that state on this rig."""
+    import threading
+    ok = []
+
+    def probe():
+        import jax
+        ok.append(len(jax.devices()))
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not ok:
+        print(json.dumps({
+            "metric": "gpt2_1p3b_zero_offload_train_tokens_per_sec_per_chip",
+            "value": None, "unit": "tokens/s/chip", "vs_baseline": None,
+            "error": f"accelerator backend unreachable after {timeout_s}s "
+                     "(tunnel down?) — no measurements taken"}))
+        raise SystemExit(0)
+
+
 def main():
+    _device_watchdog()
     import numpy as np
     import jax
     import jax.numpy as jnp

@@ -1,0 +1,80 @@
+"""Shape-matrix robustness for the Pallas kernels (interpret mode).
+
+The reference sweeps its CUDA kernels over batch/seq/head configs
+(test_cuda_forward.py's parametrize grid); this is the analog for the
+flash-attention and int8-matmul kernels: ragged sequence lengths,
+non-128 head dims, KV-cache shifts (sk != sq).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.pallas import flash_attention
+from deepspeed_tpu.ops.transformer.attention import _reference_attention
+
+
+def _qkv(b, s, h, d, sk=None, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (b, s, h, d), dtype)
+    k = jax.random.normal(k2, (b, sk or s, h, d), dtype)
+    v = jax.random.normal(k3, (b, sk or s, h, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("s,d", [(128, 64), (256, 128), (256, 80),
+                                 (384, 64), (250, 64)])
+def test_flash_shapes_vs_reference(s, d):
+    q, k, v = _qkv(1, s, 2, d)
+    out = flash_attention(q, k, v, causal=True)
+    ref = _reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_flash_decode_shift_sk_gt_sq():
+    # KV-cache attention: 1 query over a longer key history, with the
+    # bottom-right causal alignment (query at global position sk-1)
+    q, k, v = _qkv(2, 1, 2, 64, sk=256)
+    out = flash_attention(q, k, v, causal=True, block_q=1)
+    ref = _reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_flash_chunked_prefill_shift():
+    # chunked prefill: 64 queries against 192 cached keys
+    q, k, v = _qkv(1, 64, 2, 64, sk=192)
+    out = flash_attention(q, k, v, causal=True, block_q=64)
+    ref = _reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_flash_grad_nonsquare_head():
+    q, k, v = _qkv(1, 128, 2, 80)
+
+    def loss(fn):
+        return jax.grad(lambda q, k, v: (fn(q, k, v) ** 2).sum(),
+                        argnums=(0, 1, 2))(q, k, v)
+
+    gk = loss(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    gr = loss(lambda q, k, v: _reference_attention(q, k, v, causal=True))
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("m,k,n", [(1, 128, 384), (7, 256, 256),
+                                   (16, 100, 60), (512, 128, 128)])
+def test_wo_int8_shape_matrix(m, k, n):
+    from deepspeed_tpu.ops.pallas.wo_int8_matmul import wo_int8_matmul
+    from deepspeed_tpu.module_inject.module_quantize import _quantize_array
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (m, k), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (k, n), jnp.float32)
+    ql = _quantize_array(w, axis=1)
+    out = wo_int8_matmul(x, ql["q"], ql["scale"])
+    ref = x @ (np.asarray(ql["q"], np.float32) * np.asarray(ql["scale"]))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=3e-3, atol=3e-3)
