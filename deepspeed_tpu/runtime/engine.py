@@ -1025,6 +1025,37 @@ class DeepSpeedEngine:
                                       load_optimizer_states=load_optimizer_states,
                                       load_module_only=load_module_only)
 
+    def _zero3_consolidated_16bit_state_dict(self, dtype=jnp.bfloat16):
+        """Gather the FULL (unsharded) params host-side, floating leaves
+        downcast to ``dtype`` (reference: engine.py:3132 — there via
+        GatheredParameters contexts walking every ZeRO-3 shard; here
+        ``jax.device_get`` on a sharded array materializes the complete
+        logical value, the all-gather the reference hand-codes)."""
+        import numpy as np
+
+        def one(x):
+            arr = jax.device_get(x)
+            if np.issubdtype(arr.dtype, np.floating):
+                arr = np.asarray(arr, jnp.dtype(dtype))
+            return arr
+        return jax.tree.map(one, self.params)
+
+    def save_16bit_model(self, save_dir, save_filename="model_states.msgpack",
+                         dtype=jnp.bfloat16):
+        """Write the consolidated half-precision model weights as one flax
+        msgpack file — loadable without this engine, any mesh, or ZeRO
+        metadata (reference: save_16bit_model, engine.py:3202, the
+        serving-handoff export). Returns the path."""
+        import os
+        from flax import serialization
+        sd = self._zero3_consolidated_16bit_state_dict(dtype=dtype)
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, save_filename)
+        with open(path, "wb") as f:
+            f.write(serialization.to_bytes(sd))
+        log_dist(f"16-bit model saved to {path}", ranks=[0])
+        return path
+
     # ------------------------------------------------------------------
 
     def _print_flops_profile(self, placed_batch):
