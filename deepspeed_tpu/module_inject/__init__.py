@@ -4,6 +4,7 @@ from .replace_module import replace_transformer_layer  # noqa: F401
 from .replace_policy import (  # noqa: F401
     InjectionPolicy, HFGPT2LayerPolicy, HFGPTNEOLayerPolicy,
     HFGPTJLayerPolicy, GPTNEOXLayerPolicy, BLOOMLayerPolicy,
-    HFBertLayerPolicy, replace_policies, POLICY_REGISTRY)
+    HFBertLayerPolicy, replace_policies, POLICY_REGISTRY,
+    export_hf_state_dict)
 from .load_checkpoint import load_model_checkpoint, load_megatron_checkpoint  # noqa: F401
 from .module_quantize import quantize_param_tree, dequantize_param_tree  # noqa: F401

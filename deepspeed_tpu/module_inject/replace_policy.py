@@ -116,6 +116,17 @@ class InjectionPolicy:
     def convert(cls, sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
         raise NotImplementedError
 
+    @classmethod
+    def export(cls, params, cfg, prefix=""):
+        """Inverse of ``convert``: fused param tree -> HF state dict (the
+        reference's revert path, replace_module.py:778). Implemented for
+        the layout-preserving families (GPT-2, BERT); rotary-permuted
+        policies (GPT-J/NeoX/BLOOM) would need the row-permutation
+        inverses and are not supported yet."""
+        raise NotImplementedError(
+            f"{cls.__name__} has no export path (rotary/per-head qkv "
+            "permutations are not inverted); supported: gpt2, bert")
+
 
 class HFGPT2LayerPolicy(InjectionPolicy):
     """GPT-2 (reference: HFGPT2LayerPolicy, replace_policy.py:283)."""
@@ -512,3 +523,128 @@ class MegatronLayerPolicy(InjectionPolicy):
 
 replace_policies.append(MegatronLayerPolicy)
 POLICY_REGISTRY[MegatronLayerPolicy.model_type] = MegatronLayerPolicy
+
+
+# ---------------------------------------------------------------------------
+# export (revert) path: fused param tree -> HF state dict
+# ---------------------------------------------------------------------------
+
+def _unstack(tree):
+    """Inverse of _stack: dict of [L, ...]-stacked arrays -> list of L
+    per-layer dicts."""
+    length = None
+
+    def probe(t):
+        nonlocal length
+        for v in t.values():
+            if isinstance(v, dict):
+                probe(v)
+            elif length is None:
+                length = int(np.asarray(v).shape[0])
+    probe(tree)
+
+    def take(t, i):
+        return {k: (take(v, i) if isinstance(v, dict) else np.asarray(v)[i])
+                for k, v in t.items()}
+    return [take(tree, i) for i in range(length)]
+
+
+def _host32(tree):
+    """Param tree -> plain numpy fp32 (unboxing flax metadata); rejects
+    int8-quantized nodes (export needs dense weights)."""
+    from flax.core import meta as _meta
+    tree = _meta.unbox(tree)
+
+    def one(x, path=""):
+        if isinstance(x, dict):
+            if set(x.keys()) == {"q", "scale"}:
+                raise ValueError(
+                    "cannot export int8-quantized params to a HF state "
+                    "dict — export before quantization (or dequantize)")
+            return {k: one(v) for k, v in x.items()}
+        return np.asarray(x, np.float32)
+    return one(tree)
+
+
+def _emit_ln(sd, prefix, ln):
+    sd[prefix + ".weight"] = ln["scale"]
+    sd[prefix + ".bias"] = ln["bias"]
+
+
+def _gpt2_export(params, cfg, prefix="transformer."):
+    """Inverse of HFGPT2LayerPolicy.convert — Conv1D keeps the [in, out]
+    layout, so kernels copy through untransposed."""
+    p = _host32(params)
+    sd = {prefix + "wte.weight": p["wte"], prefix + "wpe.weight": p["wpe"]}
+    for i, lyr in enumerate(_unstack(p["h"])):
+        lp = f"{prefix}h.{i}."
+        _emit_ln(sd, lp + "ln_1", lyr["ln_1"])
+        _emit_ln(sd, lp + "ln_2", lyr["ln_2"])
+        sd[lp + "attn.c_attn.weight"] = lyr["attn"]["qkv"]["kernel"]
+        sd[lp + "attn.c_attn.bias"] = lyr["attn"]["qkv"]["bias"]
+        sd[lp + "attn.c_proj.weight"] = lyr["attn"]["out"]["kernel"]
+        sd[lp + "attn.c_proj.bias"] = lyr["attn"]["out"]["bias"]
+        sd[lp + "mlp.c_fc.weight"] = lyr["mlp"]["fc_in"]["kernel"]
+        sd[lp + "mlp.c_fc.bias"] = lyr["mlp"]["fc_in"]["bias"]
+        sd[lp + "mlp.c_proj.weight"] = lyr["mlp"]["fc_out"]["kernel"]
+        sd[lp + "mlp.c_proj.bias"] = lyr["mlp"]["fc_out"]["bias"]
+    _emit_ln(sd, prefix + "ln_f", p["ln_f"])
+    if getattr(cfg, "tie_embeddings", True):
+        sd["lm_head.weight"] = p["wte"]
+    return sd
+
+
+def _bert_export(params, cfg, prefix="bert."):
+    """Inverse of HFBertLayerPolicy.convert — torch Linear is [out, in],
+    so kernels transpose back; fused qkv splits into thirds."""
+    p = _host32(params)
+    sd = {
+        prefix + "embeddings.word_embeddings.weight": p["word_embeddings"],
+        prefix + "embeddings.position_embeddings.weight":
+            p["position_embeddings"],
+        prefix + "embeddings.token_type_embeddings.weight":
+            p["token_type_embeddings"],
+    }
+    _emit_ln(sd, prefix + "embeddings.LayerNorm", p["embeddings_ln"])
+    for i, lyr in enumerate(_unstack(p["layer"])):
+        lp = f"{prefix}encoder.layer.{i}."
+        qw = lyr["attn"]["qkv"]["kernel"]          # [in, 3d]
+        qb = lyr["attn"]["qkv"]["bias"]
+        wq, wk, wv = np.split(qw, 3, axis=1)
+        bq, bk, bv = np.split(qb, 3)
+        for name, w, b in (("query", wq, bq), ("key", wk, bk),
+                           ("value", wv, bv)):
+            sd[lp + f"attention.self.{name}.weight"] = _t(w)
+            sd[lp + f"attention.self.{name}.bias"] = b
+        sd[lp + "attention.output.dense.weight"] = _t(lyr["attn"]["out"]["kernel"])
+        sd[lp + "attention.output.dense.bias"] = lyr["attn"]["out"]["bias"]
+        _emit_ln(sd, lp + "attention.output.LayerNorm", lyr["ln_1"])
+        sd[lp + "intermediate.dense.weight"] = _t(lyr["mlp"]["fc_in"]["kernel"])
+        sd[lp + "intermediate.dense.bias"] = lyr["mlp"]["fc_in"]["bias"]
+        sd[lp + "output.dense.weight"] = _t(lyr["mlp"]["fc_out"]["kernel"])
+        sd[lp + "output.dense.bias"] = lyr["mlp"]["fc_out"]["bias"]
+        _emit_ln(sd, lp + "output.LayerNorm", lyr["ln_2"])
+    if "pooler" in p:
+        sd[prefix + "pooler.dense.weight"] = _t(p["pooler"]["kernel"])
+        sd[prefix + "pooler.dense.bias"] = p["pooler"]["bias"]
+    return sd
+
+
+def _gpt2_export_cm(cls, params, cfg, prefix="transformer."):
+    return _gpt2_export(params, cfg, prefix)
+
+
+def _bert_export_cm(cls, params, cfg, prefix="bert."):
+    return _bert_export(params, cfg, prefix)
+
+
+HFGPT2LayerPolicy.export = classmethod(_gpt2_export_cm)
+HFBertLayerPolicy.export = classmethod(_bert_export_cm)
+
+
+def export_hf_state_dict(model_type: str, params, cfg, **kw):
+    """Module-level entry: ``export_hf_state_dict("gpt2", params, cfg)``
+    -> HF-layout numpy state dict (fp32)."""
+    if model_type not in POLICY_REGISTRY:
+        raise ValueError(f"no policy for model_type={model_type!r}")
+    return POLICY_REGISTRY[model_type].export(params, cfg, **kw)

@@ -268,3 +268,19 @@ def test_engine_flops_profiler_wiring(tmp_path):
     assert os.path.exists(out_file)
     text = open(out_file).read()
     assert "flops" in text
+
+
+def test_ds_tpu_bench_cli(tmp_path):
+    """bin/ds_tpu_bench (reference: bin/ds_bench) runs the collective
+    sweep on a virtual CPU mesh and prints the op table."""
+    import subprocess, sys, os
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "repo", "bin", "ds_tpu_bench")
+         if os.path.isdir(os.path.join(repo, "repo")) else
+         os.path.join(repo, "bin", "ds_tpu_bench"),
+         "--cpu", "2", "--minsize", "12", "--maxsize", "12", "--trials", "1"],
+        capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stderr[-500:]
+    assert "all_reduce" in out.stdout and "busbw" in out.stdout

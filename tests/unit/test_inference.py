@@ -385,3 +385,97 @@ class TestMegatronLoader:
         # generation runs on the loaded model
         out = generate(mod_b, params_b, ids, max_new_tokens=3)
         assert out.shape == (2, 13)
+
+
+class TestHFExport:
+    """Revert path (reference: replace_module.py:778 revert_transformer
+    _layer): our fused param tree exports back to a HF state dict;
+    convert -> export roundtrips exactly."""
+
+    def _gpt2_sd(self, L=2, d=32, v=64, pos=16):
+        rng = np.random.default_rng(0)
+        r = lambda *s: rng.standard_normal(s).astype(np.float32)
+        sd = {"wte.weight": r(v, d), "wpe.weight": r(pos, d)}
+        for i in range(L):
+            lp = f"h.{i}."
+            sd.update({
+                lp + "ln_1.weight": r(d), lp + "ln_1.bias": r(d),
+                lp + "ln_2.weight": r(d), lp + "ln_2.bias": r(d),
+                lp + "attn.c_attn.weight": r(d, 3 * d),
+                lp + "attn.c_attn.bias": r(3 * d),
+                lp + "attn.c_proj.weight": r(d, d),
+                lp + "attn.c_proj.bias": r(d),
+                lp + "mlp.c_fc.weight": r(d, 4 * d),
+                lp + "mlp.c_fc.bias": r(4 * d),
+                lp + "mlp.c_proj.weight": r(4 * d, d),
+                lp + "mlp.c_proj.bias": r(d),
+            })
+        sd.update({"ln_f.weight": r(d), "ln_f.bias": r(d)})
+        return sd
+
+    def test_gpt2_roundtrip(self):
+        from deepspeed_tpu.module_inject.replace_policy import (
+            HFGPT2LayerPolicy, export_hf_state_dict)
+        cfg = GPTConfig(vocab_size=64, max_seq_len=16, d_model=32,
+                        n_layers=2, n_heads=2, scan_layers=True)
+        sd = self._gpt2_sd()
+        params = HFGPT2LayerPolicy.convert(sd, cfg)
+        back = export_hf_state_dict("gpt2", params, cfg, prefix="")
+        for k, v in sd.items():
+            np.testing.assert_array_equal(back[k], v, err_msg=k)
+        np.testing.assert_array_equal(back["lm_head.weight"],
+                                      sd["wte.weight"])
+
+    def test_bert_roundtrip(self):
+        from deepspeed_tpu.module_inject.replace_policy import (
+            HFBertLayerPolicy, export_hf_state_dict)
+        from deepspeed_tpu.models.bert import BertConfig
+        rng = np.random.default_rng(1)
+        r = lambda *s: rng.standard_normal(s).astype(np.float32)
+        d, L = 32, 2
+        sd = {
+            "embeddings.word_embeddings.weight": r(64, d),
+            "embeddings.position_embeddings.weight": r(16, d),
+            "embeddings.token_type_embeddings.weight": r(2, d),
+            "embeddings.LayerNorm.weight": r(d),
+            "embeddings.LayerNorm.bias": r(d),
+            "pooler.dense.weight": r(d, d), "pooler.dense.bias": r(d),
+        }
+        for i in range(L):
+            lp = f"encoder.layer.{i}."
+            sd.update({
+                lp + "attention.self.query.weight": r(d, d),
+                lp + "attention.self.query.bias": r(d),
+                lp + "attention.self.key.weight": r(d, d),
+                lp + "attention.self.key.bias": r(d),
+                lp + "attention.self.value.weight": r(d, d),
+                lp + "attention.self.value.bias": r(d),
+                lp + "attention.output.dense.weight": r(d, d),
+                lp + "attention.output.dense.bias": r(d),
+                lp + "attention.output.LayerNorm.weight": r(d),
+                lp + "attention.output.LayerNorm.bias": r(d),
+                lp + "intermediate.dense.weight": r(4 * d, d),
+                lp + "intermediate.dense.bias": r(4 * d),
+                lp + "output.dense.weight": r(d, 4 * d),
+                lp + "output.dense.bias": r(d),
+                lp + "output.LayerNorm.weight": r(d),
+                lp + "output.LayerNorm.bias": r(d),
+            })
+        cfg = BertConfig(vocab_size=64, max_seq_len=16, d_model=d,
+                         n_layers=L, n_heads=2, scan_layers=True)
+        params = HFBertLayerPolicy.convert(sd, cfg)
+        back = export_hf_state_dict("bert", params, cfg, prefix="")
+        for k, v in sd.items():
+            np.testing.assert_array_equal(back[k], v, err_msg=k)
+
+    def test_unsupported_and_quantized_raise(self):
+        from deepspeed_tpu.module_inject.replace_policy import (
+            GPTNEOXLayerPolicy, export_hf_state_dict)
+        with pytest.raises(NotImplementedError, match="export"):
+            GPTNEOXLayerPolicy.export({}, None)
+        cfg = GPTConfig(vocab_size=64, max_seq_len=16, d_model=32,
+                        n_layers=1, n_heads=2, scan_layers=True)
+        qparams = {"wte": {"q": np.zeros((4, 4), np.int8),
+                           "scale": np.ones((1, 4), np.float32)}}
+        with pytest.raises(ValueError, match="quantized"):
+            export_hf_state_dict("gpt2", qparams, cfg, prefix="")
