@@ -101,6 +101,71 @@ def _rotary_halfsplit_perm(rotary_dim, head_dim):
     return perm
 
 
+
+# ---------------------------------------------------------------------------
+# export (revert) helpers: fused param tree -> HF state dict
+# ---------------------------------------------------------------------------
+
+def _unstack(tree):
+    """Inverse of _stack: dict of [L, ...]-stacked arrays -> list of L
+    per-layer dicts."""
+    length = None
+
+    def probe(t):
+        nonlocal length
+        for v in t.values():
+            if isinstance(v, dict):
+                probe(v)
+            elif length is None:
+                length = int(np.asarray(v).shape[0])
+    probe(tree)
+    if length is None:
+        raise ValueError("no stacked layer arrays found in the param "
+                         "subtree — is this a scan_layers=True tree?")
+
+    def take(t, i):
+        return {k: (take(v, i) if isinstance(v, dict) else np.asarray(v)[i])
+                for k, v in t.items()}
+    return [take(tree, i) for i in range(length)]
+
+
+def _layer_list(p, key, n_layers):
+    """Per-layer dicts from either layout: scan-stacked (p[key]) or
+    unrolled (p[f"{key}_0"].. / p[f"{key}_{{i}}"])."""
+    if key in p:
+        return _unstack(p[key])
+    unrolled = [f"{key}_{i}" for i in range(n_layers)]
+    if all(k in p for k in unrolled):
+        return [p[k] for k in unrolled]
+    # BertEncoder's unrolled naming: layer_0..layer_{L-1}
+    raise ValueError(
+        f"param tree has neither a stacked '{key}' subtree nor "
+        f"'{key}_0'..'{key}_{n_layers - 1}' — unknown layer layout")
+
+
+def _host32(tree):
+    """Param tree -> plain numpy fp32 (unboxing flax metadata); rejects
+    int8-quantized nodes (export needs dense weights)."""
+    from flax.core import meta as _meta
+    from .module_quantize import _is_qleaf
+    tree = _meta.unbox(tree)
+
+    def one(x):
+        if _is_qleaf(x):
+            raise ValueError(
+                "cannot export int8-quantized params to a HF state "
+                "dict — export before quantization (or dequantize)")
+        if isinstance(x, dict):
+            return {k: one(v) for k, v in x.items()}
+        return np.asarray(x, np.float32)
+    return one(tree)
+
+
+def _emit_ln(sd, prefix, ln):
+    sd[prefix + ".weight"] = ln["scale"]
+    sd[prefix + ".bias"] = ln["bias"]
+
+
 class InjectionPolicy:
     """Base: subclasses set ``model_type`` (HF config.model_type) and
     implement build_config / convert (reference: DSPolicy ABC,
@@ -170,6 +235,37 @@ class HFGPT2LayerPolicy(InjectionPolicy):
             "h": _stack(layers),
             "ln_f": _ln(sd, pfx + "ln_f"),
         }
+
+
+    @classmethod
+    def export(cls, params, cfg, prefix="transformer."):
+        """Inverse of ``convert`` (the reference's revert path,
+        replace_module.py:778) — Conv1D keeps the [in, out] layout, so
+        kernels copy through untransposed."""
+        p = _host32(params)
+        sd = {prefix + "wte.weight": p["wte"],
+              prefix + "wpe.weight": p["wpe"]}
+        for i, lyr in enumerate(_layer_list(p, "h", cfg.n_layers)):
+            lp = f"{prefix}h.{i}."
+            _emit_ln(sd, lp + "ln_1", lyr["ln_1"])
+            _emit_ln(sd, lp + "ln_2", lyr["ln_2"])
+            sd[lp + "attn.c_attn.weight"] = lyr["attn"]["qkv"]["kernel"]
+            sd[lp + "attn.c_attn.bias"] = lyr["attn"]["qkv"]["bias"]
+            sd[lp + "attn.c_proj.weight"] = lyr["attn"]["out"]["kernel"]
+            sd[lp + "attn.c_proj.bias"] = lyr["attn"]["out"]["bias"]
+            sd[lp + "mlp.c_fc.weight"] = lyr["mlp"]["fc_in"]["kernel"]
+            sd[lp + "mlp.c_fc.bias"] = lyr["mlp"]["fc_in"]["bias"]
+            sd[lp + "mlp.c_proj.weight"] = lyr["mlp"]["fc_out"]["kernel"]
+            sd[lp + "mlp.c_proj.bias"] = lyr["mlp"]["fc_out"]["bias"]
+        _emit_ln(sd, prefix + "ln_f", p["ln_f"])
+        if getattr(cfg, "tie_embeddings", True):
+            sd["lm_head.weight"] = p["wte"]
+        else:
+            # untied head: our QDense kernel is [d, v]; HF Linear is [v, d]
+            sd["lm_head.weight"] = _t(p["lm_head"]["kernel"])
+            if "bias" in p["lm_head"]:
+                sd["lm_head.bias"] = p["lm_head"]["bias"]
+        return sd
 
 
 class HFGPTNEOLayerPolicy(InjectionPolicy):
@@ -446,6 +542,48 @@ class HFBertLayerPolicy(InjectionPolicy):
         return out
 
 
+    @classmethod
+    def export(cls, params, cfg, prefix="bert."):
+        """Inverse of ``convert`` (reference revert path) — torch Linear
+        is [out, in], so kernels transpose back; the fused qkv splits
+        into thirds."""
+        p = _host32(params)
+        sd = {
+            prefix + "embeddings.word_embeddings.weight":
+                p["word_embeddings"],
+            prefix + "embeddings.position_embeddings.weight":
+                p["position_embeddings"],
+            prefix + "embeddings.token_type_embeddings.weight":
+                p["token_type_embeddings"],
+        }
+        _emit_ln(sd, prefix + "embeddings.LayerNorm", p["embeddings_ln"])
+        for i, lyr in enumerate(_layer_list(p, "layer", cfg.n_layers)):
+            lp = f"{prefix}encoder.layer.{i}."
+            qw = lyr["attn"]["qkv"]["kernel"]          # [in, 3d]
+            qb = lyr["attn"]["qkv"]["bias"]
+            wq, wk, wv = np.split(qw, 3, axis=1)
+            bq, bk, bv = np.split(qb, 3)
+            for name, w, b in (("query", wq, bq), ("key", wk, bk),
+                               ("value", wv, bv)):
+                sd[lp + f"attention.self.{name}.weight"] = _t(w)
+                sd[lp + f"attention.self.{name}.bias"] = b
+            sd[lp + "attention.output.dense.weight"] = \
+                _t(lyr["attn"]["out"]["kernel"])
+            sd[lp + "attention.output.dense.bias"] = \
+                lyr["attn"]["out"]["bias"]
+            _emit_ln(sd, lp + "attention.output.LayerNorm", lyr["ln_1"])
+            sd[lp + "intermediate.dense.weight"] = \
+                _t(lyr["mlp"]["fc_in"]["kernel"])
+            sd[lp + "intermediate.dense.bias"] = lyr["mlp"]["fc_in"]["bias"]
+            sd[lp + "output.dense.weight"] = _t(lyr["mlp"]["fc_out"]["kernel"])
+            sd[lp + "output.dense.bias"] = lyr["mlp"]["fc_out"]["bias"]
+            _emit_ln(sd, lp + "output.LayerNorm", lyr["ln_2"])
+        if "pooler" in p:
+            sd[prefix + "pooler.dense.weight"] = _t(p["pooler"]["kernel"])
+            sd[prefix + "pooler.dense.bias"] = p["pooler"]["bias"]
+        return sd
+
+
 # model_type -> policy (reference: replace_policies list, replace_policy.py)
 replace_policies = [HFGPT2LayerPolicy, HFGPTNEOLayerPolicy, HFGPTJLayerPolicy,
                     GPTNEOXLayerPolicy, BLOOMLayerPolicy, HFBertLayerPolicy]
@@ -523,123 +661,6 @@ class MegatronLayerPolicy(InjectionPolicy):
 
 replace_policies.append(MegatronLayerPolicy)
 POLICY_REGISTRY[MegatronLayerPolicy.model_type] = MegatronLayerPolicy
-
-
-# ---------------------------------------------------------------------------
-# export (revert) path: fused param tree -> HF state dict
-# ---------------------------------------------------------------------------
-
-def _unstack(tree):
-    """Inverse of _stack: dict of [L, ...]-stacked arrays -> list of L
-    per-layer dicts."""
-    length = None
-
-    def probe(t):
-        nonlocal length
-        for v in t.values():
-            if isinstance(v, dict):
-                probe(v)
-            elif length is None:
-                length = int(np.asarray(v).shape[0])
-    probe(tree)
-
-    def take(t, i):
-        return {k: (take(v, i) if isinstance(v, dict) else np.asarray(v)[i])
-                for k, v in t.items()}
-    return [take(tree, i) for i in range(length)]
-
-
-def _host32(tree):
-    """Param tree -> plain numpy fp32 (unboxing flax metadata); rejects
-    int8-quantized nodes (export needs dense weights)."""
-    from flax.core import meta as _meta
-    tree = _meta.unbox(tree)
-
-    def one(x, path=""):
-        if isinstance(x, dict):
-            if set(x.keys()) == {"q", "scale"}:
-                raise ValueError(
-                    "cannot export int8-quantized params to a HF state "
-                    "dict — export before quantization (or dequantize)")
-            return {k: one(v) for k, v in x.items()}
-        return np.asarray(x, np.float32)
-    return one(tree)
-
-
-def _emit_ln(sd, prefix, ln):
-    sd[prefix + ".weight"] = ln["scale"]
-    sd[prefix + ".bias"] = ln["bias"]
-
-
-def _gpt2_export(params, cfg, prefix="transformer."):
-    """Inverse of HFGPT2LayerPolicy.convert — Conv1D keeps the [in, out]
-    layout, so kernels copy through untransposed."""
-    p = _host32(params)
-    sd = {prefix + "wte.weight": p["wte"], prefix + "wpe.weight": p["wpe"]}
-    for i, lyr in enumerate(_unstack(p["h"])):
-        lp = f"{prefix}h.{i}."
-        _emit_ln(sd, lp + "ln_1", lyr["ln_1"])
-        _emit_ln(sd, lp + "ln_2", lyr["ln_2"])
-        sd[lp + "attn.c_attn.weight"] = lyr["attn"]["qkv"]["kernel"]
-        sd[lp + "attn.c_attn.bias"] = lyr["attn"]["qkv"]["bias"]
-        sd[lp + "attn.c_proj.weight"] = lyr["attn"]["out"]["kernel"]
-        sd[lp + "attn.c_proj.bias"] = lyr["attn"]["out"]["bias"]
-        sd[lp + "mlp.c_fc.weight"] = lyr["mlp"]["fc_in"]["kernel"]
-        sd[lp + "mlp.c_fc.bias"] = lyr["mlp"]["fc_in"]["bias"]
-        sd[lp + "mlp.c_proj.weight"] = lyr["mlp"]["fc_out"]["kernel"]
-        sd[lp + "mlp.c_proj.bias"] = lyr["mlp"]["fc_out"]["bias"]
-    _emit_ln(sd, prefix + "ln_f", p["ln_f"])
-    if getattr(cfg, "tie_embeddings", True):
-        sd["lm_head.weight"] = p["wte"]
-    return sd
-
-
-def _bert_export(params, cfg, prefix="bert."):
-    """Inverse of HFBertLayerPolicy.convert — torch Linear is [out, in],
-    so kernels transpose back; fused qkv splits into thirds."""
-    p = _host32(params)
-    sd = {
-        prefix + "embeddings.word_embeddings.weight": p["word_embeddings"],
-        prefix + "embeddings.position_embeddings.weight":
-            p["position_embeddings"],
-        prefix + "embeddings.token_type_embeddings.weight":
-            p["token_type_embeddings"],
-    }
-    _emit_ln(sd, prefix + "embeddings.LayerNorm", p["embeddings_ln"])
-    for i, lyr in enumerate(_unstack(p["layer"])):
-        lp = f"{prefix}encoder.layer.{i}."
-        qw = lyr["attn"]["qkv"]["kernel"]          # [in, 3d]
-        qb = lyr["attn"]["qkv"]["bias"]
-        wq, wk, wv = np.split(qw, 3, axis=1)
-        bq, bk, bv = np.split(qb, 3)
-        for name, w, b in (("query", wq, bq), ("key", wk, bk),
-                           ("value", wv, bv)):
-            sd[lp + f"attention.self.{name}.weight"] = _t(w)
-            sd[lp + f"attention.self.{name}.bias"] = b
-        sd[lp + "attention.output.dense.weight"] = _t(lyr["attn"]["out"]["kernel"])
-        sd[lp + "attention.output.dense.bias"] = lyr["attn"]["out"]["bias"]
-        _emit_ln(sd, lp + "attention.output.LayerNorm", lyr["ln_1"])
-        sd[lp + "intermediate.dense.weight"] = _t(lyr["mlp"]["fc_in"]["kernel"])
-        sd[lp + "intermediate.dense.bias"] = lyr["mlp"]["fc_in"]["bias"]
-        sd[lp + "output.dense.weight"] = _t(lyr["mlp"]["fc_out"]["kernel"])
-        sd[lp + "output.dense.bias"] = lyr["mlp"]["fc_out"]["bias"]
-        _emit_ln(sd, lp + "output.LayerNorm", lyr["ln_2"])
-    if "pooler" in p:
-        sd[prefix + "pooler.dense.weight"] = _t(p["pooler"]["kernel"])
-        sd[prefix + "pooler.dense.bias"] = p["pooler"]["bias"]
-    return sd
-
-
-def _gpt2_export_cm(cls, params, cfg, prefix="transformer."):
-    return _gpt2_export(params, cfg, prefix)
-
-
-def _bert_export_cm(cls, params, cfg, prefix="bert."):
-    return _bert_export(params, cfg, prefix)
-
-
-HFGPT2LayerPolicy.export = classmethod(_gpt2_export_cm)
-HFBertLayerPolicy.export = classmethod(_bert_export_cm)
 
 
 def export_hf_state_dict(model_type: str, params, cfg, **kw):

@@ -274,13 +274,11 @@ def test_ds_tpu_bench_cli(tmp_path):
     """bin/ds_tpu_bench (reference: bin/ds_bench) runs the collective
     sweep on a virtual CPU mesh and prints the op table."""
     import subprocess, sys, os
-    repo = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))   # tests/unit/.. -> repo root
     out = subprocess.run(
-        [sys.executable, os.path.join(repo, "repo", "bin", "ds_tpu_bench")
-         if os.path.isdir(os.path.join(repo, "repo")) else
-         os.path.join(repo, "bin", "ds_tpu_bench"),
+        [sys.executable, os.path.join(repo_root, "bin", "ds_tpu_bench"),
          "--cpu", "2", "--minsize", "12", "--maxsize", "12", "--trials", "1"],
-        capture_output=True, text=True, timeout=420)
+        capture_output=True, text=True, timeout=180)
     assert out.returncode == 0, out.stderr[-500:]
     assert "all_reduce" in out.stdout and "busbw" in out.stdout

@@ -479,3 +479,21 @@ class TestHFExport:
                            "scale": np.ones((1, 4), np.float32)}}
         with pytest.raises(ValueError, match="quantized"):
             export_hf_state_dict("gpt2", qparams, cfg, prefix="")
+
+    def test_gpt2_export_untied_head_and_unrolled_layers(self):
+        from deepspeed_tpu.module_inject.replace_policy import \
+            export_hf_state_dict
+        cfg = GPTConfig(vocab_size=64, max_seq_len=16, d_model=32,
+                        n_layers=2, n_heads=2, scan_layers=False,
+                        tie_embeddings=False, dtype=jnp.float32)
+        m = GPT(cfg)
+        ids = jnp.zeros((1, 8), jnp.int32)
+        import flax.core.meta as flax_meta
+        params = flax_meta.unbox(m.init(jax.random.PRNGKey(0), ids))["params"]
+        sd = export_hf_state_dict("gpt2", params, cfg, prefix="")
+        # unrolled h_0/h_1 layout exported per layer
+        assert "h.0.attn.c_attn.weight" in sd and "h.1.ln_2.bias" in sd
+        # untied head emitted with the torch [out, in] layout
+        np.testing.assert_array_equal(
+            sd["lm_head.weight"],
+            np.asarray(params["lm_head"]["kernel"], np.float32).T)
