@@ -259,6 +259,31 @@ class TestInt8Serving:
         assert agree > 0.7, agree
 
 
+    def test_int8_direct_under_tensor_parallel_mesh(self):
+        """QDense's fused-dequant matmul must compile and serve under a
+        model-axis (TP) mesh — pallas custom calls see the sharded
+        operands; token agreement bounds int8 loss, not sharding bugs."""
+        import deepspeed_tpu
+        from deepspeed_tpu.comm import MeshSpec
+        cfg = GPTConfig(vocab_size=97, max_seq_len=64, d_model=64,
+                        n_layers=2, n_heads=4, dtype=jnp.float32,
+                        scan_layers=True)
+        m = GPT(cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(0), (2, 10), 0, 97)
+        params = m.init(jax.random.PRNGKey(0), ids)["params"]
+        dense = deepspeed_tpu.init_inference(
+            m, params=params, dtype=jnp.float32,
+            mesh=MeshSpec(model=2, data=4))
+        q8 = deepspeed_tpu.init_inference(
+            m, params=params, dtype=jnp.float32,
+            mesh=MeshSpec(model=2, data=4), quantize_weights=True,
+            quantize_min_size=256)
+        od = dense.generate(ids, max_new_tokens=5)
+        oq = q8.generate(ids, max_new_tokens=5)
+        agree = (np.asarray(od) == np.asarray(oq)).mean()
+        assert agree > 0.7, agree
+
+
 class TestMoEServing:
     """MoE inference (VERDICT missing #2; reference:
     DeepSpeedMoEInference, moe_inference.py:205): generate() on an
