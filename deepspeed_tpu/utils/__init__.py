@@ -1,2 +1,40 @@
 from .logging import logger, log_dist, print_json_dist, warn_once
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
+from .init_on_device import OnDevice
+
+
+def instrument_w_nvtx(func):
+    """Reference: deepspeed/utils/nvtx.py — wrap hot functions in NVTX
+    ranges. TPU analog: jax.named_scope annotations land in the XLA
+    profile / xprof timeline the way NVTX ranges land in nsight."""
+    import functools
+    import jax
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        with jax.named_scope(func.__qualname__):
+            return func(*args, **kwargs)
+    return wrapped
+
+
+def _lazy():
+    return {
+        "RepeatingLoader": lambda: _from(
+            "deepspeed_tpu.runtime.dataloader", "RepeatingLoader"),
+        "groups": lambda: __import__("deepspeed_tpu.comm.mesh",
+                                     fromlist=["mesh"]),
+    }
+
+
+def _from(mod, name):
+    return getattr(__import__(mod, fromlist=[name]), name)
+
+
+def __getattr__(name):
+    factory = _lazy().get(name)
+    if factory is None:
+        raise AttributeError(f"module 'deepspeed_tpu.utils' has no "
+                             f"attribute {name!r}")
+    value = factory()
+    globals()[name] = value
+    return value
