@@ -109,6 +109,11 @@ def _lazy_exports():
         "zero": lambda: __import__(
             "deepspeed_tpu.runtime.zero", fromlist=["zero"]),
         "moe": lambda: __import__("deepspeed_tpu.moe", fromlist=["moe"]),
+        "pipe": lambda: __import__(
+            "deepspeed_tpu.runtime.pipe", fromlist=["pipe"]),
+        "checkpointing": lambda: _from(
+            "deepspeed_tpu.runtime.activation_checkpointing",
+            "checkpointing"),
         "PipelineModule": lambda: _from(
             "deepspeed_tpu.runtime.pipe.module", "PipelineModule"),
         "LayerSpec": lambda: _from(

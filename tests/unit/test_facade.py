@@ -28,6 +28,8 @@ def test_facade_exports_resolve():
     assert ds.OnDevice.__name__ == "OnDevice"
     assert ds.DeepSpeedTransformerLayer.__name__ == "DeepSpeedTransformerLayer"
     assert ds.zero.Init is not None
+    assert ds.pipe.__name__.endswith("runtime.pipe")
+    assert callable(ds.checkpointing.checkpoint)
     assert callable(ds.log_dist)
     with pytest.raises(AttributeError):
         ds.not_a_real_export
