@@ -37,10 +37,10 @@ from ..config_utils import DeepSpeedConfigError
 from ..lr_schedules import get_lr_schedule
 from ..optimizers import build_optimizer
 from .module import PipelineModule
-from .schedule import (TrainSchedule, LoadMicroBatch, ForwardPass,
-                       BackwardPass, SendActivation, RecvActivation,
-                       SendGrad, RecvGrad, ReduceGrads, ReduceTiedGrads,
-                       OptimizerStep)
+from .schedule import (TrainSchedule, InferenceSchedule, LoadMicroBatch,
+                       ForwardPass, BackwardPass, SendActivation,
+                       RecvActivation, SendGrad, RecvGrad, ReduceGrads,
+                       ReduceTiedGrads, OptimizerStep)
 
 
 class HostDrivenPipelineEngine:
@@ -305,16 +305,47 @@ class HostDrivenPipelineEngine:
 
     # -- eval ----------------------------------------------------------
 
-    def eval_batch(self, batch):
-        if "eval" not in self._compiled:
-            stage_fns = [self._stage_forward(s)
-                         for s in range(self.num_stages)]
-            loss_fn = self.loss_fn
-
-            def run(params, batch):
-                x = batch["input_ids"]
-                for s, fn in enumerate(stage_fns[:-1]):
-                    x = fn(params[s], x)
-                return loss_fn(stage_fns[-1](params[-1], x), batch)
-            self._compiled["eval"] = jax.jit(run)
-        return self._compiled["eval"](self.params, batch)
+    def eval_batch(self, batch, micro_batches: Optional[int] = None):
+        """Forward-only pipelined evaluation executing the
+        ``InferenceSchedule`` instruction stream (reference:
+        InferenceSchedule, schedule.py:129, run by _exec_schedule) — the
+        same mailbox executor as train_batch minus backward/step, so
+        stage k evaluates micro m while stage k-1 runs micro m+1."""
+        ids = jnp.asarray(batch["input_ids"])
+        B = ids.shape[0]
+        n_micro = micro_batches or self.micro_batches
+        if B % n_micro:
+            raise ValueError(f"batch dim {B} not divisible by micro count "
+                             f"{n_micro}")
+        mbsz = B // n_micro
+        micro_ids = [jax.tree.map(lambda x: x[i * mbsz:(i + 1) * mbsz], batch)
+                     for i in range(n_micro)]
+        S = self.num_stages
+        streams = [list(InferenceSchedule(n_micro, S, s).steps())
+                   for s in range(S)]
+        n_buf = 2
+        act_in = [[None] * n_buf for _ in range(S)]
+        mail: Dict[Any, Any] = {}
+        losses = []
+        for t in range(len(streams[0])):
+            for s in range(S):
+                m = t - s       # InferenceSchedule's micro for (t, s)
+                for cmd in streams[s][t]:
+                    b = getattr(cmd, "buffer_id", None)
+                    if isinstance(cmd, LoadMicroBatch):
+                        if s == 0:
+                            act_in[s][b] = micro_ids[m]["input_ids"]
+                    elif isinstance(cmd, RecvActivation):
+                        act_in[s][b] = mail.pop((s, m))
+                    elif isinstance(cmd, ForwardPass):
+                        x = act_in[s][b]
+                        if s == S - 1:
+                            losses.append(self._last_fwd_prog()(
+                                self.params[s], x, micro_ids[m]))
+                        else:   # output reuses the buffer until the send
+                            act_in[s][b] = self._fwd_prog(s)(
+                                self.params[s], x)
+                    elif isinstance(cmd, SendActivation):
+                        mail[(s + 1, m)] = act_in[s][b]
+                        act_in[s][b] = None
+        return jnp.mean(jnp.stack(losses))
