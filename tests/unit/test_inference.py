@@ -522,3 +522,32 @@ class TestHFExport:
         np.testing.assert_array_equal(
             sd["lm_head.weight"],
             np.asarray(params["lm_head"]["kernel"], np.float32).T)
+
+    def test_gpt2_export_loads_into_hf_model(self, ids_np):
+        """Full external loop: HF torch GPT-2 -> inject/convert -> export
+        -> load into a FRESH HF model -> torch logits match the original
+        (proves the exported dict is a real HF checkpoint, not just our
+        inverse)."""
+        from transformers import GPT2Config, GPT2LMHeadModel
+        from deepspeed_tpu.module_inject import (replace_transformer_layer,
+                                                 export_hf_state_dict)
+        torch.manual_seed(0)
+        hf = GPT2LMHeadModel(GPT2Config(vocab_size=90, n_positions=64,
+                                        n_embd=32, n_layer=2, n_head=2))
+        hf.eval()
+        mod, params = replace_transformer_layer(hf, dtype=jnp.float32)
+        sd = export_hf_state_dict("gpt2", params, mod.config)
+        fresh = GPT2LMHeadModel(GPT2Config(vocab_size=90, n_positions=64,
+                                           n_embd=32, n_layer=2, n_head=2))
+        missing, unexpected = fresh.load_state_dict(
+            {k: torch.tensor(v) for k, v in sd.items()}, strict=False)
+        # only non-persistent buffers (attn.bias causal masks) may be missing
+        assert not unexpected, unexpected
+        assert all("attn" in k and "bias" in k or "masked_bias" in k
+                   for k in missing), missing
+        fresh.eval()
+        tids = torch.tensor(ids_np)
+        with torch.no_grad():
+            ref = hf(tids).logits.numpy()
+            got = fresh(tids).logits.numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
