@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from .layers import Block, LayerNorm, QDense, activation_constraint
+from .layers import (Block, LayerNorm, QDense, activation_constraint,
+                     replicated_constraint)
 
 # jax.checkpoint policies keyed by config string (reference analog: the
 # activation_checkpointing config block,
@@ -127,7 +128,11 @@ class GPT(nn.Module):
                 "wpe", nn.with_logical_partitioning(
                     nn.initializers.normal(0.02), ("pos", "embed")),
                 (cfg.max_seq_len, cfg.d_model), cfg.param_dtype)
-            h = h + jnp.take(wpe, positions, axis=0).astype(cfg.dtype)
+            # gather from the replicated table: a ZeRO-3 embed-dim shard
+            # here forces an involuntary-remat reshard (fsdp axis moving
+            # from the feature dim onto the batch tile) in fwd AND bwd
+            h = h + jnp.take(replicated_constraint(wpe), positions,
+                             axis=0).astype(cfg.dtype)
 
         if cfg.embed_ln:
             h = LayerNorm(epsilon=cfg.ln_epsilon, name="emb_ln")(h)

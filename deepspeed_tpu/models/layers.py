@@ -32,6 +32,18 @@ def set_activation_rules(rules: dict):
     _ACTIVATION_RULES = dict(rules or {})
 
 
+def _usable_global_mesh():
+    """The global mesh if a sharding constraint can be applied here, else
+    None. Inside shard_map (Manual axes) the global-mesh NamedSharding is
+    from a different (Auto) mesh view and would poison downstream ops."""
+    from jax.sharding import get_abstract_mesh
+    am = get_abstract_mesh()
+    if not am.empty and any("Manual" in str(t) for t in am.axis_types):
+        return None
+    from ..comm.mesh import peek_global_mesh
+    return peek_global_mesh()
+
+
 def activation_constraint(x, logical_names):
     """Apply with_sharding_constraint if the engine installed rules.
 
@@ -45,14 +57,7 @@ def activation_constraint(x, logical_names):
     if all(a is None for a in axes):
         return x
     try:
-        # inside shard_map (Manual axes) the global-mesh NamedSharding is
-        # from a different (Auto) mesh view and would poison downstream ops
-        from jax.sharding import get_abstract_mesh
-        am = get_abstract_mesh()
-        if not am.empty and any("Manual" in str(t) for t in am.axis_types):
-            return x
-        from ..comm.mesh import peek_global_mesh
-        mesh = peek_global_mesh()
+        mesh = _usable_global_mesh()
         if mesh is None:
             return x
         # drop constraints the array can't honor (dim not divisible by the
@@ -70,6 +75,31 @@ def activation_constraint(x, logical_names):
     except Exception as e:  # never break an un-meshed model run
         from ..utils.logging import warn_once
         warn_once(f"activation sharding constraint skipped: {e}")
+        return x
+
+
+def replicated_constraint(x):
+    """Constrain ``x`` to fully-replicated on the global mesh.
+
+    Used on small lookup tables (e.g. learned position embeddings) right
+    before a gather: a ZeRO-3 "embed"-dim shard would force the SPMD
+    partitioner to move the fsdp axis from the feature dim onto the
+    (data, fsdp) batch tile of the gather output — a transition it can
+    only do by involuntary full rematerialization. One explicit
+    all-gather of the tiny table is the efficient form of the same data
+    movement, and the transposed constraint makes the backward scatter a
+    clean psum instead of the reverse reshard."""
+    if not _ACTIVATION_RULES:
+        return x
+    try:
+        from jax.sharding import PartitionSpec as P, NamedSharding
+        mesh = _usable_global_mesh()
+        if mesh is None:
+            return x
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+    except Exception as e:
+        from ..utils.logging import warn_once
+        warn_once(f"replicated sharding constraint skipped: {e}")
         return x
 
 

@@ -444,7 +444,8 @@ class DeepSpeedEngine:
             adamw = _resolve_adamw(opt_type, opt_params)
             self.streamed_offload = StreamedHostAdam(
                 opt_params, adamw, self.param_specs, self._param_shapes,
-                self.mesh, self.zero_stage)
+                self.mesh, self.zero_stage,
+                param_names=self._param_names)
             self.opt_shardings = self.streamed_offload.state_shardings()
             self.optimizer_state = jax.jit(
                 self.streamed_offload.init,
@@ -457,7 +458,8 @@ class DeepSpeedEngine:
         opt_shapes = jax.eval_shape(self.optimizer.init, self._param_shapes)
         opt_rule = make_opt_state_rules(self.zero_stage, self.mesh)
         self.opt_shardings = map_opt_state_sharding(
-            opt_shapes, self._param_shapes, self.param_specs, opt_rule, self.mesh)
+            opt_shapes, self._param_shapes, self.param_specs, opt_rule,
+            self.mesh, param_names=self._param_names)
         self.optimizer_state = jax.jit(
             self.optimizer.init, out_shardings=self.opt_shardings)(self.params)
 
@@ -467,9 +469,9 @@ class DeepSpeedEngine:
         from .zero.offload_optimizer import CPUAdamOffloadOptimizer
         opt_rule = make_opt_state_rules(max(self.zero_stage, 1), self.mesh)
         grad_specs = jax.tree.map(
-            lambda spec, s: opt_rule(spec, s.shape),
-            self.param_specs, self._param_shapes,
-            is_leaf=lambda x: isinstance(x, P))
+            lambda n, spec, s: opt_rule(spec, s.shape, n),
+            self._param_names, self.param_specs, self._param_shapes,
+            is_leaf=_tree_names_is_leaf)
         self.grad_shardings = _with_host_memory(jax.tree.map(
             lambda spec: NamedSharding(self.mesh, spec), grad_specs,
             is_leaf=lambda x: isinstance(x, P)))
@@ -535,9 +537,9 @@ class DeepSpeedEngine:
         if self.zero_stage >= 2 and self.native_offload is None:
             opt_rule = make_opt_state_rules(self.zero_stage, self.mesh)
             grad_specs = jax.tree.map(
-                lambda spec, s: opt_rule(spec, s.shape),
-                self.param_specs, self._param_shapes,
-                is_leaf=lambda x: isinstance(x, P))
+                lambda n, spec, s: opt_rule(spec, s.shape, n),
+                self._param_names, self.param_specs, self._param_shapes,
+                is_leaf=_tree_names_is_leaf)
 
             grad_shardings = jax.tree.map(
                 lambda spec: NamedSharding(self.mesh, spec), grad_specs,

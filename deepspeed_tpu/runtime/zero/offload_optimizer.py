@@ -242,7 +242,8 @@ class StreamedHostAdam:
     """
 
     def __init__(self, opt_params: Dict[str, Any], adamw: bool,
-                 param_specs, param_shapes, mesh, zero_stage: int):
+                 param_specs, param_shapes, mesh, zero_stage: int,
+                 param_names=None):
         from jax.sharding import PartitionSpec as P
         from .sharding import make_opt_state_rules
 
@@ -253,9 +254,16 @@ class StreamedHostAdam:
         self.adamw = adamw
 
         opt_rule = make_opt_state_rules(max(zero_stage, 1), mesh)
-        moment_specs = jax.tree.map(
-            lambda spec, s: opt_rule(spec, s.shape),
-            param_specs, param_shapes, is_leaf=lambda x: isinstance(x, P))
+        if param_names is not None:
+            from ...utils.tree import _is_names
+            moment_specs = jax.tree.map(
+                lambda n, spec, s: opt_rule(spec, s.shape, n),
+                param_names, param_specs, param_shapes,
+                is_leaf=_is_names)
+        else:
+            moment_specs = jax.tree.map(
+                lambda spec, s: opt_rule(spec, s.shape),
+                param_specs, param_shapes, is_leaf=lambda x: isinstance(x, P))
         self.dev_shardings = jax.tree.map(
             lambda spec: NamedSharding(mesh, spec), moment_specs,
             is_leaf=lambda x: isinstance(x, P))

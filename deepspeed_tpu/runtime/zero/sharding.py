@@ -77,32 +77,57 @@ def make_param_rules(stage: int, persistence_threshold: int = 0,
                 for i, a in enumerate(axes)]
 
         if stage == 3 and int(np.prod(shape)) > persistence_threshold:
-            # Shard over fsdp on the "embed" dim when present, else the
-            # largest still-replicated dim (reference: partition along flat
-            # numel; here we keep a real dim so XLA stays efficient).
-            cand = [i for i, n in enumerate(names) if n == "embed" and axes[i] is None]
-            if not cand:
-                cand = sorted((i for i, a in enumerate(axes) if a is None),
-                              key=lambda i: -shape[i])
-            for i in cand:
-                if _divisible(shape, i, FSDP_AXIS, mesh):
-                    axes[i] = FSDP_AXIS
+            # Gather tables (a "vocab"/"pos" row dim): put fsdp on the ROW
+            # dim, stacked onto any TP axis already there. An embed-dim
+            # shard of a lookup table forces the SPMD partitioner to move
+            # the fsdp axis from the feature dim onto the (data, fsdp)
+            # batch tile of the gather output — an involuntary full
+            # rematerialization in fwd and bwd. Row-sharding instead folds
+            # into the masked-local-gather + psum vocab-parallel pattern.
+            placed = False
+            for i, n in enumerate(names):
+                # dim 0 only: the row dim of a 2-D lookup table. An untied
+                # lm_head matmul kernel ("embed", "vocab") is NOT a gather
+                # table and keeps the embed-dim rule below.
+                if i != 0 or n not in ("vocab", "pos") or len(shape) != 2:
+                    continue
+                existing = axes[i]
+                prior = (tuple(existing) if isinstance(existing, (tuple, list))
+                         else (existing,) if existing is not None else ())
+                combo = (*prior, FSDP_AXIS)
+                if _divisible(shape, i, combo, mesh):
+                    axes[i] = combo if len(combo) > 1 else combo[0]
+                    placed = True
                     break
+            if not placed:
+                # Shard over fsdp on the "embed" dim when present, else the
+                # largest still-replicated dim (reference: partition along
+                # flat numel; here we keep a real dim so XLA stays
+                # efficient).
+                cand = [i for i, n in enumerate(names)
+                        if n == "embed" and axes[i] is None]
+                if not cand:
+                    cand = sorted((i for i, a in enumerate(axes) if a is None),
+                                  key=lambda i: -shape[i])
+                for i in cand:
+                    if _divisible(shape, i, FSDP_AXIS, mesh):
+                        axes[i] = FSDP_AXIS
+                        break
         return P(*axes)
 
     return rules
 
 
 def make_opt_state_rules(stage: int, mesh):
-    """Given a param's spec+shape, return the spec for its optimizer-state
-    leaves (fp32 master copy, Adam moments...).
+    """Given a param's spec+shape (+optional logical dim names), return the
+    spec for its optimizer-state leaves (fp32 master copy, Adam moments...).
 
     stage 0: follow the param. stage >= 1: additionally shard over the
     data(+expert) axes on the largest free dim — the ZeRO-1 partition.
     """
     base_axes = tuple(a for a in ("data", "expert") if mesh.shape.get(a, 1) > 1)
 
-    def rules(param_spec: P, shape):
+    def rules(param_spec: P, shape, names=None):
         if stage < 1 or not base_axes or not shape:
             return param_spec
         axes = list(param_spec) + [None] * (len(shape) - len(param_spec))
@@ -118,6 +143,26 @@ def make_opt_state_rules(stage: int, mesh):
         shard_axes = tuple(a for a in base_axes if a not in used)
         if not shard_axes:
             return P(*axes)
+        # Gather tables (a "vocab"/"pos" row dim): stack the ZeRO partition
+        # onto the ROW dim, combined with any TP/fsdp axis already there.
+        # A feature-dim shard on a table GRAD forces the backward scatter's
+        # updates (batch-sharded cotangents) through an involuntary-full-
+        # rematerialization reshard; a row shard folds into the masked
+        # scatter + reduce the partitioner already emits.
+        if names:
+            for i, n in enumerate(names):
+                # dim 0 of a 2-D table only — see make_param_rules: an
+                # untied lm_head kernel ("embed", "vocab") is a matmul
+                # weight, not a gather table
+                if i != 0 or n not in ("vocab", "pos") or len(shape) != 2:
+                    continue
+                existing = axes[i]
+                prior = (tuple(existing) if isinstance(existing, (tuple, list))
+                         else (existing,) if existing is not None else ())
+                combo = (*prior, *shard_axes)
+                if _divisible(shape, i, combo, mesh):
+                    axes[i] = combo if len(combo) > 1 else combo[0]
+                    return P(*axes)
         free = sorted((i for i, a in enumerate(axes) if a is None),
                       key=lambda i: -shape[i])
         for i in free:

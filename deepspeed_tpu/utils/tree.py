@@ -9,26 +9,40 @@ def _is_spec(x):
     return isinstance(x, P)
 
 
+def _is_names(x):
+    return x is None or (isinstance(x, tuple)
+                         and all(isinstance(e, (str, type(None))) for e in x))
+
+
 def map_opt_state_sharding(opt_state_shapes, param_shapes, param_specs,
-                           opt_rule, mesh):
+                           opt_rule, mesh, param_names=None):
     """Build a NamedSharding tree for an optax state.
 
     Optax states are (nested) tuples whose fields are either param-shaped
     pytrees (Adam moments, master copies) or scalars (count). Any subtree
     whose structure+shapes match the param tree gets per-param specs via
-    ``opt_rule(param_spec, param_shape)``; everything else replicates.
+    ``opt_rule(param_spec, param_shape[, names])``; everything else
+    replicates. ``param_names`` (optional) is the logical-dim-names tree
+    aligned with ``param_shapes`` — lets the rule spot gather tables.
     """
     param_treedef = jtu.tree_structure(param_shapes)
     spec_leaves = jtu.tree_leaves(param_specs, is_leaf=_is_spec)
     shape_leaves = jtu.tree_leaves(param_shapes)
+    if param_names is not None:
+        name_leaves = jtu.tree_leaves(param_names, is_leaf=_is_names)
+    else:
+        name_leaves = [None] * len(shape_leaves)
+    if len(name_leaves) != len(shape_leaves):
+        name_leaves = [None] * len(shape_leaves)
 
     def build(node):
         try:
             if jtu.tree_structure(node) == param_treedef:
                 node_leaves = jtu.tree_leaves(node)
                 if all(n.shape == s.shape for n, s in zip(node_leaves, shape_leaves)):
-                    flat = [NamedSharding(mesh, opt_rule(spec, s.shape))
-                            for spec, s in zip(spec_leaves, shape_leaves)]
+                    flat = [NamedSharding(mesh, opt_rule(spec, s.shape, nm))
+                            for spec, s, nm in
+                            zip(spec_leaves, shape_leaves, name_leaves)]
                     return jtu.tree_unflatten(param_treedef, flat)
         except Exception:
             pass

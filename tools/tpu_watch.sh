@@ -1,0 +1,18 @@
+#!/bin/bash
+# Poll for axon tunnel liveness; when the TPU answers, run bench.py once
+# and exit (the exit re-invokes the caller). Probe uses a hard timeout so
+# a hung jax.devices() never wedges anything.
+cd /root/repo
+for i in $(seq 1 200); do
+  if timeout 75 python -c "import jax; assert jax.default_backend() == 'tpu'; jax.devices()" >/dev/null 2>&1; then
+    echo "TUNNEL LIVE at $(date -u +%H:%M:%S) after $i probes"
+    timeout 3000 python bench.py > /root/repo/BENCH_attempt_r04.json 2> /root/repo/bench_r04.stderr
+    echo "bench exit=$? output:"
+    cat /root/repo/BENCH_attempt_r04.json
+    exit 0
+  fi
+  echo "probe $i: tunnel down at $(date -u +%H:%M:%S)"
+  sleep 240
+done
+echo "gave up after 200 probes"
+exit 1
