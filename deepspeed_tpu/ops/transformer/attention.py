@@ -22,8 +22,14 @@ import jax.numpy as jnp
 
 def _reference_attention(q, k, v, bias=None, mask=None, *, causal=False,
                          softmax_scale=None, dropout_rate=0.0,
-                         dropout_rng=None, deterministic=True):
-    """q,k,v: [batch, seq, heads, head_dim] (BSHD, the JAX-native layout)."""
+                         dropout_rng=None, deterministic=True,
+                         dropout_mask=None):
+    """q,k,v: [batch, seq, heads, head_dim] (BSHD, the JAX-native layout).
+
+    ``dropout_mask``: precomputed boolean keep mask [b, h, sq, sk] —
+    overrides rng sampling. Sequence-parallel callers pass their local
+    slice of a globally-sampled mask (partitionable threefry makes the
+    slices bit-identical to the replicated sample)."""
     *_, q_len, _, head_dim = q.shape
     k_len = k.shape[-3]
     scale = softmax_scale if softmax_scale is not None else head_dim ** -0.5
@@ -43,7 +49,9 @@ def _reference_attention(q, k, v, bias=None, mask=None, *, causal=False,
         logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
 
     probs = jax.nn.softmax(logits, axis=-1)
-    if dropout_rate > 0.0 and not deterministic:
+    if dropout_mask is not None:
+        probs = jnp.where(dropout_mask, probs / (1.0 - dropout_rate), 0.0)
+    elif dropout_rate > 0.0 and not deterministic:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
 
@@ -60,21 +68,28 @@ def attention(q, k, v, bias=None, mask=None, *, causal=False,
     backend: None = auto (pallas flash kernel on TPU when eligible,
     reference otherwise) | "reference" | "pallas".
     seq_parallel: None = auto (ulysses when the mesh's ``seq`` axis > 1)
-    | "ulysses" | "ring" | "none". Sequence-parallel paths require
-    bias/mask-free attention (causal flag is fine) and no dropout.
+    | "ulysses" | "ring" | "none". Bias, mask and dropout ride along on
+    both sequence-parallel paths (ulysses keeps the replicated path's
+    exact dropout pattern via partitionable threefry; ring samples per
+    k/v block). Only shape constraints fall back.
     """
-    sp_mode = _resolve_seq_parallel(seq_parallel, q, bias, mask,
-                                    dropout_rate, deterministic)
+    sp_mode = _resolve_seq_parallel(seq_parallel, q, bias, mask)
     if sp_mode == "ulysses":
         from ...sequence_parallel import ulysses_attention
         inner = functools.partial(attention, backend=backend,
                                   seq_parallel="none")
-        return ulysses_attention(q, k, v, causal=causal,
-                                 softmax_scale=softmax_scale, attn_fn=inner)
+        return ulysses_attention(q, k, v, bias=bias, mask=mask,
+                                 causal=causal, softmax_scale=softmax_scale,
+                                 dropout_rate=dropout_rate,
+                                 dropout_rng=dropout_rng,
+                                 deterministic=deterministic, attn_fn=inner)
     if sp_mode == "ring":
         from ...sequence_parallel import ring_attention
-        return ring_attention(q, k, v, causal=causal,
-                              softmax_scale=softmax_scale)
+        return ring_attention(q, k, v, bias=bias, mask=mask, causal=causal,
+                              softmax_scale=softmax_scale,
+                              dropout_rate=dropout_rate,
+                              dropout_rng=dropout_rng,
+                              deterministic=deterministic)
 
     if backend is None:
         backend = _auto_backend(q, bias, mask, dropout_rate, deterministic)
@@ -96,9 +111,9 @@ def attention(q, k, v, bias=None, mask=None, *, causal=False,
                                 deterministic=deterministic)
 
 
-def _resolve_seq_parallel(seq_parallel, q, bias, mask, dropout_rate,
-                          deterministic):
-    """Pick the sequence-parallel mode; "none" when inapplicable."""
+def _resolve_seq_parallel(seq_parallel, q, bias, mask):
+    """Pick the sequence-parallel mode; "none" when inapplicable.
+    Dropout never disqualifies (both SP paths sample it locally)."""
     if seq_parallel == "none":
         return "none"
     from ...comm.mesh import get_global_mesh, _GLOBAL_MESH
@@ -109,10 +124,20 @@ def _resolve_seq_parallel(seq_parallel, q, bias, mask, dropout_rate,
         if seq_parallel in ("ulysses", "ring"):
             _warn_sp_no_axis()  # explicit request, but no seq axis to use
         return "none"
-    # decode-time q (seq=1 chunks) and masked/biased attention fall back to
-    # the replicated path — XLA all-gathers the seq shards transparently.
-    eligible = (q.ndim == 4 and q.shape[1] % sp == 0 and bias is None
-                and mask is None and (dropout_rate == 0.0 or deterministic))
+    # bias/mask/dropout ride along (sharded operands / partitionable
+    # threefry); only SHAPES disqualify: decode-time q (seq=1 chunks,
+    # XLA all-gathers the seq shards transparently) and operands whose
+    # broadcast dims the region specs can't express (b/h/sq must be 1 or
+    # full-size, the forms every model in models/ produces).
+    def _op_ok(t):
+        return t is None or (
+            t.ndim == 4
+            and all(t.shape[i] in (1, full)
+                    for i, full in ((0, q.shape[0]), (1, q.shape[2]),
+                                    (2, q.shape[1])))
+            and t.shape[3] == q.shape[1])
+    eligible = (q.ndim == 4 and q.shape[1] % sp == 0
+                and _op_ok(bias) and _op_ok(mask))
     if not eligible:
         if seq_parallel is not None:
             _warn_sp_fallback()
@@ -138,9 +163,9 @@ def _warn_sp_no_axis():
 @functools.lru_cache(None)
 def _warn_sp_fallback():
     import warnings
-    warnings.warn("sequence-parallel attention requested but bias/mask/"
-                  "dropout/shape constraints require the replicated path; "
-                  "falling back")
+    warnings.warn("sequence-parallel attention requested but the q/bias/"
+                  "mask shapes (decode-time seq=1 chunks, non-broadcast "
+                  "operand dims) require the replicated path; falling back")
 
 
 @functools.lru_cache(None)

@@ -31,8 +31,17 @@ _SEQ_AXIS = "seq"
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _ring_local(q, k, v, *, axis_name, causal, softmax_scale):
-    """Local shard computation: q/k/v [b, s_l, h, d]."""
+def _ring_local(q, k, v, bias=None, mask=None, dropout_rng=None, *,
+                axis_name, causal, softmax_scale, dropout_rate=0.0):
+    """Local shard computation: q/k/v [b, s_l, h, d].
+
+    ``bias``/``mask`` arrive with their sq dim already local (sharded over
+    the ring axis, or broadcast size-1) and their sk dim GLOBAL — each
+    step dynamic-slices the current source block's key columns. Dropout
+    samples per (q-block, k-block) pair from ``fold_in(rng, my*sp+src)``:
+    iid bernoulli with the configured rate, deterministic in the ring
+    layout, but not bit-identical to the replicated path's sample (unlike
+    Ulysses, whose local logits tile the global [b,h,sq,sk] array)."""
     sp = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, s_l, h, d = q.shape
@@ -42,6 +51,7 @@ def _ring_local(q, k, v, *, axis_name, causal, softmax_scale):
     qpos = jnp.arange(s_l)[:, None]          # local row offsets
     kpos = jnp.arange(s_l)[None, :]
     perm = [(i, (i + 1) % sp) for i in range(sp)]
+    dropout_on = dropout_rate > 0.0 and dropout_rng is not None
 
     def step(carry, t):
         k_blk, v_blk, acc, m, denom = carry
@@ -49,18 +59,35 @@ def _ring_local(q, k, v, *, axis_name, causal, softmax_scale):
         # [b, h, s_l, s_l] logits
         logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
                             k_blk.astype(jnp.float32))
+        if bias is not None:
+            bias_blk = lax.dynamic_slice_in_dim(
+                bias, src * s_l, s_l, axis=-1) if bias.shape[-1] != s_l \
+                else bias
+            logits = logits + bias_blk
         if causal:
             gq = my * s_l + qpos             # global positions
             gk = src * s_l + kpos
             logits = jnp.where((gk <= gq)[None, None], logits, _NEG_INF)
+        if mask is not None:
+            mask_blk = lax.dynamic_slice_in_dim(
+                mask, src * s_l, s_l, axis=-1) if mask.shape[-1] != s_l \
+                else mask
+            logits = jnp.where(mask_blk, logits, _NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1))
         # rows with no valid key yet keep m == -inf; guard the exp args
         safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(logits - safe_m[..., None])
         p = jnp.where(jnp.isfinite(logits), p, 0.0)
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        p_use = p
+        if dropout_on:
+            # dropout zeroes softmax PROBS: the denominator accumulates
+            # the un-dropped sums, the numerator the dropped ones
+            blk_rng = jax.random.fold_in(dropout_rng, my * sp + src)
+            keep = jax.random.bernoulli(blk_rng, 1.0 - dropout_rate, p.shape)
+            p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         acc = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+            "bhqk,bkhd->bhqd", p_use, v_blk.astype(jnp.float32))
         denom = denom * corr + p.sum(axis=-1)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
@@ -76,25 +103,65 @@ def _ring_local(q, k, v, *, axis_name, causal, softmax_scale):
     return out.transpose(0, 2, 1, 3).astype(q.dtype)   # [b, s_l, h, d]
 
 
-def ring_attention(q, k, v, *, causal=True, softmax_scale=None, mesh=None,
-                   axis_name=_SEQ_AXIS, batch_axes=_BATCH_AXES,
-                   head_axis=_HEAD_AXIS):
+def ring_attention(q, k, v, *, bias=None, mask=None, causal=True,
+                   softmax_scale=None, dropout_rate=0.0, dropout_rng=None,
+                   deterministic=True, mesh=None, axis_name=_SEQ_AXIS,
+                   batch_axes=_BATCH_AXES, head_axis=_HEAD_AXIS):
     """Ring attention over seq-sharded [B, S, H, D] global arrays.
 
     Unlike Ulysses there is no head-divisibility requirement, so it also
     covers few-head / GQA-ish models; comm is P-1 neighbor permutes.
-    """
+
+    bias/mask ([b|1, h|1, sq|1, sk]): the sq dim is sharded over the ring
+    (when full-size), the sk dim stays global per device and each step
+    slices the current source block — O(S^2/P) operand memory, the price
+    of an explicit dense mask (banded/causal patterns should use
+    ``causal`` which is index-computed, O(1)). Dropout is iid per
+    (q-block, k-block) via fold_in — not bit-identical to the replicated
+    path's sample (see _ring_local)."""
     mesh = mesh or get_global_mesh()
     sp = mesh.shape[axis_name]
+    dropout_on = dropout_rate > 0.0 and not deterministic
+    if dropout_on and dropout_rng is None:
+        raise ValueError("ring_attention: dropout_rate > 0 with "
+                         "deterministic=False requires dropout_rng")
     if sp == 1:
         from ..ops.transformer.attention import attention as attn_fn
-        return attn_fn(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        return attn_fn(q, k, v, bias=bias, mask=mask, causal=causal,
+                       softmax_scale=softmax_scale,
+                       dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                       deterministic=deterministic)
     if q.shape[1] % sp != 0:
         raise ValueError(f"sequence length {q.shape[1]} not divisible by sp={sp}")
 
     spec = P(_fit_axes(q.shape[0], batch_axes, mesh), axis_name,
              _fit_axes(q.shape[2], head_axis, mesh), None)
-    local = partial(_ring_local, axis_name=axis_name, causal=causal,
-                    softmax_scale=softmax_scale)
-    return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+
+    def _op_spec(t):
+        # [b|1, h|1, sq|1, sk]: shard sq over the ring when full-size;
+        # sk global (stepwise-sliced); batch/head when real and divisible
+        b, h, sq = t.shape[0], t.shape[1], t.shape[2]
+        return P(_fit_axes(b, batch_axes, mesh) if b > 1 else None,
+                 _fit_axes(h, head_axis, mesh) if h > 1 else None,
+                 axis_name if sq == q.shape[1] and sq % sp == 0 else None,
+                 None)
+
+    extras = [("bias", bias), ("mask", mask),
+              ("dropout_rng", dropout_rng if dropout_on else None)]
+    present = [(n, t) for n, t in extras if t is not None]
+    extra_specs = tuple(P() if n == "dropout_rng" else _op_spec(t)
+                        for n, t in present)
+    names = tuple(n for n, _ in present)
+
+    def local(q, k, v, *extra):
+        ops = dict(zip(names, extra))
+        return _ring_local(q, k, v, bias=ops.get("bias"),
+                           mask=ops.get("mask"),
+                           dropout_rng=ops.get("dropout_rng"),
+                           axis_name=axis_name, causal=causal,
+                           softmax_scale=softmax_scale,
+                           dropout_rate=dropout_rate if dropout_on else 0.0)
+
+    return shard_map(local, mesh=mesh,
+                     in_specs=(spec, spec, spec) + extra_specs,
+                     out_specs=spec)(q, k, v, *(t for _, t in present))

@@ -53,23 +53,59 @@ def _fit_axes(dim_size, axes, mesh):
     return tuple(kept) if len(kept) > 1 else kept[0]
 
 
-def ulysses_attention(q, k, v, *, causal=False, softmax_scale=None,
-                      attn_fn=None, mesh=None, axis_name=_SEQ_AXIS,
-                      batch_axes=_BATCH_AXES, head_axis=_HEAD_AXIS):
+def _bhqk_spec(shape, mesh, batch_axes, head_sub_axes):
+    """Spec for a [b|1, h|1, sq|1, sk] operand (mask/bias/keep) entering
+    the shard_map region: batch sharded when real, the head dim sharded
+    the way the post-all-to-all q/k/v heads are laid out (outer TP axis,
+    then the seq axis — the a2a keeps chunk ``seq_index`` of each local
+    head block), q/k dims replicated (the local core sees full sequence).
+    Broadcast (size-1) dims stay replicated."""
+    b, h = shape[0], shape[1]
+    return P(_fit_axes(b, batch_axes, mesh) if b > 1 else None,
+             _fit_axes(h, head_sub_axes, mesh) if h > 1 else None,
+             None, None)
+
+
+def ulysses_attention(q, k, v, *, bias=None, mask=None, causal=False,
+                      softmax_scale=None, dropout_rate=0.0, dropout_rng=None,
+                      deterministic=True, attn_fn=None, mesh=None,
+                      axis_name=_SEQ_AXIS, batch_axes=_BATCH_AXES,
+                      head_axis=_HEAD_AXIS):
     """Full-sequence attention over seq-sharded inputs, [B, S, H, D] global.
 
     ``attn_fn(q, k, v, causal=..., softmax_scale=...)`` is the local
     attention core (default: the ops.transformer dispatch, so the Pallas
     flash kernel is used on TPU when eligible). Requires
     ``H / tp_degree`` divisible by the seq-axis size.
+
+    bias/mask ([b|1, h|1, sq|1, sk]) ride into the region pre-sharded on
+    the head dim to match the post-all-to-all head layout — no extra
+    collective. Dropout keeps EXACT parity with the replicated path: the
+    keep mask is sampled at global [b, h, sq, sk] shape with a sharding
+    constraint, and partitionable threefry generates each device's slice
+    bit-identically to the unsharded sample.
     """
     mesh = mesh or get_global_mesh()
     sp = mesh.shape[axis_name]
     if attn_fn is None:
         from ..ops.transformer.attention import attention
         attn_fn = partial(attention, seq_parallel="none")
+    dropout_on = dropout_rate > 0.0 and not deterministic
     if sp == 1:
-        return attn_fn(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        # keep the documented (q, k, v, causal=, softmax_scale=) attn_fn
+        # contract when no operands ride along; only operand-carrying
+        # calls need the full attention() signature
+        extra_kwargs = {}
+        if bias is not None:
+            extra_kwargs["bias"] = bias
+        if mask is not None:
+            extra_kwargs["mask"] = mask
+        if dropout_on:
+            extra_kwargs.update(dropout_rate=dropout_rate,
+                                dropout_rng=dropout_rng,
+                                deterministic=deterministic)
+        return attn_fn(q, k, v, causal=causal, softmax_scale=softmax_scale,
+                       **extra_kwargs)
 
     n_heads, seq_len = q.shape[2], q.shape[1]
     tp = mesh.shape.get(head_axis, 1)
@@ -82,15 +118,46 @@ def ulysses_attention(q, k, v, *, causal=False, softmax_scale=None,
         raise ValueError(f"sequence length {seq_len} not divisible by sp={sp}")
 
     spec = _qkv_spec(q.shape, mesh, batch_axes, axis_name, head_axis)
+    head_sub = ((head_axis, axis_name) if tp > 1 else (axis_name,))
 
-    def local_fn(q, k, v):
+    keep = None
+    if dropout_on:
+        # global-shape sample, sharded like the local logits: each device
+        # generates exactly its [b, h/(tp*sp), sq, sk] slice
+        keep = jax.random.bernoulli(
+            dropout_rng, 1.0 - dropout_rate,
+            (q.shape[0], n_heads, seq_len, k.shape[1]))
+        keep = jax.lax.with_sharding_constraint(
+            keep, jax.sharding.NamedSharding(
+                mesh, _bhqk_spec(keep.shape, mesh, batch_axes, head_sub)))
+
+    extras = [(name, t) for name, t in
+              (("bias", bias), ("mask", mask), ("keep", keep))
+              if t is not None]
+    extra_specs = tuple(_bhqk_spec(t.shape, mesh, batch_axes, head_sub)
+                        for _, t in extras)
+    extra_names = tuple(name for name, _ in extras)
+
+    def local_fn(q, k, v, *extra):
+        ops = dict(zip(extra_names, extra))
         # [b, s/sp, h, d] -> [b, s, h/sp, d]: the head<->seq swap
         q, k, v = (lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
                                   tiled=True) for t in (q, k, v))
-        out = attn_fn(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        if ops:
+            # operands force the dense core (the flash kernel takes no
+            # bias/mask/dropout) — same rule as the attention() dispatch
+            from ..ops.transformer.attention import _reference_attention
+            out = _reference_attention(
+                q, k, v, bias=ops.get("bias"), mask=ops.get("mask"),
+                causal=causal, softmax_scale=softmax_scale,
+                dropout_rate=dropout_rate, dropout_mask=ops.get("keep"),
+                deterministic=not dropout_on)
+        else:
+            out = attn_fn(q, k, v, causal=causal, softmax_scale=softmax_scale)
         # [b, s, h/sp, d] -> [b, s/sp, h, d]
         return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    return shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec) + extra_specs,
+        out_specs=spec)(q, k, v, *(t for _, t in extras))
