@@ -101,6 +101,28 @@ def _rotary_halfsplit_perm(rotary_dim, head_dim):
     return perm
 
 
+def _inv_perm(perm):
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size)
+    return inv
+
+
+def _split_qkv_to_headfirst(w, n_heads):
+    """Inverse of _headfirst_qkv_to_split: [in, 3d] out-dim laid out
+    [3, heads, hd] (ours) -> [heads, 3, hd] (HF NeoX/BLOOM)."""
+    d_in, d3 = w.shape
+    hd = d3 // (3 * n_heads)
+    w = w.reshape(d_in, 3, n_heads, hd)
+    return np.ascontiguousarray(
+        w.transpose(0, 2, 1, 3).reshape(d_in, d3))
+
+
+def _split_qkv_bias_to_headfirst(b, n_heads):
+    hd = b.shape[0] // (3 * n_heads)
+    return np.ascontiguousarray(
+        b.reshape(3, n_heads, hd).transpose(1, 0, 2).reshape(-1))
+
+
 
 # ---------------------------------------------------------------------------
 # export (revert) helpers: fused param tree -> HF state dict
@@ -184,13 +206,12 @@ class InjectionPolicy:
     @classmethod
     def export(cls, params, cfg, prefix=""):
         """Inverse of ``convert``: fused param tree -> HF state dict (the
-        reference's revert path, replace_module.py:778). Implemented for
-        the layout-preserving families (GPT-2, BERT); rotary-permuted
-        policies (GPT-J/NeoX/BLOOM) would need the row-permutation
-        inverses and are not supported yet."""
+        reference's revert path, replace_module.py:778). Every HF-family
+        policy implements it, inverting its own qkv/rotary row
+        permutations (e.g. _inv_perm(_rotary_halfsplit_perm(...)));
+        Megatron checkpoints are loaded, not exported."""
         raise NotImplementedError(
-            f"{cls.__name__} has no export path (rotary/per-head qkv "
-            "permutations are not inverted); supported: gpt2, bert")
+            f"{cls.__name__} has no export path")
 
 
 class HFGPT2LayerPolicy(InjectionPolicy):
@@ -324,6 +345,48 @@ class HFGPTNEOLayerPolicy(InjectionPolicy):
         }
 
 
+    @classmethod
+    def export(cls, params, cfg, prefix="transformer."):
+        """Inverse of ``convert``: un-scale q (our kernel always applies
+        1/sqrt(hd); HF GPT-Neo is unscaled) and re-transpose to torch
+        Linear [out, in]. HF GPT-Neo has no qkv bias — a trained nonzero
+        bias cannot be represented and raises."""
+        p = _host32(params)
+        d = cfg.d_model
+        qscale = float(cfg.head_dim) ** 0.5
+        sd = {prefix + "wte.weight": p["wte"],
+              prefix + "wpe.weight": p["wpe"]}
+        for i, lyr in enumerate(_layer_list(p, "h", cfg.n_layers)):
+            lp = f"{prefix}h.{i}."
+            _emit_ln(sd, lp + "ln_1", lyr["ln_1"])
+            _emit_ln(sd, lp + "ln_2", lyr["ln_2"])
+            qkv = lyr["attn"]["qkv"]["kernel"]
+            qkv_b = lyr["attn"]["qkv"].get("bias")
+            if qkv_b is not None and np.abs(qkv_b).max() > 1e-8:
+                raise ValueError(
+                    "HF GPT-Neo attention has no qkv bias; this model's "
+                    "trained qkv bias cannot be exported losslessly")
+            sd[lp + "attn.attention.q_proj.weight"] = _t(qkv[:, :d] / qscale)
+            sd[lp + "attn.attention.k_proj.weight"] = _t(qkv[:, d:2 * d])
+            sd[lp + "attn.attention.v_proj.weight"] = _t(qkv[:, 2 * d:])
+            sd[lp + "attn.attention.out_proj.weight"] = \
+                _t(lyr["attn"]["out"]["kernel"])
+            sd[lp + "attn.attention.out_proj.bias"] = \
+                lyr["attn"]["out"]["bias"]
+            sd[lp + "mlp.c_fc.weight"] = _t(lyr["mlp"]["fc_in"]["kernel"])
+            sd[lp + "mlp.c_fc.bias"] = lyr["mlp"]["fc_in"]["bias"]
+            sd[lp + "mlp.c_proj.weight"] = _t(lyr["mlp"]["fc_out"]["kernel"])
+            sd[lp + "mlp.c_proj.bias"] = lyr["mlp"]["fc_out"]["bias"]
+        _emit_ln(sd, prefix + "ln_f", p["ln_f"])
+        if getattr(cfg, "tie_embeddings", True):
+            sd["lm_head.weight"] = p["wte"]
+        else:
+            sd["lm_head.weight"] = _t(p["lm_head"]["kernel"])
+            if "bias" in p["lm_head"]:
+                sd["lm_head.bias"] = p["lm_head"]["bias"]
+        return sd
+
+
 class HFGPTJLayerPolicy(InjectionPolicy):
     """GPT-J (reference: HFGPTJLayerPolicy, replace_policy.py:158)."""
     model_type = "gptj"
@@ -379,6 +442,41 @@ class HFGPTJLayerPolicy(InjectionPolicy):
         }
 
 
+    @classmethod
+    def export(cls, params, cfg, prefix="transformer."):
+        """Inverse of ``convert``: undo the interleaved->half-split rotary
+        row permutation on q/k (apply _inv_perm of the same permutation;
+        v was never permuted) and re-transpose to torch Linear layout."""
+        p = _host32(params)
+        hd = cfg.head_dim
+        inv = _inv_perm(_rotary_halfsplit_perm(cfg.rotary_dim or hd, hd))
+
+        def unpermute_rows(w):  # [in, d], out-dim is axis 1
+            w = w.reshape(w.shape[0], cfg.n_heads, hd)
+            return np.ascontiguousarray(
+                w[:, :, inv].reshape(w.shape[0], -1))
+
+        d = cfg.d_model
+        sd = {prefix + "wte.weight": p["wte"]}
+        for i, lyr in enumerate(_layer_list(p, "h", cfg.n_layers)):
+            lp = f"{prefix}h.{i}."
+            _emit_ln(sd, lp + "ln_1", lyr["ln_1"])
+            qkv = lyr["attn"]["qkv"]["kernel"]
+            sd[lp + "attn.q_proj.weight"] = _t(unpermute_rows(qkv[:, :d]))
+            sd[lp + "attn.k_proj.weight"] = \
+                _t(unpermute_rows(qkv[:, d:2 * d]))
+            sd[lp + "attn.v_proj.weight"] = _t(qkv[:, 2 * d:])
+            sd[lp + "attn.out_proj.weight"] = _t(lyr["attn"]["out"]["kernel"])
+            sd[lp + "mlp.fc_in.weight"] = _t(lyr["mlp"]["fc_in"]["kernel"])
+            sd[lp + "mlp.fc_in.bias"] = lyr["mlp"]["fc_in"]["bias"]
+            sd[lp + "mlp.fc_out.weight"] = _t(lyr["mlp"]["fc_out"]["kernel"])
+            sd[lp + "mlp.fc_out.bias"] = lyr["mlp"]["fc_out"]["bias"]
+        _emit_ln(sd, prefix + "ln_f", p["ln_f"])
+        sd["lm_head.weight"] = _t(p["lm_head"]["kernel"])
+        sd["lm_head.bias"] = p["lm_head"]["bias"]
+        return sd
+
+
 class GPTNEOXLayerPolicy(InjectionPolicy):
     """GPT-NeoX / Pythia (reference: GPTNEOXLayerPolicy, replace_policy.py:362)."""
     model_type = "gpt_neox"
@@ -432,6 +530,34 @@ class GPTNEOXLayerPolicy(InjectionPolicy):
         }
 
 
+    @classmethod
+    def export(cls, params, cfg, prefix="gpt_neox."):
+        """Inverse of ``convert``: ours [3, heads, hd] qkv out-dim back to
+        HF NeoX's per-head [heads, 3, hd] fusion, then torch transpose."""
+        p = _host32(params)
+        nh = cfg.n_heads
+        sd = {prefix + "embed_in.weight": p["wte"]}
+        for i, lyr in enumerate(_layer_list(p, "h", cfg.n_layers)):
+            lp = f"{prefix}layers.{i}."
+            _emit_ln(sd, lp + "input_layernorm", lyr["ln_1"])
+            _emit_ln(sd, lp + "post_attention_layernorm", lyr["ln_2"])
+            sd[lp + "attention.query_key_value.weight"] = _t(
+                _split_qkv_to_headfirst(lyr["attn"]["qkv"]["kernel"], nh))
+            sd[lp + "attention.query_key_value.bias"] = \
+                _split_qkv_bias_to_headfirst(lyr["attn"]["qkv"]["bias"], nh)
+            sd[lp + "attention.dense.weight"] = _t(lyr["attn"]["out"]["kernel"])
+            sd[lp + "attention.dense.bias"] = lyr["attn"]["out"]["bias"]
+            sd[lp + "mlp.dense_h_to_4h.weight"] = \
+                _t(lyr["mlp"]["fc_in"]["kernel"])
+            sd[lp + "mlp.dense_h_to_4h.bias"] = lyr["mlp"]["fc_in"]["bias"]
+            sd[lp + "mlp.dense_4h_to_h.weight"] = \
+                _t(lyr["mlp"]["fc_out"]["kernel"])
+            sd[lp + "mlp.dense_4h_to_h.bias"] = lyr["mlp"]["fc_out"]["bias"]
+        _emit_ln(sd, prefix + "final_layer_norm", p["ln_f"])
+        sd["embed_out.weight"] = _t(p["lm_head"]["kernel"])
+        return sd
+
+
 class BLOOMLayerPolicy(InjectionPolicy):
     """BLOOM (reference: BLOOMLayerPolicy, replace_policy.py:323) — the
     BASELINE config #5 inference family."""
@@ -480,6 +606,36 @@ class BLOOMLayerPolicy(InjectionPolicy):
             "h": _stack(layers),
             "ln_f": _ln(sd, pfx + "ln_f"),
         }
+
+
+    @classmethod
+    def export(cls, params, cfg, prefix="transformer."):
+        """Inverse of ``convert``: same per-head qkv un-fusion as NeoX;
+        embeddings are tied (HF BloomForCausalLM ties lm_head to
+        word_embeddings, so emitting the embedding suffices)."""
+        p = _host32(params)
+        nh = cfg.n_heads
+        sd = {prefix + "word_embeddings.weight": p["wte"]}
+        _emit_ln(sd, prefix + "word_embeddings_layernorm", p["emb_ln"])
+        for i, lyr in enumerate(_layer_list(p, "h", cfg.n_layers)):
+            lp = f"{prefix}h.{i}."
+            _emit_ln(sd, lp + "input_layernorm", lyr["ln_1"])
+            _emit_ln(sd, lp + "post_attention_layernorm", lyr["ln_2"])
+            sd[lp + "self_attention.query_key_value.weight"] = _t(
+                _split_qkv_to_headfirst(lyr["attn"]["qkv"]["kernel"], nh))
+            sd[lp + "self_attention.query_key_value.bias"] = \
+                _split_qkv_bias_to_headfirst(lyr["attn"]["qkv"]["bias"], nh)
+            sd[lp + "self_attention.dense.weight"] = \
+                _t(lyr["attn"]["out"]["kernel"])
+            sd[lp + "self_attention.dense.bias"] = lyr["attn"]["out"]["bias"]
+            sd[lp + "mlp.dense_h_to_4h.weight"] = \
+                _t(lyr["mlp"]["fc_in"]["kernel"])
+            sd[lp + "mlp.dense_h_to_4h.bias"] = lyr["mlp"]["fc_in"]["bias"]
+            sd[lp + "mlp.dense_4h_to_h.weight"] = \
+                _t(lyr["mlp"]["fc_out"]["kernel"])
+            sd[lp + "mlp.dense_4h_to_h.bias"] = lyr["mlp"]["fc_out"]["bias"]
+        _emit_ln(sd, prefix + "ln_f", p["ln_f"])
+        return sd
 
 
 class HFBertLayerPolicy(InjectionPolicy):
