@@ -463,18 +463,26 @@ class DeepSpeedEngine:
         self.optimizer_state = jax.jit(
             self.optimizer.init, out_shardings=self.opt_shardings)(self.params)
 
-    def _configure_native_offload(self, off, opt_type):
-        """Grad shardings = the ZeRO partition, landing in pinned host
-        memory; host state built from the current params."""
-        from .zero.offload_optimizer import CPUAdamOffloadOptimizer
-        opt_rule = make_opt_state_rules(max(self.zero_stage, 1), self.mesh)
+    def _zero_grad_shardings(self, stage):
+        """NamedSharding tree for gradients under the ZeRO partition:
+        the (names-aware) opt-state rule applied to every param — the
+        reduce-scatter target the reference hand-codes in
+        stage_1_and_2.py:895 average_tensor."""
+        opt_rule = make_opt_state_rules(stage, self.mesh)
         grad_specs = jax.tree.map(
             lambda n, spec, s: opt_rule(spec, s.shape, n),
             self._param_names, self.param_specs, self._param_shapes,
             is_leaf=_tree_names_is_leaf)
-        self.grad_shardings = _with_host_memory(jax.tree.map(
+        return jax.tree.map(
             lambda spec: NamedSharding(self.mesh, spec), grad_specs,
-            is_leaf=lambda x: isinstance(x, P)))
+            is_leaf=lambda x: isinstance(x, P))
+
+    def _configure_native_offload(self, off, opt_type):
+        """Grad shardings = the ZeRO partition, landing in pinned host
+        memory; host state built from the current params."""
+        from .zero.offload_optimizer import CPUAdamOffloadOptimizer
+        self.grad_shardings = _with_host_memory(
+            self._zero_grad_shardings(max(self.zero_stage, 1)))
         opt_params = dict(self.config.optimizer.params) if self.config.optimizer else {}
         adamw = _resolve_adamw(opt_type, opt_params)
         self.native_offload = CPUAdamOffloadOptimizer(
@@ -535,15 +543,7 @@ class DeepSpeedEngine:
         # the reference hand-codes in stage_1_and_2.py:895 average_tensor.
         grad_constraint = None
         if self.zero_stage >= 2 and self.native_offload is None:
-            opt_rule = make_opt_state_rules(self.zero_stage, self.mesh)
-            grad_specs = jax.tree.map(
-                lambda n, spec, s: opt_rule(spec, s.shape, n),
-                self._param_names, self.param_specs, self._param_shapes,
-                is_leaf=_tree_names_is_leaf)
-
-            grad_shardings = jax.tree.map(
-                lambda spec: NamedSharding(self.mesh, spec), grad_specs,
-                is_leaf=lambda x: isinstance(x, P))
+            grad_shardings = self._zero_grad_shardings(self.zero_stage)
 
             def grad_constraint(g):
                 if offloaded:
@@ -858,7 +858,25 @@ class DeepSpeedEngine:
             def fwd(params, batch, rng, extra):
                 return jax.value_and_grad(
                     lambda p: loss_fn(model, p, batch, rng, True, **extra))(params)
-            self._compiled["fwd_grads"] = jax.jit(fwd)
+            # ZeRO stage >= 2: grads leave the step already in the ZeRO
+            # partition, so the host-persistent accumulation buffer
+            # (self._accum_grads, carried across backward() calls) is
+            # sharded like the opt state instead of replicated — the
+            # parity-API analog of the fused path's scan-carry constraint.
+            # With offloaded params, host-space grad leaves keep their
+            # own placement (None = unconstrained), mirroring the fused
+            # path's per-leaf _offload_mask handling.
+            grad_out = None
+            if self.zero_stage >= 2 and self.native_offload is None:
+                grad_out = self._zero_grad_shardings(self.zero_stage)
+                if getattr(self, "_offload_params", False):
+                    grad_out = jax.tree.map(
+                        lambda sh, off: None if off else sh,
+                        grad_out, self._offload_mask,
+                        is_leaf=lambda x: isinstance(x, NamedSharding))
+            self._compiled["fwd_grads"] = jax.jit(
+                fwd, out_shardings=None if grad_out is None
+                else (None, grad_out))
         if (self.curriculum_scheduler is not None
                 and self.curriculum_scheduler.config.curriculum_type == "seqlen"):
             seqlen = self.curriculum_scheduler.update_difficulty(
