@@ -13,9 +13,12 @@ bf16 bytes every step. This kernel reads int8 HBM bytes (half the
 bandwidth of bf16 — decode is weight-bandwidth-bound) and converts
 tile-by-tile in VMEM.
 
-Grid (n_blocks, k_blocks), k innermost; fp32 accumulator scratch persists
-across the k walk; the per-channel scale multiplies the accumulated tile
-once at the end (x @ (q·s) == (x @ q)·s for per-n scales).
+Grid (m_blocks, n_blocks, k_blocks), k innermost; fp32 accumulator
+scratch persists across the k walk; the per-channel scale multiplies the
+accumulated tile once at the end (x @ (q·s) == (x @ q)·s for per-n
+scales). Decode (m small) runs one m-block exactly as before; prefill
+(m large) tiles the row dim so long prompts stay int8-resident too —
+no full bf16 weight copy ever lands in HBM.
 """
 
 import functools
@@ -27,18 +30,19 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._common import interpret_mode as _interpret
 
+DEFAULT_BLOCK_M = 512
 DEFAULT_BLOCK_N = 512
 DEFAULT_BLOCK_K = 1024
 
 
 def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_kb, out_dtype):
-    ki = pl.program_id(1)
+    ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _zero():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    x = x_ref[...]                       # [m, bk] activation dtype
+    x = x_ref[...]                       # [bm, bk] activation dtype
     w = q_ref[...].astype(x.dtype)       # int8 -> activation dtype (VPU)
     acc_ref[...] += jnp.dot(x, w, preferred_element_type=jnp.float32)
 
@@ -48,47 +52,57 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_kb, out_dtype):
             .astype(out_dtype)
 
 
-MAX_M = 512   # beyond this (prefill), the matmul is compute-bound and the
-              # XLA dequant-fused dot is the right tool; the kernel's edge
-              # is the weight-bandwidth-bound small-m decode case
-
-
-def _wo_int8_2d(x, q, scale, block_n, block_k, out_dtype):
+def _wo_int8_2d(x, q, scale, block_m, block_n, block_k, out_dtype):
     from ._common import pick_block
     m, k = x.shape
     _, n = q.shape
-    if m > MAX_M:
-        return None   # x tile + fp32 accumulator would scale with m (VMEM)
     block_n = pick_block(n, block_n)
     block_k = pick_block(k, block_k)
     if n % block_n or k % block_k:
         return None   # caller falls back
     if block_n * block_k > 8 * 2 ** 20:
         return None   # ragged dims forced a >8MB VMEM weight tile
+    # decode: one row-block of exactly m; prefill: tile m. Prefer an
+    # aligned divisor of m (no padding, no extra x round-trip); only a
+    # ragged m with no VMEM-sized divisor pays a zero-padded tail (rows
+    # are independent — padding contributes nothing and is sliced off).
+    block_m = min(block_m, m)
+    bm = pick_block(m, block_m)
+    if bm <= 2 * DEFAULT_BLOCK_M:
+        block_m, pad_m = bm, 0
+    else:
+        pad_m = (-m) % block_m
+        x = jnp.pad(x, ((0, pad_m), (0, 0)))
+    m_pad = m + pad_m
     n_kb = k // block_k
-    grid = (n // block_n, n_kb)
-    return pl.pallas_call(
+    grid = (m_pad // block_m, n // block_n, n_kb)
+    out = pl.pallas_call(
         functools.partial(_kernel, n_kb=n_kb, out_dtype=out_dtype),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((m, block_k), lambda ni, ki: (0, ki)),
-            pl.BlockSpec((block_k, block_n), lambda ni, ki: (ki, ni)),
-            pl.BlockSpec((1, block_n), lambda ni, ki: (0, ni)),
+            pl.BlockSpec((block_m, block_k), lambda mi, ni, ki: (mi, ki)),
+            pl.BlockSpec((block_k, block_n), lambda mi, ni, ki: (ki, ni)),
+            pl.BlockSpec((1, block_n), lambda mi, ni, ki: (0, ni)),
         ],
-        out_specs=pl.BlockSpec((m, block_n), lambda ni, ki: (0, ni)),
-        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
-        scratch_shapes=[pltpu.VMEM((m, block_n), jnp.float32)],
+        out_specs=pl.BlockSpec((block_m, block_n),
+                               lambda mi, ni, ki: (mi, ni)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
         interpret=_interpret(),
     )(x, q, scale.reshape(1, n))
+    return out[:m] if pad_m else out
 
 
-def wo_int8_matmul(x, q, scale, *, block_n=DEFAULT_BLOCK_N,
-                   block_k=DEFAULT_BLOCK_K, out_dtype=None):
+def wo_int8_matmul(x, q, scale, *, block_m=DEFAULT_BLOCK_M,
+                   block_n=DEFAULT_BLOCK_N, block_k=DEFAULT_BLOCK_K,
+                   out_dtype=None):
     """``x @ (q * scale)`` with int8 ``q`` dequantized in-kernel.
 
     x: [..., k] activations (bf16/f32); q: [k, n] int8; scale: per-output
     -channel, any shape broadcastable to [1, n] (module_quantize stores
     [1, n]). Returns [..., n] in ``out_dtype`` (default: x.dtype).
+    Any m is supported (decode m=1 through long-prompt prefill — the m
+    dim is tiled at ``block_m`` with zero-padded ragged tails).
 
     Shapes the kernel cannot tile (n or k not divisible by the block
     size) fall back to the jnp dequant matmul — numerically identical,
@@ -104,7 +118,7 @@ def wo_int8_matmul(x, q, scale, *, block_n=DEFAULT_BLOCK_N,
         scale = jnp.broadcast_to(scale, (n,))
     if scale.size != n:
         raise ValueError(f"scale has {scale.size} elements for n={n}")
-    out = _wo_int8_2d(x2, q, scale, block_n, block_k, out_dtype)
+    out = _wo_int8_2d(x2, q, scale, block_m, block_n, block_k, out_dtype)
     if out is None:
         w = (q.astype(jnp.float32) * scale[None, :]).astype(x.dtype)
         out = jnp.dot(x2, w, preferred_element_type=jnp.float32) \

@@ -67,7 +67,11 @@ def test_flash_grad_nonsquare_head():
 
 
 @pytest.mark.parametrize("m,k,n", [(1, 128, 384), (7, 256, 256),
-                                   (16, 100, 60), (512, 128, 128)])
+                                   (16, 100, 60), (512, 128, 128),
+                                   # prefill sizes: m tiles past one block,
+                                   # incl. a ragged tail (VERDICT r3 #5)
+                                   (1024, 128, 128), (1000, 256, 128),
+                                   (2048, 128, 256)])
 def test_wo_int8_shape_matrix(m, k, n):
     from deepspeed_tpu.ops.pallas.wo_int8_matmul import wo_int8_matmul
     from deepspeed_tpu.module_inject.module_quantize import _quantize_array
