@@ -68,7 +68,7 @@ def _wo_int8_2d(x, q, scale, block_m, block_n, block_k, out_dtype):
     # are independent — padding contributes nothing and is sliced off).
     block_m = min(block_m, m)
     bm = pick_block(m, block_m)
-    if bm <= 2 * DEFAULT_BLOCK_M:
+    if bm <= 2 * block_m:   # caller's block_m is the VMEM budget
         block_m, pad_m = bm, 0
     else:
         pad_m = (-m) % block_m

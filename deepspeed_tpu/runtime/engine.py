@@ -278,12 +278,11 @@ class DeepSpeedEngine:
         and XLA's latency-hiding scheduler overlaps block k+1's h2d with
         block k's compute — the coordinator's prefetch, by compilation."""
         off = self.config.zero_optimization.offload_param
+        self._param_swapper = None
+        self._params_on_disk = False
         if off is None or off.device not in ("cpu", "nvme"):
             self._offload_params = False
             return
-        if off.device == "nvme":
-            logger.warning("offload_param.device=nvme has no NVMe tier yet; "
-                           "params stream via host memory")
         if self.config.fp16.enabled:
             raise DeepSpeedConfigError(
                 "offload_param currently supports bf16/fp32 training only "
@@ -305,8 +304,27 @@ class DeepSpeedEngine:
             self.module = type(self.module)(
                 dataclasses.replace(mcfg, offload_params=True))
         self._offload_params = True
+        if off.device == "nvme":
+            # NVMe tier (reference: partitioned_param_swapper.py:36): the
+            # stacked block params persist on SSD and leave host RAM
+            # BETWEEN steps when they exceed max_in_cpu;
+            # _ensure_params_resident pages them back with async
+            # prefetched reads before any use. During the step the full
+            # stacked tree must be host-resident (the fused jit consumes
+            # whole arrays as autodiff inputs — the reference's per-layer
+            # in-step window does not compose with whole-tree autodiff
+            # under jit; the in-step h2d window is still per-block via
+            # stream_in). Constructed AFTER the config validations so a
+            # rejected config never spawns the aio thread pool.
+            import os as _os
+            from .swap_tensor.swapper import AsyncTensorSwapper
+            self._param_swapper = AsyncTensorSwapper(
+                _os.path.join(off.nvme_path, "zero_params"),
+                n_threads=max(2, int(off.buffer_count)))
         log_dist("ZeRO-Infinity param offload: block params in host "
-                 "memory, streamed per scan step", ranks=[0])
+                 "memory, streamed per scan step"
+                 + (" (NVMe tier between steps)"
+                    if self._param_swapper else ""), ranks=[0])
 
     def _warn_inert_zero_knobs(self):
         """Stage-3 fetch-coordinator knobs are subsumed by the
@@ -462,6 +480,70 @@ class DeepSpeedEngine:
             self.mesh, param_names=self._param_names)
         self.optimizer_state = jax.jit(
             self.optimizer.init, out_shardings=self.opt_shardings)(self.params)
+
+    # ------------------------------------------------------------------
+    # ZeRO-Infinity param NVMe tier: page offloaded block params between
+    # SSD and host RAM around the step (swap_tensor/swapper.py)
+    # ------------------------------------------------------------------
+
+    def _evict_params_to_nvme(self):
+        """After the step: async-write the offloaded (host-side stacked
+        block) param leaves to SSD, then drop the host arrays — between
+        steps host RAM holds only the small resident params.
+
+        Gated on ``offload_param.max_in_cpu`` (reference semantics: bytes
+        of params allowed to stay in host RAM): models under the
+        threshold skip the per-step SSD round-trip entirely."""
+        if self._param_swapper is None or self._params_on_disk:
+            return
+        off = self.config.zero_optimization.offload_param
+        offloaded_bytes = sum(
+            leaf.size * jnp.dtype(leaf.dtype).itemsize
+            for leaf, m in zip(jax.tree.leaves(self.params),
+                               jax.tree.leaves(self._offload_mask)) if m)
+        if offloaded_bytes <= int(off.max_in_cpu):
+            return
+        flat, treedef = jax.tree.flatten(self.params)
+        paths = [p for p, _ in jax.tree.flatten_with_path(self.params)[0]]
+        mask = jax.tree.leaves(self._offload_mask)
+        new_leaves = []
+        for path, leaf, off in zip(paths, flat, mask):
+            if off:
+                name = "param" + jax.tree_util.keystr(path)
+                self._param_swapper.swap_out(name, np.asarray(leaf))
+                new_leaves.append(jax.ShapeDtypeStruct(leaf.shape, leaf.dtype))
+            else:
+                new_leaves.append(leaf)
+        # join writes BEFORE dropping the jax arrays backing the buffers
+        self._param_swapper.flush()
+        self.params = jax.tree.unflatten(treedef, new_leaves)
+        self._params_on_disk = True
+
+    def _ensure_params_resident(self):
+        """Page NVMe-evicted param leaves back into host memory. Reads
+        are all issued first (the aio thread pool overlaps them), then
+        consumed in order — the reference's prefetch pipelining."""
+        if not self._params_on_disk:
+            return
+        flat, treedef = jax.tree.flatten(self.params)
+        paths = [p for p, _ in jax.tree.flatten_with_path(self.params)[0]]
+        mask = jax.tree.leaves(self._offload_mask)
+        shardings = jax.tree.leaves(
+            self.param_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        names = ["param" + jax.tree_util.keystr(p) for p in paths]
+        for name, off in zip(names, mask):
+            if off:
+                self._param_swapper.prefetch(name)
+        new_leaves = []
+        for name, leaf, off, sh in zip(names, flat, mask, shardings):
+            if off:
+                buf = self._param_swapper.swap_in(name)
+                new_leaves.append(jax.device_put(buf, sh))
+            else:
+                new_leaves.append(leaf)
+        self.params = jax.tree.unflatten(treedef, new_leaves)
+        self._params_on_disk = False
 
     def _zero_grad_shardings(self, stage):
         """NamedSharding tree for gradients under the ZeRO partition:
@@ -750,6 +832,7 @@ class DeepSpeedEngine:
         batch = self._place_batch(batch, with_gas_dim=True)
 
         self.tput_timer.start()
+        self._ensure_params_resident()
         scaler = self.loss_scale_state or init_loss_scale(1.0)
         rng = jax.random.fold_in(self.rng, self.global_steps + 1)
         extra = {}
@@ -790,6 +873,7 @@ class DeepSpeedEngine:
         if self.global_steps % cfg.steps_per_print == 0:
             self._report_step(metrics)
         self._write_monitor(metrics)
+        self._evict_params_to_nvme()
         return metrics["loss"]
 
     def _apply_weight_projections(self):
@@ -852,6 +936,7 @@ class DeepSpeedEngine:
         (autodiff needs the forward anyway; caching avoids recompute).
         Applies the same curriculum truncation / PLD theta as the fused
         train_batch path."""
+        self._ensure_params_resident()
         if "fwd_grads" not in self._compiled:
             model, loss_fn = self.module, self._loss_fn
 
@@ -985,6 +1070,7 @@ class DeepSpeedEngine:
                      f"grad_norm={float(gnorm):.3f}", ranks=[0])
 
     def eval_batch(self, batch: Dict[str, Any]):
+        self._ensure_params_resident()
         if "eval" not in self._compiled:
             model, loss_fn = self.module, self._loss_fn
             self._compiled["eval"] = jax.jit(
@@ -1032,6 +1118,7 @@ class DeepSpeedEngine:
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
+        self._ensure_params_resident()
         from .checkpointing import save_engine_checkpoint
         return save_engine_checkpoint(self, save_dir, tag=tag,
                                       client_state=client_state,
@@ -1040,6 +1127,10 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
                         load_module_only=False):
+        # the loaded params supersede any NVMe-evicted copies: just drop
+        # the on-disk flag (restore templates come from _param_shapes, so
+        # paging the stale tree back in would be wasted SSD traffic)
+        self._params_on_disk = False
         from .checkpointing import load_engine_checkpoint
         return load_engine_checkpoint(self, load_dir, tag=tag,
                                       load_optimizer_states=load_optimizer_states,
@@ -1051,6 +1142,7 @@ class DeepSpeedEngine:
         GatheredParameters contexts walking every ZeRO-3 shard; here
         ``jax.device_get`` on a sharded array materializes the complete
         logical value, the all-gather the reference hand-codes)."""
+        self._ensure_params_resident()
         import numpy as np
 
         def one(x):
