@@ -1,4 +1,8 @@
 from .autotuner import Autotuner, TuneResult
+from .runner import (SubprocessMeasurer, candidate_config, report_result,
+                     run_autotuning_cli)
 from .tuner import GridSearchTuner, RandomTuner
 
-__all__ = ["Autotuner", "TuneResult", "GridSearchTuner", "RandomTuner"]
+__all__ = ["Autotuner", "TuneResult", "GridSearchTuner", "RandomTuner",
+           "SubprocessMeasurer", "candidate_config", "report_result",
+           "run_autotuning_cli"]

@@ -47,15 +47,24 @@ class Autotuner:
             candidate's train_batch_size.
     """
 
-    def __init__(self, make_engine: Callable[[Dict], Any],
-                 make_batch: Callable[[Dict], Any],
+    def __init__(self, make_engine: Optional[Callable[[Dict], Any]] = None,
+                 make_batch: Optional[Callable[[Dict], Any]] = None,
                  warmup_steps: int = 1, measure_steps: int = 3,
-                 results_dir: Optional[str] = None):
+                 results_dir: Optional[str] = None,
+                 measurer: Optional[Callable[[Dict], Dict]] = None):
+        if measurer is None and (make_engine is None or make_batch is None):
+            raise ValueError("pass make_engine+make_batch (in-process) or "
+                             "measurer (subprocess isolation)")
         self.make_engine = make_engine
         self.make_batch = make_batch
         self.warmup_steps = warmup_steps
         self.measure_steps = measure_steps
         self.results: List[TuneResult] = []
+        # crash isolation (reference: scheduler.py:27 per-experiment
+        # launch): when set, measure() delegates to it — typically
+        # runner.SubprocessMeasurer, so an OOM-at-compile candidate kills
+        # its own process instead of wedging this one's accelerator client
+        self.measurer = measurer
         # reference: per-experiment exp.json files + autotuning_results/
         # best config written by the ResourceManager; None = in-memory only
         self.results_dir = results_dir
@@ -86,7 +95,83 @@ class Autotuner:
             space.append(cfg)
         return space
 
+    # -- memory pre-pass (reference: model_info_profile_run, :658) ------
+    @staticmethod
+    def profile_model_info(model, sample_batch, rng=None) -> Dict[str, Any]:
+        """eval_shape the model init (no arrays allocated) -> model_info
+        dict for space pruning; pulls hidden/layers/seq off the model
+        config when present."""
+        import jax
+        import numpy as np
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        abstract = jax.eval_shape(
+            lambda r: model.init(r, **sample_batch), rng)
+        num_params = sum(int(np.prod(l.shape))
+                         for l in jax.tree.leaves(abstract))
+        mcfg = getattr(model, "config", None)
+        info = {"num_params": num_params}
+        for src, dst in (("d_model", "hidden_size"),
+                         ("n_layers", "num_layers"),
+                         ("max_seq_len", "seq_len")):
+            if mcfg is not None and getattr(mcfg, src, None):
+                info[dst] = int(getattr(mcfg, src))
+        return info
+
+    @staticmethod
+    def estimate_device_bytes(config: Dict[str, Any],
+                              model_info: Dict[str, Any]) -> int:
+        """Per-candidate device-memory estimate (single-accelerator view;
+        sharded axes scale it down further, so this is conservative):
+        params + grads + optimizer state (unless offloaded) + activation
+        residuals per micro batch."""
+        p = int(model_info["num_params"])
+        zero = config.get("zero_optimization") or {}
+        dtype_b = 2 if (config.get("bf16") or {}).get("enabled") or \
+            (config.get("fp16") or {}).get("enabled") else 4
+        total = p * dtype_b                      # params
+        total += p * 4                           # fp32 grad accumulation
+        off_opt = (zero.get("offload_optimizer") or {}).get("device") \
+            in ("cpu", "nvme")
+        if not off_opt:
+            total += 3 * p * 4                   # master + 2 Adam moments
+        hidden = model_info.get("hidden_size")
+        layers = model_info.get("num_layers")
+        seq = model_info.get("seq_len")
+        if hidden and layers and seq:
+            micro = int(config.get("train_micro_batch_size_per_gpu", 1))
+            # full remat keeps ~1 residual per layer boundary; no remat
+            # keeps every internal activation (~8x a block's residual).
+            # The engine enables remat whenever the activation_checkpointing
+            # block is PRESENT (runtime/engine.py) — key off presence.
+            act_factor = 2 if (config.get("activation_checkpointing")
+                               or {}) else 8
+            total += micro * seq * hidden * (layers + 2) * 4 * act_factor
+        return total
+
+    @classmethod
+    def prune_space(cls, space: List[Dict[str, Any]],
+                    model_info: Dict[str, Any],
+                    budget_bytes: float) -> List[Dict[str, Any]]:
+        kept = [c for c in space
+                if cls.estimate_device_bytes(c, model_info) <= budget_bytes]
+        if len(kept) < len(space):
+            logger.info(
+                f"memory pre-pass pruned {len(space) - len(kept)}/"
+                f"{len(space)} candidates over "
+                f"{budget_bytes / 2**30:.1f} GiB")
+        return kept
+
     def measure(self, config: Dict[str, Any]) -> TuneResult:
+        if self.measurer is not None:
+            try:
+                m = self.measurer(config)
+                return TuneResult(config, m.get("samples_per_sec"),
+                                  step_ms=m.get("step_ms"))
+            except Exception as e:
+                logger.warning(f"autotune candidate failed: {e}")
+                return TuneResult(
+                    config, None,
+                    error="".join(traceback.format_exception_only(e)))
         try:
             engine = self.make_engine(config)
             batch = self.make_batch(config)
@@ -107,13 +192,29 @@ class Autotuner:
              zero_stages=(0, 1, 2, 3), micro_batches=(1, 2, 4, 8),
              dp_world_size: int = 1, tuner_type: str = "model_based",
              early_stop: Optional[int] = None,
-             gas_values: Optional[List[int]] = None) -> TuneResult:
+             gas_values: Optional[List[int]] = None,
+             model=None, sample_batch=None,
+             model_info: Optional[Dict[str, Any]] = None,
+             memory_budget_bytes: Optional[float] = None) -> TuneResult:
         """Measure the space, return the best feasible point (reference:
-        tune() :390; fast mode = early_stop after N non-improving)."""
+        tune() :390; fast mode = early_stop after N non-improving).
+
+        Memory pre-pass (reference: model_info_profile_run :658): pass
+        ``model``+``sample_batch`` (eval_shape profiling) or a ready
+        ``model_info`` dict, plus ``memory_budget_bytes``, to prune
+        estimated-infeasible candidates before measuring them."""
         space = self.build_space(base_config, list(zero_stages),
                                  list(micro_batches), dp_world_size,
                                  gas_values=(list(gas_values)
                                              if gas_values else None))
+        if model is not None and model_info is None:
+            model_info = self.profile_model_info(model, sample_batch or {})
+        if model_info is not None and memory_budget_bytes is not None:
+            space = self.prune_space(space, model_info, memory_budget_bytes)
+            if not space:
+                raise RuntimeError(
+                    "memory pre-pass pruned every candidate — raise "
+                    "memory_budget_bytes or shrink micro_batches")
         order = TUNER_MAP[tuner_type](space).order()
         best: Optional[TuneResult] = None
         since_best = 0
@@ -135,11 +236,12 @@ class Autotuner:
                                f"(tried {len(self.results)})")
         self._persist_best(best)
         z = best.config.get("zero_optimization", {}).get("stage")
+        ms = "" if best.step_ms is None else f" ({best.step_ms:.1f} ms)"
         logger.info(
             f"autotune best: stage={z} "
             f"micro_batch={best.config['train_micro_batch_size_per_gpu']} "
             f"gas={best.config.get('gradient_accumulation_steps', 1)} "
-            f"-> {best.samples_per_sec:.1f} samples/s ({best.step_ms:.1f} ms)")
+            f"-> {best.samples_per_sec:.1f} samples/s{ms}")
         return best
 
     # -- persistence (reference: autotuning exps/*.json + the

@@ -182,6 +182,13 @@ def parse_args(argv=None):
                              "tpu-vm ssh')")
     parser.add_argument("--force_multi", action="store_true",
                         help="force the multi-node path on one host")
+    parser.add_argument("--autotuning", default="", choices=["", "tune"],
+                        help="run the autotuner over the user script "
+                             "instead of launching training (reference: "
+                             "deepspeed --autotuning)")
+    parser.add_argument("--autotuning_config", default="",
+                        help="path to the tuning-space json (see "
+                             "autotuning/runner.py run_autotuning_cli)")
     parser.add_argument("user_script", help="training script to launch")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(argv)
@@ -189,6 +196,13 @@ def parse_args(argv=None):
 
 def main(argv=None):
     args = parse_args(argv)
+
+    if args.autotuning:
+        if not args.autotuning_config:
+            raise SystemExit("--autotuning requires --autotuning_config")
+        from ..autotuning.runner import run_autotuning_cli
+        return run_autotuning_cli(args)
+
     resource_pool = fetch_hostfile(args.hostfile)
 
     if not resource_pool and not args.force_multi:
