@@ -1186,7 +1186,8 @@ class DeepSpeedEngine:
                 lowered = self._compiled["train_step"].lower(
                     self.params, self.optimizer_state, scaler,
                     placed_batch, rng, self._last_extra)
-            cost = lowered.compile().cost_analysis() or {}
+            compiled = lowered.compile()
+            cost = compiled.cost_analysis() or {}
             if isinstance(cost, list):
                 cost = cost[0] if cost else {}
             flops = float(cost.get("flops", 0.0))
@@ -1201,10 +1202,31 @@ class DeepSpeedEngine:
             if step_s:
                 line += f" achieved={flops/step_s/1e12:.1f} TFLOPS"
             log_dist(line, ranks=[0])
+            # per-module tree (reference: print_model_profile's module
+            # rows, profiler.py:88-113/481) from HLO op_name metadata —
+            # own try so a parse failure never loses the summary line
+            table = ""
+            if self.config.flops_profiler.module_depth != 0:
+                try:
+                    from ..profiling.flops_profiler import (
+                        per_module_breakdown, format_module_profile,
+                        params_by_module)
+                    depth = self.config.flops_profiler.module_depth
+                    breakdown = per_module_breakdown(
+                        compiled, max_depth=depth if depth > 0 else 4)
+                    table = format_module_profile(
+                        breakdown, params_by_module(
+                            self.params,
+                            max_depth=depth if depth > 0 else 4))
+                    log_dist("per-module profile:\n" + table, ranks=[0])
+                except Exception as e:
+                    logger.warning(f"per-module profile failed: {e}")
             out_file = self.config.flops_profiler.output_file
             if out_file and jax.process_index() == 0:
                 with open(out_file, "w") as f:
                     f.write(line + "\n")
+                    if table:
+                        f.write(table + "\n")
                     for k, v in sorted(cost.items()):
                         f.write(f"{k}: {v}\n")
         except Exception as e:  # profiling must never kill training
