@@ -79,7 +79,13 @@ class InferenceEngine:
             #   double weight memory and erase the bandwidth win).
             # - transform (arbitrary user flax modules): quantize the full
             #   tree and dequantize per step in front of model.apply.
-            direct = type(self.module).__module__.startswith("deepspeed_tpu.")
+            # explicit capability flag (ADVICE r3): a module whose dense
+            # layers are all QDense declares supports_quantized_kernels —
+            # a package-name heuristic would quantize "kernel" leaves of
+            # nn.DenseGeneral-based modules in this namespace into dicts
+            # they cannot consume
+            direct = bool(getattr(type(self.module),
+                                  "supports_quantized_kernels", False))
             from flax.core import meta as _meta
             self.params = _meta.unbox(self.params)  # boxed leaves would hide
             self.params = jax.jit(                  # the "kernel" path names

@@ -54,6 +54,8 @@ class BertEncoder(nn.Module):
 
     Returns (sequence_output [b,s,d], pooled_output [b,d]).
     """
+    # every dense layer is QDense (init_inference direct-quantization gate)
+    supports_quantized_kernels = True
     config: BertConfig
 
     @nn.compact
@@ -126,6 +128,8 @@ class BertEncoder(nn.Module):
 class BertForPreTraining(nn.Module):
     """MLM + NSP heads (reference: BertForPreTraining in tests/unit/modeling.py)."""
     config: BertConfig
+    # every dense layer is QDense (init_inference direct-quantization gate)
+    supports_quantized_kernels = True
 
     @nn.compact
     def __call__(self, input_ids, *, token_type_ids=None, attention_mask=None,

@@ -103,6 +103,9 @@ GPT2_PRESETS = {
 class GPT(nn.Module):
     """Decoder-only LM. __call__ returns logits [batch, seq, vocab]."""
     config: GPTConfig
+    # every dense layer is QDense: int8 {"q","scale"} kernel nodes are
+    # consumed directly (init_inference direct-quantization gate)
+    supports_quantized_kernels = True
 
     @nn.compact
     def __call__(self, input_ids, *, attention_mask=None, deterministic=True,

@@ -56,6 +56,8 @@ class MoEGPT(nn.Module):
     DeepSpeedMoEInference, ops/transformer/inference/moe_inference.py:205 —
     expert all-to-all at decode falls out of the same expert-axis sharding
     constraints the training path uses)."""
+    # every dense layer is QDense (init_inference direct-quantization gate)
+    supports_quantized_kernels = True
     config: MoEGPTConfig
 
     @nn.compact

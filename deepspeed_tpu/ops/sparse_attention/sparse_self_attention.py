@@ -68,6 +68,18 @@ def sparse_attention(q, k, v, sparsity_config: SparsityConfig, *,
                 "key_padding_mask/attn_mask — drop them or use the dense "
                 "path")
         from ..pallas._common import on_tpu
+        if extra_masks and on_tpu():
+            # a padding-masked BERT silently loses the kernel's FLOP
+            # savings — say so once instead of degrading quietly (ADVICE
+            # r3: folding the padding mask into the kernel's fine-mask
+            # path is the future fix). Only warn where the kernel was
+            # actually reachable (off-TPU auto mode never takes it).
+            from ...utils.logging import warn_once
+            warn_once(
+                "sparse_attention: key_padding_mask/attn_mask present — "
+                "taking the dense-mask path (the block-sparse kernel "
+                "takes no mask operands); FLOP savings of the sparsity "
+                "pattern are not realized")
         # auto mode takes the kernel only on real TPUs — off-TPU it would
         # run in interpret mode, orders of magnitude slower than the dense
         # XLA path; backend="pallas" forces it anyway (tests)

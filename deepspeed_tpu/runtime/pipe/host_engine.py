@@ -321,31 +321,41 @@ class HostDrivenPipelineEngine:
         micro_ids = [jax.tree.map(lambda x: x[i * mbsz:(i + 1) * mbsz], batch)
                      for i in range(n_micro)]
         S = self.num_stages
-        streams = [list(InferenceSchedule(n_micro, S, s).steps())
-                   for s in range(S)]
-        n_buf = 2
-        act_in = [[None] * n_buf for _ in range(S)]
-        mail: Dict[Any, Any] = {}
+        scheds = [InferenceSchedule(n_micro, S, s) for s in range(S)]
+        streams = [list(sc.steps()) for sc in scheds]
+        # per-stage buffer counts (ADVICE r3: no hardcoded n_buf; a
+        # schedule may size buffers per stage, like TrainSchedule does)
+        act_in = [[None] * sc.num_pipe_buffers() for sc in scheds]
+        micro_of = [[None] * sc.num_pipe_buffers() for sc in scheds]
+        # micro identity rides with the BUFFER: LoadMicroBatch consumes
+        # micros in order from the stage's iterator (the reference's
+        # data-iterator contract) and pins the micro to its buffer; the
+        # point-to-point channel is a per-receiver FIFO — sends and recvs
+        # pair in order regardless of either side's buffer numbering.
+        next_load = [0] * S
+        from collections import deque
+        mail: Dict[int, Any] = {s: deque() for s in range(S)}
         losses = []
         for t in range(len(streams[0])):
             for s in range(S):
-                m = t - s       # InferenceSchedule's micro for (t, s)
                 for cmd in streams[s][t]:
                     b = getattr(cmd, "buffer_id", None)
                     if isinstance(cmd, LoadMicroBatch):
+                        micro_of[s][b] = micro_ids[next_load[s]]
+                        next_load[s] += 1
                         if s == 0:
-                            act_in[s][b] = micro_ids[m]["input_ids"]
+                            act_in[s][b] = micro_of[s][b]["input_ids"]
                     elif isinstance(cmd, RecvActivation):
-                        act_in[s][b] = mail.pop((s, m))
+                        act_in[s][b] = mail[s].popleft()
                     elif isinstance(cmd, ForwardPass):
                         x = act_in[s][b]
                         if s == S - 1:
                             losses.append(self._last_fwd_prog()(
-                                self.params[s], x, micro_ids[m]))
+                                self.params[s], x, micro_of[s][b]))
                         else:   # output reuses the buffer until the send
                             act_in[s][b] = self._fwd_prog(s)(
                                 self.params[s], x)
                     elif isinstance(cmd, SendActivation):
-                        mail[(s + 1, m)] = act_in[s][b]
+                        mail[s + 1].append(act_in[s][b])
                         act_in[s][b] = None
         return jnp.mean(jnp.stack(losses))
