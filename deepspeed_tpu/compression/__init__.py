@@ -1,6 +1,10 @@
-from .compress import (Compressor, init_compression, redundancy_clean)
+from .compress import (Compressor, apply_layer_reduction,
+                       fake_quantize_activation, init_compression,
+                       redundancy_clean, student_initialization)
 from .config import CompressionConfig
 from .scheduler import CompressionScheduler
 
-__all__ = ["Compressor", "init_compression", "redundancy_clean",
+__all__ = ["Compressor", "apply_layer_reduction",
+           "fake_quantize_activation", "init_compression",
+           "redundancy_clean", "student_initialization",
            "CompressionConfig", "CompressionScheduler"]

@@ -44,6 +44,17 @@ def fake_quantize(w, bits: int = 8, symmetric: bool = True,
     return jnp.round((w - lo) / scale) * scale + lo
 
 
+def fake_quantize_activation(x, bits: int = 8, symmetric: bool = True):
+    """Dynamic-range activation fake quantization with a straight-through
+    estimator (reference: basic_layer.py:378 enable_activation_quantization
+    with range_calibration='dynamic'; SymQuantizer/AsymQuantizer.apply are
+    torch autograd STE functions — here the classic x + sg(q(x) - x)).
+    Per-tensor range, recomputed per call (the 'dynamic' mode; 'static'
+    calibration has no analog — XLA recomputes the range for free)."""
+    q = fake_quantize(x, bits=bits, symmetric=symmetric, per_channel=False)
+    return x + jax.lax.stop_gradient(q - x)
+
+
 def magnitude_mask(w, ratio: float):
     """Unstructured sparse-pruning mask: zero the smallest |w| fraction
     (reference: sparse_pruning method=l1)."""
@@ -249,6 +260,77 @@ def apply_layer_reduction(params, config: Dict[str, Any]):
     new = dict(params)
     new.update(stacked)
     return new, teacher_layers
+
+
+def student_initialization(student_params, teacher_params,
+                           config: Dict[str, Any]):
+    """Distillation init (reference: compress.py:182): map selected
+    TEACHER layers onto the (shallower) STUDENT's layer slots and copy
+    the shared non-layer modules, before knowledge-distillation training.
+
+    ``config``: a ds config dict with a ``compression_training.
+    layer_reduction`` block, or the layer_reduction block itself —
+      {"teacher_layer": [1, 3, 5, ...],   # teacher layer per student slot
+       "other_module_name": [...]}        # non-layer modules to copy;
+                                          # default: every shared subtree
+    Works on scan-stacked ("h"/"blocks" [L, ...] leaves) and unrolled
+    ("h_0".."h_{L-1}") layouts. Returns the initialized student tree."""
+    cfg = config
+    for key in ("compression_training", "layer_reduction"):
+        if isinstance(cfg, dict) and key in cfg:
+            cfg = cfg[key]
+    teacher_layer = cfg.get("teacher_layer")
+    if teacher_layer is None:
+        raise ValueError("student_initialization needs "
+                         "layer_reduction.teacher_layer")
+    n_student = _count_layers(student_params)
+    if len(teacher_layer) != n_student:
+        raise ValueError(
+            f"teacher_layer has {len(teacher_layer)} entries for a "
+            f"student with {n_student} layers")
+    reduced, _ = apply_layer_reduction(
+        teacher_params, {"enabled": True, "teacher_layer": teacher_layer})
+
+    # reconcile layer LAYOUTS: teacher checkpoints may be unrolled
+    # (h_0..h_{L-1}) while the student is scan-stacked ("h"), or the
+    # reverse — convert instead of silently skipping the copy
+    red_unrolled = sorted((k for k in reduced if k.startswith("h_")),
+                          key=lambda k: int(k.split("_")[1]))
+    stu_keys = set(student_params.keys())
+    stu_stacked = next((k for k in ("h", "blocks") if k in stu_keys), None)
+    stu_unrolled = sorted((k for k in stu_keys if k.startswith("h_")),
+                          key=lambda k: int(k.split("_")[1]))
+    if red_unrolled and stu_stacked:
+        per_layer = [reduced[k] for k in red_unrolled]
+        reduced = {k: v for k, v in reduced.items()
+                   if not k.startswith("h_")}
+        reduced[stu_stacked] = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *per_layer)
+    elif stu_unrolled and not red_unrolled:
+        red_stacked = next((k for k in ("h", "blocks") if k in reduced),
+                           None)
+        if red_stacked is not None:
+            stacked = reduced.pop(red_stacked)
+            for i in range(len(stu_unrolled)):
+                reduced[f"h_{i}"] = jax.tree.map(lambda x, _i=i: x[_i],
+                                                 stacked)
+    other = cfg.get("other_module_name")
+    new = dict(student_params)
+    copied_layers = False
+    for k, v in reduced.items():
+        is_layer = k in ("h", "blocks") or k.startswith("h_")
+        if not is_layer and other is not None \
+                and not any(o in k for o in other):
+            continue
+        if k in new:
+            new[k] = v
+            copied_layers = copied_layers or is_layer
+    if not copied_layers:
+        raise ValueError(
+            "student_initialization copied no layer weights — teacher "
+            f"layer keys {sorted(k for k in reduced if k.startswith('h_') or k in ('h', 'blocks'))} "
+            f"do not match the student's {sorted(stu_keys)}")
+    return new
 
 
 def _count_layers(params) -> int:

@@ -46,6 +46,7 @@ class TechniqueConfig:
 @dataclass
 class CompressionConfig:
     weight_quantization: TechniqueConfig = field(default_factory=TechniqueConfig)
+    activation_quantization: TechniqueConfig = field(default_factory=TechniqueConfig)
     sparse_pruning: TechniqueConfig = field(default_factory=TechniqueConfig)
     row_pruning: TechniqueConfig = field(default_factory=TechniqueConfig)
     head_pruning: TechniqueConfig = field(default_factory=TechniqueConfig)

@@ -37,6 +37,12 @@ class InferenceEngine:
                  mesh=None, quantize_weights: bool = False,
                  quantize_min_size: int = 4096, **kwargs):
         dist.init_distributed()
+        # serving never fake-quantizes activations: clear any rule table a
+        # compression-training engine left in this process (the table is
+        # process-global; a distillation teacher served next to a
+        # quantized student must run clean)
+        from ..models.layers import set_activation_quantization
+        set_activation_quantization(None)
         self.module = model
         self.dtype = dtype
         self.mp_world_size = mp_size

@@ -78,6 +78,33 @@ def activation_constraint(x, logical_names):
         return x
 
 
+# Set by the engine from the compression_training.activation_quantization
+# block (reference: basic_layer.py:378/:424 — there a per-module forward
+# hook; here a module-level rule table the engine toggles at
+# schedule_offset, recompiling once). Empty = off.
+_ACT_QUANT_RULES = []
+
+
+def set_activation_quantization(rules):
+    """rules: list of {"modules": [patterns], "bits": n, "symmetric": b}
+    or None/[] to disable."""
+    global _ACT_QUANT_RULES
+    _ACT_QUANT_RULES = list(rules or [])
+
+
+def _maybe_quantize_activation(x, module_path):
+    if not _ACT_QUANT_RULES:
+        return x
+    path = "/".join(str(p) for p in module_path)
+    for r in _ACT_QUANT_RULES:
+        if any(p == "*" or p in path for p in r.get("modules", ["*"])):
+            from ..compression.compress import fake_quantize_activation
+            return fake_quantize_activation(
+                x, bits=int(r.get("bits", 8)),
+                symmetric=bool(r.get("symmetric", True)))
+    return x
+
+
 def replicated_constraint(x):
     """Constrain ``x`` to fully-replicated on the global mesh.
 
@@ -152,6 +179,7 @@ class QDense(nn.Module):
             binit = self.bias_init or nn.initializers.zeros
             bias = self.param("bias", binit, (self.features,), self.param_dtype)
         x = x.astype(self.dtype)
+        x = _maybe_quantize_activation(x, self.path)
         if _is_qleaf(kernel):
             from ..ops.pallas.wo_int8_matmul import wo_int8_matmul
             y = wo_int8_matmul(x, kernel["q"], kernel["scale"],

@@ -165,6 +165,8 @@ class DeepSpeedEngine:
         # inside the training step)
         from ..compression.compress import init_compression
         self.compression_scheduler = init_compression(config.compression_training)
+        self._act_quant_on = False
+        self._sync_activation_quantization()
         self.moq_quantizer = None
         qt = dict(config.quantize_training or {})
         if qt.get("enabled", False):
@@ -833,6 +835,7 @@ class DeepSpeedEngine:
 
         self.tput_timer.start()
         self._ensure_params_resident()
+        self._sync_activation_quantization()
         scaler = self.loss_scale_state or init_loss_scale(1.0)
         rng = jax.random.fold_in(self.rng, self.global_steps + 1)
         extra = {}
@@ -875,6 +878,38 @@ class DeepSpeedEngine:
         self._write_monitor(metrics)
         self._evict_params_to_nvme()
         return metrics["loss"]
+
+    def _sync_activation_quantization(self):
+        """Toggle activation fake-quant at its schedule_offset (reference:
+        basic_layer.py:424 applies it in every compressed layer's forward
+        once enabled). Model forwards read a module-level rule table;
+        crossing the offset flips it and drops the compiled step so the
+        next call retraces with quantized activations — one recompile per
+        toggle, zero cost inside the step."""
+        from ..models.layers import set_activation_quantization
+        comp = self.compression_scheduler
+        aq = comp.config.activation_quantization if comp is not None else None
+        on = bool(aq is not None and aq.enabled
+                  and self.global_steps >= aq.schedule_offset)
+        # ALWAYS re-assert the table (the rule table is process-global:
+        # this also clears rules another engine left behind — e.g. a
+        # distillation teacher built after a quantized student must not
+        # inherit the student's 4-bit forward)
+        if on:
+            set_activation_quantization([
+                {"modules": g.modules,
+                 "bits": int(g.params.get("bits", 8)),
+                 "symmetric": g.params.get("quantization_type",
+                                           "symmetric") == "symmetric"}
+                for g in aq.groups.values()] or
+                [{"modules": ["*"], "bits": 8, "symmetric": True}])
+        else:
+            set_activation_quantization(None)
+        if on == self._act_quant_on:
+            return
+        self._act_quant_on = on
+        for key in ("train_step", "fwd_grads", "eval", "grad_step"):
+            self._compiled.pop(key, None)
 
     def _apply_weight_projections(self):
         """Gas-boundary weight projections (reference: compression
@@ -937,6 +972,7 @@ class DeepSpeedEngine:
         Applies the same curriculum truncation / PLD theta as the fused
         train_batch path."""
         self._ensure_params_resident()
+        self._sync_activation_quantization()
         if "fwd_grads" not in self._compiled:
             model, loss_fn = self.module, self._loss_fn
 
@@ -1071,6 +1107,7 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch: Dict[str, Any]):
         self._ensure_params_resident()
+        self._sync_activation_quantization()
         if "eval" not in self._compiled:
             model, loss_fn = self.module, self._loss_fn
             self._compiled["eval"] = jax.jit(
