@@ -1,9 +1,12 @@
 """Async NVMe I/O handle (ZeRO-Infinity swap backend).
 
 Reference: AsyncIOBuilder().load() aio handle over csrc/aio/
-(deepspeed_py_aio_handle.h). Here csrc/aio.cpp — a C++ worker-thread pool
-doing positional pread/pwrite — via ctypes. Buffers are numpy arrays;
-submissions return tickets, ``wait``/``wait_all`` join them.
+(deepspeed_py_aio_handle.h, libaio io_submit). Here csrc/aio.cpp via
+ctypes: an io_uring engine (raw syscalls — kernel-async submission, no
+userspace I/O threads) with a worker-thread pread/pwrite pool as the
+fallback where io_uring_setup is filtered. ``backend`` reports which
+engine the kernel gave us. Buffers are numpy arrays; submissions return
+tickets, ``wait``/``wait_all`` join them.
 """
 
 import ctypes
@@ -19,6 +22,11 @@ class AsyncIOHandle:
         self.lib = AsyncIOBuilder.load()
         self._h = self.lib.ds_aio_new(n_threads)
         self._pinned = {}  # ticket -> buffer keep-alive
+
+    @property
+    def backend(self) -> str:
+        """"io_uring" or "threads" (the engine ds_aio_new picked)."""
+        return "io_uring" if self.lib.ds_aio_backend(self._h) else "threads"
 
     def pread(self, path: str, buf: np.ndarray, offset: int = 0) -> int:
         t = self.lib.ds_aio_pread(self._h, os.fsencode(path),

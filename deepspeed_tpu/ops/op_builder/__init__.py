@@ -160,6 +160,8 @@ class AsyncIOBuilder(OpBuilder):
         lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.ds_aio_wait_all.restype = ctypes.c_int
         lib.ds_aio_wait_all.argtypes = [ctypes.c_void_p]
+        lib.ds_aio_backend.restype = ctypes.c_int
+        lib.ds_aio_backend.argtypes = [ctypes.c_void_p]
         return lib
 
 

@@ -65,11 +65,24 @@ def test_cpu_adam_bf16_output():
     np.testing.assert_allclose(got, p, rtol=1e-2, atol=1e-2)
 
 
+@pytest.fixture(params=["auto", "threads"])
+def aio_backend(request, monkeypatch):
+    """Exercise both engines: io_uring (when the kernel allows it) and
+    the worker-thread fallback (forced via env)."""
+    if request.param == "threads":
+        monkeypatch.setenv("DS_TPU_AIO_FORCE_THREADS", "1")
+    else:
+        monkeypatch.delenv("DS_TPU_AIO_FORCE_THREADS", raising=False)
+    return request.param
+
+
 @needs_gxx
-def test_aio_roundtrip(tmp_path):
+def test_aio_roundtrip(tmp_path, aio_backend):
     from deepspeed_tpu.ops.aio import AsyncIOHandle
 
     h = AsyncIOHandle(n_threads=2)
+    if aio_backend == "threads":
+        assert h.backend == "threads"
     rng = np.random.default_rng(2)
     data = rng.standard_normal(1 << 16).astype(np.float32)
     f = str(tmp_path / "blob.bin")
