@@ -29,6 +29,19 @@ def op_report(printer=print):
     except Exception as e:  # pragma: no cover
         printer(f"{'pallas (device kernels)':.<35s} {RED_NO} ...... {e}")
 
+    # which async-I/O engine the kernel grants (io_uring vs thread pool)
+    try:
+        from .ops.aio import AsyncIOHandle
+        h = AsyncIOHandle(n_threads=1)
+        try:
+            printer(f"{'aio engine':.<35s} {GREEN_OK} ...... {h.backend}")
+        finally:
+            h.close()
+    except Exception as e:
+        # first line only: a failed build embeds multi-line g++ stderr
+        reason = (str(e).splitlines() or ["?"])[0]
+        printer(f"{'aio engine':.<35s} {RED_NO} ...... {reason}")
+
 
 def main(printer=print):
     import jax
