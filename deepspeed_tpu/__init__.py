@@ -62,17 +62,14 @@ def initialize(args=None,
     if pipeline:
         if getattr(model, "heterogeneous", False):
             # heterogeneous LayerSpec stacks execute the 1F1B instruction
-            # stream host-side (reference: _exec_schedule, pipe/engine.py:1354)
-            if mesh is not None:
-                from .utils.logging import logger as _logger
-                _logger.warning(
-                    "heterogeneous PipelineModule runs on the host-driven "
-                    "executor, which is single-client: the provided mesh is "
-                    "ignored (batch arithmetic uses world size 1)")
+            # stream host-side (reference: _exec_schedule, pipe/engine.py
+            # :1354); a mesh with a "data" axis composes DP with it
+            # (stage params replicated, micros batch-sharded)
             from .runtime.pipe.host_engine import HostDrivenPipelineEngine
             engine = HostDrivenPipelineEngine(
                 model, cfg, loss_fn=loss_fn, sample_batch=sample_batch,
-                rng=rng, optimizer=optimizer, lr_scheduler=lr_scheduler)
+                rng=rng, optimizer=optimizer, lr_scheduler=lr_scheduler,
+                mesh=mesh)
         else:
             from .runtime.pipe.engine import PipelineEngine
             engine = PipelineEngine(model, cfg, loss_fn=loss_fn,

@@ -50,12 +50,31 @@ class HostDrivenPipelineEngine:
 
     def __init__(self, module: PipelineModule, config, *, loss_fn=None,
                  sample_batch=None, rng=None, optimizer=None,
-                 lr_scheduler=None):
+                 lr_scheduler=None, mesh=None):
         self.pipe = module
         if isinstance(config, dict):
             config = DeepSpeedConfig.from_dict(config)
         dist.init_distributed()
-        config.resolve_batch_sizes(1)
+        # Data parallelism composes with the host-driven schedule: stage
+        # params are replicated over the mesh's "data" axis and every
+        # micro batch is sharded on its leading dim, so each jitted
+        # stage program runs data-parallel and the recompute-vjp's
+        # param grads come back already psum'd by SPMD (the reference's
+        # ReduceGrads). Other parallel axes do not apply to this
+        # executor (stages are host-scheduled, not mesh axes).
+        self.mesh = mesh
+        self.dp_world_size = 1
+        if mesh is not None:
+            bad = {a: s for a, s in mesh.shape.items()
+                   if a != "data" and s > 1}
+            if bad:
+                raise DeepSpeedConfigError(
+                    "HostDrivenPipelineEngine composes with DATA "
+                    f"parallelism only; mesh has non-data axes {bad} — "
+                    "use the SPMD PipelineEngine (homogeneous stacks) "
+                    "for tp/fsdp/stage meshes")
+            self.dp_world_size = mesh.shape.get("data", 1)
+        config.resolve_batch_sizes(self.dp_world_size)
         self.config = config
         self.loss_fn = loss_fn or module.loss_fn
         if self.loss_fn is None:
@@ -95,7 +114,29 @@ class HostDrivenPipelineEngine:
                 stage_params.append(variables)
                 x = layer.apply(variables, x)
             params.append(stage_params)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self.mesh, P())
+            params = jax.tree.map(lambda a: jax.device_put(a, rep), params)
         self.params = params
+
+    def _place_micro(self, tree):
+        """Shard a micro batch's leading dim over the data axis (no-op
+        without a mesh; non-divisible leading dims replicate)."""
+        if self.mesh is None or self.dp_world_size == 1:
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def one(x):
+            x = jnp.asarray(x)
+            if x.ndim == 0:   # scalar leaves replicate (rank-1 specs
+                              # are invalid on rank-0 arrays)
+                return jax.device_put(x, NamedSharding(self.mesh, P()))
+            spec = ("data",) if x.shape[0] % self.dp_world_size == 0 \
+                else (None,)
+            return jax.device_put(x, NamedSharding(
+                self.mesh, P(*spec, *(None,) * (x.ndim - 1))))
+        return jax.tree.map(one, tree)
 
     def _stage_forward(self, s: int):
         """fn(stage_params, x) -> y, jitted once per stage."""
@@ -189,8 +230,9 @@ class HostDrivenPipelineEngine:
                              f"{cfg.train_batch_size}")
         n_micro = self.micro_batches
         mb = B // n_micro
-        micro_ids = [jax.tree.map(lambda x: x[i * mb:(i + 1) * mb], batch)
-                     for i in range(n_micro)]
+        micro_ids = [self._place_micro(
+            jax.tree.map(lambda x: x[i * mb:(i + 1) * mb], batch))
+            for i in range(n_micro)]
 
         S = self.num_stages
         schedules = [TrainSchedule(n_micro, S, s) for s in range(S)]
@@ -270,7 +312,10 @@ class HostDrivenPipelineEngine:
                         dx_micro[s][b] = m
                         act_in[s][b] = None
                     elif isinstance(cmd, (ReduceGrads, ReduceTiedGrads)):
-                        pass   # single-client: grads already global sums
+                        # one JAX client: with params replicated over the
+                        # data axis, SPMD already psum'd the vjp's param
+                        # grads — the reduction this instruction names
+                        pass
                     elif isinstance(cmd, OptimizerStep):
                         if s == S - 1:   # run the step exactly once
                             self._take_step(grad_accum)
@@ -318,8 +363,9 @@ class HostDrivenPipelineEngine:
             raise ValueError(f"batch dim {B} not divisible by micro count "
                              f"{n_micro}")
         mbsz = B // n_micro
-        micro_ids = [jax.tree.map(lambda x: x[i * mbsz:(i + 1) * mbsz], batch)
-                     for i in range(n_micro)]
+        micro_ids = [self._place_micro(
+            jax.tree.map(lambda x: x[i * mbsz:(i + 1) * mbsz], batch))
+            for i in range(n_micro)]
         S = self.num_stages
         scheds = [InferenceSchedule(n_micro, S, s) for s in range(S)]
         streams = [list(sc.steps()) for sc in scheds]
