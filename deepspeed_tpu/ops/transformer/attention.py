@@ -62,7 +62,7 @@ def _reference_attention(q, k, v, bias=None, mask=None, *, causal=False,
 def attention(q, k, v, bias=None, mask=None, *, causal=False,
               softmax_scale=None, dropout_rate=0.0, dropout_rng=None,
               deterministic=True, backend: Optional[str] = None,
-              seq_parallel: Optional[str] = None):
+              seq_parallel: Optional[str] = None, ring_block_q: int = 1024):
     """Multi-head attention, BSHD layout.
 
     backend: None = auto (pallas flash kernel on TPU when eligible,
@@ -89,7 +89,8 @@ def attention(q, k, v, bias=None, mask=None, *, causal=False,
                               softmax_scale=softmax_scale,
                               dropout_rate=dropout_rate,
                               dropout_rng=dropout_rng,
-                              deterministic=deterministic)
+                              deterministic=deterministic,
+                              block_q=ring_block_q)
 
     if backend is None:
         backend = _auto_backend(q, bias, mask, dropout_rate, deterministic)

@@ -32,73 +32,122 @@ _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
 def _ring_local(q, k, v, bias=None, mask=None, dropout_rng=None, *,
-                axis_name, causal, softmax_scale, dropout_rate=0.0):
+                axis_name, causal, softmax_scale, dropout_rate=0.0,
+                block_q=1024):
     """Local shard computation: q/k/v [b, s_l, h, d].
 
     ``bias``/``mask`` arrive with their sq dim already local (sharded over
     the ring axis, or broadcast size-1) and their sk dim GLOBAL — each
     step dynamic-slices the current source block's key columns. Dropout
-    samples per (q-block, k-block) pair from ``fold_in(rng, my*sp+src)``:
-    iid bernoulli with the configured rate, deterministic in the ring
-    layout, but not bit-identical to the replicated path's sample (unlike
-    Ulysses, whose local logits tile the global [b,h,sq,sk] array)."""
+    samples per (q-chunk, k-block) pair from fold_in: iid bernoulli with
+    the configured rate, deterministic in the ring layout, but not
+    bit-identical to the replicated path's sample (unlike Ulysses, whose
+    local logits tile the global [b,h,sq,sk] array).
+
+    Memory: when the local shard exceeds ``block_q`` rows, each ring step
+    processes q in chunks (row-independent online-softmax updates mapped
+    over a rematerialized per-chunk body), bounding live logits at
+    [b, h, block_q, s_l] in BOTH fwd and bwd instead of [b, h, s_l, s_l]
+    — 128k-class global sequences stay trainable on modest rings."""
     sp = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, s_l, h, d = q.shape
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
 
+    import math
+    cq = min(block_q, s_l)
+    if s_l % cq != 0:
+        # largest divisor <= block_q keeps the memory bound (a ragged
+        # block_q must not silently reintroduce O(s_l^2) logits); only
+        # pathological s_l (no divisor >= 128) falls back to one chunk
+        cq = math.gcd(s_l, cq)
+        if cq < min(128, s_l):
+            cq = s_l
+    n_chunks = s_l // cq
+
     q32 = q.astype(jnp.float32) * scale
-    qpos = jnp.arange(s_l)[:, None]          # local row offsets
     kpos = jnp.arange(s_l)[None, :]
     perm = [(i, (i + 1) % sp) for i in range(sp)]
     dropout_on = dropout_rate > 0.0 and dropout_rng is not None
 
-    def step(carry, t):
-        k_blk, v_blk, acc, m, denom = carry
-        src = (my - t) % sp                  # global block index of k_blk
-        # [b, h, s_l, s_l] logits
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
+    def chunk_update(k_blk, v_blk, src, qo, q_c, acc_c, m_c, den_c):
+        """Online-softmax update for q rows [qo, qo+cq) against k_blk.
+        q_c [b, cq, h, d]; acc_c [b, h, cq, d]; m_c/den_c [b, h, cq]."""
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q_c,
                             k_blk.astype(jnp.float32))
         if bias is not None:
             bias_blk = lax.dynamic_slice_in_dim(
                 bias, src * s_l, s_l, axis=-1) if bias.shape[-1] != s_l \
                 else bias
+            if bias_blk.shape[-2] != 1:
+                bias_blk = lax.dynamic_slice_in_dim(bias_blk, qo, cq, axis=-2)
             logits = logits + bias_blk
         if causal:
-            gq = my * s_l + qpos             # global positions
+            gq = my * s_l + qo + jnp.arange(cq)[:, None]  # global positions
             gk = src * s_l + kpos
             logits = jnp.where((gk <= gq)[None, None], logits, _NEG_INF)
         if mask is not None:
             mask_blk = lax.dynamic_slice_in_dim(
                 mask, src * s_l, s_l, axis=-1) if mask.shape[-1] != s_l \
                 else mask
+            if mask_blk.shape[-2] != 1:
+                mask_blk = lax.dynamic_slice_in_dim(mask_blk, qo, cq, axis=-2)
             logits = jnp.where(mask_blk, logits, _NEG_INF)
-        m_new = jnp.maximum(m, logits.max(axis=-1))
+        m_new = jnp.maximum(m_c, logits.max(axis=-1))
         # rows with no valid key yet keep m == -inf; guard the exp args
         safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(logits - safe_m[..., None])
         p = jnp.where(jnp.isfinite(logits), p, 0.0)
-        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        corr = jnp.where(jnp.isfinite(m_c), jnp.exp(m_c - safe_m), 0.0)
         p_use = p
         if dropout_on:
             # dropout zeroes softmax PROBS: the denominator accumulates
             # the un-dropped sums, the numerator the dropped ones
-            blk_rng = jax.random.fold_in(dropout_rng, my * sp + src)
+            blk_rng = jax.random.fold_in(
+                jax.random.fold_in(dropout_rng, my * sp + src),
+                qo // cq if n_chunks > 1 else 0)
             keep = jax.random.bernoulli(blk_rng, 1.0 - dropout_rate, p.shape)
             p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-        acc = acc * corr[..., None] + jnp.einsum(
+        acc_c = acc_c * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p_use, v_blk.astype(jnp.float32))
-        denom = denom * corr + p.sum(axis=-1)
+        den_c = den_c * corr + p.sum(axis=-1)
+        return acc_c, m_new, den_c
+
+    # chunk-major state layout for the WHOLE scan (one reshape in, one
+    # out — per-step transposes of the carry would copy acc each step);
+    # q chunks are precomputed once, loop-invariant
+    q_cs = q32.reshape(b, n_chunks, cq, h, d).transpose(1, 0, 2, 3, 4)
+    offs = jnp.arange(n_chunks) * cq
+
+    def step(carry, t):
+        k_blk, v_blk, acc, m, denom = carry   # acc [nq,b,h,cq,d] etc.
+        src = (my - t) % sp                  # global block index of k_blk
+        if n_chunks == 1:
+            a, mm, dd = chunk_update(k_blk, v_blk, src, 0,
+                                     q_cs[0], acc[0], m[0], denom[0])
+            acc, m, denom = a[None], mm[None], dd[None]
+        else:
+            # chunk rows are independent: map a REMATERIALIZED per-chunk
+            # body so neither fwd nor bwd ever holds more than one
+            # chunk's logits
+            @jax.checkpoint
+            def one(args):
+                qo, q_c, a_c, m_c, d_c = args
+                return chunk_update(k_blk, v_blk, src, qo, q_c, a_c, m_c, d_c)
+
+            acc, m, denom = lax.map(one, (offs, q_cs, acc, m, denom))
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
-        return (k_blk, v_blk, acc, m_new, denom), None
+        return (k_blk, v_blk, acc, m, denom), None
 
-    acc0 = jnp.zeros((b, h, s_l, d), jnp.float32)
-    m0 = jnp.full((b, h, s_l), _NEG_INF, jnp.float32)
-    den0 = jnp.zeros((b, h, s_l), jnp.float32)
+    acc0 = jnp.zeros((n_chunks, b, h, cq, d), jnp.float32)
+    m0 = jnp.full((n_chunks, b, h, cq), _NEG_INF, jnp.float32)
+    den0 = jnp.zeros((n_chunks, b, h, cq), jnp.float32)
     (_, _, acc, _, denom), _ = lax.scan(
         step, (k, v, acc0, m0, den0), jnp.arange(sp))
 
+    acc = acc.transpose(1, 2, 0, 3, 4).reshape(b, h, s_l, d)
+    denom = denom.transpose(1, 2, 0, 3).reshape(b, h, s_l)
     out = acc / jnp.maximum(denom, 1e-30)[..., None]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)   # [b, s_l, h, d]
 
@@ -106,7 +155,8 @@ def _ring_local(q, k, v, bias=None, mask=None, dropout_rng=None, *,
 def ring_attention(q, k, v, *, bias=None, mask=None, causal=True,
                    softmax_scale=None, dropout_rate=0.0, dropout_rng=None,
                    deterministic=True, mesh=None, axis_name=_SEQ_AXIS,
-                   batch_axes=_BATCH_AXES, head_axis=_HEAD_AXIS):
+                   batch_axes=_BATCH_AXES, head_axis=_HEAD_AXIS,
+                   block_q=1024):
     """Ring attention over seq-sharded [B, S, H, D] global arrays.
 
     Unlike Ulysses there is no head-divisibility requirement, so it also
@@ -160,7 +210,8 @@ def ring_attention(q, k, v, *, bias=None, mask=None, causal=True,
                            dropout_rng=ops.get("dropout_rng"),
                            axis_name=axis_name, causal=causal,
                            softmax_scale=softmax_scale,
-                           dropout_rate=dropout_rate if dropout_on else 0.0)
+                           dropout_rate=dropout_rate if dropout_on else 0.0,
+                           block_q=block_q)
 
     return shard_map(local, mesh=mesh,
                      in_specs=(spec, spec, spec) + extra_specs,
