@@ -289,19 +289,25 @@ class TestMoEServing:
     DeepSpeedMoEInference, moe_inference.py:205): generate() on an
     expert-parallel MoEGPT over the expert mesh axis."""
 
-    def test_moe_generate_matches_full_forward(self):
+    @staticmethod
+    def _moe_setup(d_model=32, k=1, moe_interval=2):
+        """Shared mesh/config/params/ids block for the serving tests."""
         from deepspeed_tpu.comm import MeshSpec, build_mesh
         from deepspeed_tpu.models.moe_gpt import MoEGPT, MoEGPTConfig
         mesh = build_mesh(MeshSpec(expert=4, data=2))
         cfg = MoEGPTConfig(
-            base=GPTConfig(vocab_size=97, max_seq_len=64, d_model=32,
+            base=GPTConfig(vocab_size=97, max_seq_len=64, d_model=d_model,
                            n_layers=2, n_heads=2, dtype=jnp.float32,
                            scan_layers=False),
-            num_experts=4, k=1, capacity_factor=2.0,
-            eval_capacity_factor=2.0, moe_interval=2)
+            num_experts=4, k=k, capacity_factor=2.0,
+            eval_capacity_factor=2.0, moe_interval=moe_interval)
         m = MoEGPT(cfg)
         ids = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 97)
         params = m.init(jax.random.PRNGKey(0), ids)["params"]
+        return mesh, m, ids, params
+
+    def test_moe_generate_matches_full_forward(self):
+        _, m, ids, params = self._moe_setup(k=1, moe_interval=2)
         out = generate(m, params, ids, max_new_tokens=4, temperature=0.0)
         cur = ids
         for _ in range(4):
@@ -312,22 +318,30 @@ class TestMoEServing:
 
     def test_moe_engine_generate(self):
         import deepspeed_tpu
-        from deepspeed_tpu.comm import MeshSpec, build_mesh
-        from deepspeed_tpu.models.moe_gpt import MoEGPT, MoEGPTConfig
-        mesh = build_mesh(MeshSpec(expert=4, data=2))
-        cfg = MoEGPTConfig(
-            base=GPTConfig(vocab_size=97, max_seq_len=64, d_model=32,
-                           n_layers=2, n_heads=2, dtype=jnp.float32,
-                           scan_layers=False),
-            num_experts=4, k=2, capacity_factor=2.0,
-            eval_capacity_factor=2.0, moe_interval=1)
-        m = MoEGPT(cfg)
-        ids = jax.random.randint(jax.random.PRNGKey(1), (4, 8), 0, 97)
-        params = m.init(jax.random.PRNGKey(0), ids)["params"]
+        mesh, m, ids, params = self._moe_setup(k=2, moe_interval=1)
         eng = deepspeed_tpu.init_inference(m, params=params,
                                            dtype=jnp.float32, mesh=mesh)
         out = eng.generate(ids, max_new_tokens=4)
         assert out.shape == (4, 12)
+
+    def test_moe_int8_direct_serving(self):
+        """Expert-parallel MoE + weight-only int8: the capability flag
+        routes MoEGPT through DIRECT mode (expert kernels stay int8
+        dicts consumed by QDense) and generation still runs."""
+        import deepspeed_tpu
+        mesh, m, ids, params = self._moe_setup(d_model=64, k=1,
+                                               moe_interval=2)
+        eng = deepspeed_tpu.init_inference(
+            m, params=params, dtype=jnp.float32, mesh=mesh,
+            quantize_weights=True, quantize_min_size=1024)
+        assert eng._param_transform is None   # direct mode via the flag
+        from deepspeed_tpu.module_inject.module_quantize import _is_qleaf
+        qleaves = sum(_is_qleaf(l) for l in jax.tree.leaves(
+            eng.params, is_leaf=_is_qleaf))
+        assert qleaves > 0
+        out = eng.generate(ids, max_new_tokens=4)
+        assert out.shape == (4, 12)
+        assert np.asarray(out)[:, :8].tolist() == np.asarray(ids).tolist()
 
 
 class TestMegatronLoader:
