@@ -369,3 +369,47 @@ class TestRingChunkedQ:
             assert np.isfinite(g).all()
         finally:
             set_global_mesh(None)
+
+
+def test_zero3_fsdp_ulysses_dropout_composition():
+    """Combined regime: ZeRO-3 param sharding x fsdp x Ulysses sequence
+    parallelism x dropout on ONE mesh — the config where sharding rules
+    (table row-sharding, grad partitions, SP operand specs, threefry
+    keep masks) are most likely to conflict. Must train with no fallback
+    warning and decreasing loss."""
+    import warnings
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT, GPTConfig, gpt_loss_fn
+
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, seq=2))
+    cfg = GPTConfig(vocab_size=512, max_seq_len=64, d_model=64, n_layers=2,
+                    n_heads=4, dtype=jnp.float32, scan_layers=True,
+                    seq_parallel="ulysses", attn_backend="reference",
+                    dropout_rate=0.1, attn_dropout_rate=0.1)
+
+    def loss_fn(model, params, batch, rng, train):
+        logits = model.apply(params, batch["input_ids"],
+                             deterministic=not train, rngs={"dropout": rng})
+        return gpt_loss_fn(logits[:, :-1], batch["input_ids"][:, 1:])
+
+    config = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+              "gradient_accumulation_steps": 2,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "zero_optimization": {
+                  "stage": 3, "stage3_param_persistence_threshold": 0},
+              "steps_per_print": 1000}
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 512, size=(8, 64),
+                                       dtype=np.int32)}
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
+            engine, _, _, _ = ds.initialize(
+                model=GPT(cfg), config=config, loss_fn=loss_fn,
+                sample_batch={"input_ids": batch["input_ids"][:1]},
+                rng=jax.random.PRNGKey(0), mesh=mesh)
+            losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    finally:
+        set_global_mesh(None)
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
