@@ -232,8 +232,17 @@ class Autotuner:
                             "non-improving candidates")
                 break
         if best is None:
+            first_err = next((r.error for r in self.results if r.error),
+                             None)
+            hint = ""
+            if first_err:
+                hint = f"; first failure: {first_err.strip()[-400:]}"
+                if "dp_world" in first_err:
+                    hint += (" (candidate runs on more devices than the "
+                             "space assumed — set dp_world_size in the "
+                             "autotuning config)")
             raise RuntimeError("no feasible autotuning candidate "
-                               f"(tried {len(self.results)})")
+                               f"(tried {len(self.results)}){hint}")
         self._persist_best(best)
         z = best.config.get("zero_optimization", {}).get("stage")
         ms = "" if best.step_ms is None else f" ({best.step_ms:.1f} ms)"

@@ -107,7 +107,9 @@ def run_autotuning_cli(args) -> int:
       "zero_stages": [0, 1, 2, 3],
       "gas_values": [1, 8],                 # optional
       "base_config": { ... ds config ... } | "path/to/ds_config.json",
-      "dp_world_size": 1,                   # optional
+      "dp_world_size": 1 | "auto",          # "auto" probes jax.devices()
+                                            # in a subprocess (the parent
+                                            # never touches the backend)
       "tuner_type": "model_based",          # optional
       "early_stop": null,                   # optional
       "timeout_s": 600,                     # optional, per candidate
@@ -127,6 +129,22 @@ def run_autotuning_cli(args) -> int:
         with open(base) as f:
             base = json.load(f)
 
+    dp = at.get("dp_world_size", 1)
+    if dp == "auto":
+        # probe the device count in a SUBPROCESS: importing jax here
+        # would hang the tuner itself when the accelerator tunnel is
+        # wedged (the hazard the per-candidate isolation exists for)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(len(jax.devices()))"],
+                capture_output=True, text=True, timeout=240)
+            dp = int(r.stdout.strip().splitlines()[-1]) \
+                if r.returncode == 0 else 1
+        except (subprocess.TimeoutExpired, ValueError, IndexError):
+            dp = 1
+        logger.info(f"autotuning dp_world_size=auto resolved to {dp}")
+
     tuner = Autotuner(
         make_engine=None, make_batch=None,
         measurer=SubprocessMeasurer(
@@ -136,7 +154,7 @@ def run_autotuning_cli(args) -> int:
     space_kw = dict(
         zero_stages=at.get("zero_stages", [0, 1, 2, 3]),
         micro_batches=at.get("micro_batches", [1, 2, 4, 8]),
-        dp_world_size=int(at.get("dp_world_size", 1)),
+        dp_world_size=int(dp),
         gas_values=at.get("gas_values"))
     best = tuner.tune(
         base, tuner_type=at.get("tuner_type", "model_based"),

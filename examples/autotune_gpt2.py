@@ -1,0 +1,77 @@
+"""Autotuning candidate script (reference: `deepspeed --autotuning` over a
+user training script, autotuning.md).
+
+Tune stage x micro-batch x grad-accum for a small GPT:
+
+    bin/ds_tpu --autotuning tune \
+        --autotuning_config examples/autotune_gpt2.json \
+        examples/autotune_gpt2.py
+
+The tuner launches this script once per candidate (its own process —
+crash isolation) with DS_TPU_AUTOTUNING_CANDIDATE pointing at the
+candidate config; the script trains a few steps and reports one
+AUTOTUNE_RESULT line. Run WITHOUT the tuner, it trains the base config.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.autotuning import candidate_config, report_result
+from deepspeed_tpu.models import GPT, GPT2_PRESETS, gpt_chunked_loss_fn
+
+SEQ = 256
+WARMUP, MEASURE = 1, 3
+
+
+def main():
+    import dataclasses
+    n_chips = len(jax.devices())
+    cfg = candidate_config() or {
+        "train_batch_size": 8 * n_chips,
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 6e-4}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 1000,
+    }
+    mcfg = dataclasses.replace(GPT2_PRESETS["gpt2-125m"],
+                               dtype=jnp.bfloat16, max_seq_len=SEQ,
+                               remat="full")
+
+    def loss_fn(model, params, batch, rng, train):
+        ids = batch["input_ids"]
+        h, wte = model.apply(params, ids, deterministic=not train,
+                             return_hidden=True)
+        return gpt_chunked_loss_fn(h[:, :-1], wte, ids[:, 1:], chunk=128)
+
+    engine, _, _, _ = ds.initialize(
+        model=GPT(mcfg), config=cfg, loss_fn=loss_fn,
+        sample_batch={"input_ids": np.zeros((1, SEQ), np.int32)},
+        rng=jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(0)
+
+    def batch():
+        return {"input_ids": rng.integers(
+            0, mcfg.vocab_size, size=(cfg["train_batch_size"], SEQ),
+            dtype=np.int32)}
+
+    for _ in range(WARMUP):
+        engine.train_batch(batch())
+    t0 = time.perf_counter()
+    for _ in range(MEASURE):
+        engine.train_batch(batch())
+    dt = (time.perf_counter() - t0) / MEASURE
+    report_result(samples_per_sec=cfg["train_batch_size"] / dt,
+                  step_ms=dt * 1e3)
+
+
+if __name__ == "__main__":
+    main()
