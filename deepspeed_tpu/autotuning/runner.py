@@ -134,16 +134,31 @@ def run_autotuning_cli(args) -> int:
         # probe the device count in a SUBPROCESS: importing jax here
         # would hang the tuner itself when the accelerator tunnel is
         # wedged (the hazard the per-candidate isolation exists for)
+        why = None
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
                  "import jax; print(len(jax.devices()))"],
                 capture_output=True, text=True, timeout=240)
-            dp = int(r.stdout.strip().splitlines()[-1]) \
-                if r.returncode == 0 else 1
-        except (subprocess.TimeoutExpired, ValueError, IndexError):
-            dp = 1
-        logger.info(f"autotuning dp_world_size=auto resolved to {dp}")
+            if r.returncode == 0:
+                dp = int(r.stdout.strip().splitlines()[-1])
+            else:
+                dp, why = 1, f"probe exited {r.returncode}: " \
+                    f"{r.stderr.strip()[-200:]}"
+        except subprocess.TimeoutExpired:
+            dp, why = 1, "probe timed out after 240s (accelerator " \
+                "tunnel wedged?)"
+        except (ValueError, IndexError):
+            dp, why = 1, f"unparseable probe output: {r.stdout[-100:]!r}"
+        if why:
+            # dp=1 on a multi-chip rig makes EVERY candidate infeasible —
+            # make the cause loud, not an info line
+            logger.warning(
+                f"dp_world_size=auto fell back to 1 ({why}); on a "
+                "multi-chip host set dp_world_size explicitly or every "
+                "candidate will fail the batch-arithmetic check")
+        else:
+            logger.info(f"autotuning dp_world_size=auto resolved to {dp}")
 
     tuner = Autotuner(
         make_engine=None, make_batch=None,
