@@ -85,11 +85,12 @@ def initialize(args=None,
 
     dataloader = None
     if training_data is not None:
-        from .runtime.dataloader import DeepSpeedDataLoader
-        dataloader = DeepSpeedDataLoader(
+        from .runtime.dataloader import (DeepSpeedDataLoader,
+                                         PrefetchingLoader)
+        dataloader = PrefetchingLoader(DeepSpeedDataLoader(
             training_data,
             batch_size=engine.config.train_batch_size,
-            collate_fn=collate_fn)
+            collate_fn=collate_fn))
     return engine, engine.optimizer, dataloader, engine.lr_schedule
 
 

@@ -34,6 +34,75 @@ class RepeatingLoader:
             return next(self.data_iter)
 
 
+class PrefetchingLoader:
+    """Pipeline host-side batch preparation with device compute
+    (reference: DeepSpeedDataLoader's num_local_io_workers / torch
+    DataLoader workers): a daemon thread runs the wrapped iterator and
+    keeps up to ``prefetch`` ready batches in a queue, so indexing /
+    collation / augmentation for batch k+1 overlaps the jitted step on
+    batch k. Exceptions in the worker re-raise at the consuming site."""
+
+    _DONE = object()
+
+    def __init__(self, loader, prefetch: int = 2):
+        self.loader = loader
+        self.prefetch = max(1, int(prefetch))
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __getattr__(self, name):
+        # preserve the wrapped loader's surface (batch_size, dataset,
+        # num_batches, ...) — initialize() returns this wrapper in place
+        # of the bare DeepSpeedDataLoader
+        return getattr(self.loader, name)
+
+    def __iter__(self):
+        import queue
+        import threading
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def work():
+            try:
+                for item in self.loader:
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+            except BaseException as e:  # forwarded to the consumer
+                if not stop.is_set():
+                    try:
+                        q.put(e, timeout=1.0)
+                    except queue.Full:
+                        pass
+                return
+            try:
+                q.put(self._DONE, timeout=1.0)
+            except queue.Full:
+                pass
+
+        t = threading.Thread(target=work, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # abandoned iteration (break / generator close): release the
+            # worker — it checks the event between bounded puts — so
+            # neither the thread nor its queued batches outlive the loop
+            stop.set()
+
+
 class DeepSpeedDataLoader:
     """Batch a map-style dataset into global-batch dicts of numpy arrays.
 

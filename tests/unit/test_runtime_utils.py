@@ -68,3 +68,77 @@ def test_call_to_str():
     assert call_to_str("SendActivation", 1, dest=2) == \
         "SendActivation(1, dest=2)"
     assert call_to_str("Step") == "Step()"
+
+
+class TestPrefetchingLoader:
+    def test_yields_all_batches_in_order(self):
+        from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                                      PrefetchingLoader)
+        data = {"x": np.arange(64).reshape(32, 2)}
+        base = DeepSpeedDataLoader(data, batch_size=8, shuffle=False)
+        pre = PrefetchingLoader(base, prefetch=2)
+        assert len(pre) == len(base) == 4
+        got = [b["x"] for b in pre]
+        want = [b["x"] for b in DeepSpeedDataLoader(
+            data, batch_size=8, shuffle=False)]
+        assert len(got) == 4
+        for a, b in zip(got, want):
+            np.testing.assert_array_equal(a, b)
+
+    def test_worker_exception_reraises(self):
+        from deepspeed_tpu.runtime.dataloader import PrefetchingLoader
+
+        def bad():
+            yield {"x": np.zeros(2)}
+            raise RuntimeError("boom in worker")
+
+        it = iter(PrefetchingLoader(bad(), prefetch=1))
+        next(it)
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError, match="boom in worker"):
+            next(it)
+
+    def test_overlaps_producer_with_consumer(self):
+        """The worker fills the queue while the consumer sleeps: total
+        wall time ~ max(producer, consumer), not their sum."""
+        import time
+        from deepspeed_tpu.runtime.dataloader import PrefetchingLoader
+
+        def slow_producer():
+            for i in range(4):
+                time.sleep(0.05)
+                yield i
+
+        t0 = time.perf_counter()
+        for _ in PrefetchingLoader(slow_producer(), prefetch=2):
+            time.sleep(0.05)   # "compute"
+        overlapped = time.perf_counter() - t0
+        # serial is ~0.4s; overlapped ~0.25s — smoke bound with slack
+        # for loaded CI hosts
+        assert overlapped < 0.38, overlapped
+
+    def test_early_break_releases_worker(self):
+        """Abandoning iteration must not leave the worker thread
+        blocked on a full queue (the leak: every early-exit epoch would
+        pin a thread + prefetched global batches for the process
+        life)."""
+        import gc
+        import threading
+        import time
+        from deepspeed_tpu.runtime.dataloader import PrefetchingLoader
+
+        def producer():
+            for i in range(100):
+                yield np.zeros(1024) + i
+
+        before = threading.active_count()
+        it = iter(PrefetchingLoader(producer(), prefetch=2))
+        next(it)
+        it.close()           # generator close -> finally -> stop event
+        gc.collect()
+        deadline = time.perf_counter() + 3.0
+        while (threading.active_count() > before
+               and time.perf_counter() < deadline):
+            time.sleep(0.05)
+        assert threading.active_count() <= before, \
+            "prefetch worker still alive after iterator close"
