@@ -142,3 +142,42 @@ class TestPrefetchingLoader:
             time.sleep(0.05)
         assert threading.active_count() <= before, \
             "prefetch worker still alive after iterator close"
+
+
+class TestTimers:
+    """Direct coverage for utils/timer.py (reference: utils/timing.py
+    SynchronizedWallClockTimer + ThroughputTimer; exercised indirectly by
+    every engine step, pinned here)."""
+
+    def test_wallclock_timer_elapsed_and_mean(self):
+        import time
+        from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+        timers = SynchronizedWallClockTimer()
+        t = timers("fwd")
+        assert timers("fwd") is t          # cached per name
+        assert timers.has_timer("fwd") and not timers.has_timer("bwd")
+        for _ in range(2):
+            t.start()
+            time.sleep(0.01)
+            t.stop()
+        mean = t.mean()
+        assert 0.005 < mean < 0.2
+        elapsed = t.elapsed(reset=True)    # total of both intervals
+        assert elapsed >= mean
+        assert t.elapsed(reset=False) == 0.0   # reset happened
+        means = timers.get_mean(["fwd", "missing"])
+        assert "missing" not in means
+
+    def test_throughput_timer_avg(self):
+        from deepspeed_tpu.utils.timer import ThroughputTimer
+        logs = []
+        tt = ThroughputTimer(batch_size=4, start_step=1, steps_per_output=2,
+                             logging_fn=lambda msg, **kw: logs.append(msg))
+        import time
+        for _ in range(4):
+            tt.start()
+            time.sleep(0.002)   # nonzero step: guards coarse clocks
+            tt.stop(global_step=True)
+        assert tt.global_step_count == 4
+        assert tt.avg_samples_per_sec() > 0
+        assert any("SamplesPerSec" in m for m in logs)
