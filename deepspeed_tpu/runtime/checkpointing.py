@@ -26,8 +26,57 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _async_checkpointer(engine):
+    """One AsyncCheckpointer per engine (it owns a worker thread): the
+    initial device->host snapshot is synchronous, the file writes run in
+    the background — training steps (which DONATE params) are safe to
+    continue immediately."""
+    import orbax.checkpoint as ocp
+    if getattr(engine, "_async_ckptr", None) is None:
+        engine._async_ckptr = ocp.AsyncCheckpointer(
+            ocp.PyTreeCheckpointHandler())
+    return engine._async_ckptr
+
+
+def finalize_pending_checkpoint(engine):
+    """Block until the in-flight async save (if any) lands, then publish
+    its ``latest`` tag. The tag is only ever written AFTER the state is
+    durable, so a crash mid-write can never leave ``latest`` pointing at
+    a partial checkpoint."""
+    pending = getattr(engine, "_pending_ckpt", None)
+    if pending is None:
+        return None
+    # the pending record is consumed no matter what: a failed background
+    # write must neither wedge future saves nor get its latest tag
+    # published on a retry (the partial-checkpoint corruption this
+    # protocol exists to prevent)
+    engine._pending_ckpt = None
+    engine._async_ckptr.wait_until_finished()
+    save_dir, tag, save_latest = pending
+    if save_latest and jax.process_index() == 0:
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(str(tag))
+    log_dist(f"async checkpoint {tag} finalized", ranks=[0])
+    return os.path.join(save_dir, str(tag))
+
+
+def close_async_checkpointer(engine):
+    """Release the per-engine AsyncCheckpointer's worker resources after
+    joining any pending save (call at engine teardown)."""
+    try:
+        finalize_pending_checkpoint(engine)
+    finally:
+        ckptr = getattr(engine, "_async_ckptr", None)
+        if ckptr is not None:
+            engine._async_ckptr = None
+            ckptr.close()
+
+
 def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
-                           save_latest=True):
+                           save_latest=True, async_save=False):
+    # at most one async save in flight: joining the previous one first
+    # also publishes its latest tag
+    finalize_pending_checkpoint(engine)
     tag = tag or f"global_step{engine.global_steps}"
     path = os.path.abspath(os.path.join(save_dir, str(tag)))
     os.makedirs(path, exist_ok=True)
@@ -37,8 +86,15 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
         state["optimizer_state"] = engine.optimizer_state
     if engine.fp16_enabled and engine.loss_scale_state is not None:
         state["loss_scale"] = dict(engine.loss_scale_state._asdict())
-    ckptr = _checkpointer()
-    ckptr.save(os.path.join(path, "state"), state, force=True)
+    if async_save:
+        _async_checkpointer(engine).save(
+            os.path.join(path, "state"), state, force=True)
+        engine._pending_ckpt = (os.path.abspath(save_dir), str(tag),
+                                save_latest)
+        save_latest = False   # published by finalize, post-durability
+    else:
+        ckptr = _checkpointer()
+        ckptr.save(os.path.join(path, "state"), state, force=True)
 
     if getattr(engine, "native_offload", None) is not None:
         # per-process host-state shard files (reference: the per-rank

@@ -1154,12 +1154,33 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True):
+                        save_latest=True, async_save=False):
+        """``async_save=True`` snapshots device state synchronously but
+        writes files in the background — training continues during the
+        write; the ``latest`` tag is published when the save is durable
+        (at the next save, or via ``wait_checkpoint()``)."""
         self._ensure_params_resident()
         from .checkpointing import save_engine_checkpoint
         return save_engine_checkpoint(self, save_dir, tag=tag,
                                       client_state=client_state,
-                                      save_latest=save_latest)
+                                      save_latest=save_latest,
+                                      async_save=async_save)
+
+    def wait_checkpoint(self):
+        """Join the in-flight async save and publish its latest tag."""
+        from .checkpointing import finalize_pending_checkpoint
+        return finalize_pending_checkpoint(self)
+
+    def destroy(self):
+        """Release engine-held background resources: the async
+        checkpointer's worker (after joining any pending save) and the
+        NVMe param swapper's aio threads (reference: engine.destroy)."""
+        from .checkpointing import close_async_checkpointer
+        close_async_checkpointer(self)
+        swapper = getattr(self, "_param_swapper", None)
+        if swapper is not None:
+            self._param_swapper = None
+            swapper.close()
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
@@ -1168,6 +1189,7 @@ class DeepSpeedEngine:
         # the on-disk flag (restore templates come from _param_shapes, so
         # paging the stale tree back in would be wasted SSD traffic)
         self._params_on_disk = False
+        self.wait_checkpoint()   # an in-flight async save must land first
         from .checkpointing import load_engine_checkpoint
         return load_engine_checkpoint(self, load_dir, tag=tag,
                                       load_optimizer_states=load_optimizer_states,

@@ -177,6 +177,36 @@ def test_checkpoint_roundtrip(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
 
 
+def test_async_checkpoint_save(tmp_path):
+    """async_save=True: training continues during the background write;
+    the latest tag is only published once the save is durable (at
+    wait_checkpoint or the next save) and the restore is exact."""
+    import os
+    engine = _init_kwargs_engine(1)
+    engine.train_batch(make_batch(16, seed=0))
+    snap = [np.array(l) for l in jax.tree.leaves(engine.params)]
+    engine.save_checkpoint(str(tmp_path), tag="a1", async_save=True)
+    # keep training while the write is in flight: the save must have
+    # snapshotted, so later steps cannot leak into the checkpoint
+    engine.train_batch(make_batch(16, seed=1))
+    engine.train_batch(make_batch(16, seed=2))
+    assert not os.path.exists(tmp_path / "latest")   # not yet durable
+    out = engine.wait_checkpoint()
+    assert out is not None
+    assert (tmp_path / "latest").read_text() == "a1"
+    assert engine.wait_checkpoint() is None          # idempotent
+
+    engine2 = _init_kwargs_engine(1)
+    engine2.load_checkpoint(str(tmp_path))           # via latest tag
+    for a, b in zip(snap, jax.tree.leaves(engine2.params)):
+        np.testing.assert_allclose(np.asarray(b), a, rtol=1e-6)
+    # teardown releases the async worker (joins pending saves first)
+    engine.save_checkpoint(str(tmp_path), tag="a2", async_save=True)
+    engine.destroy()
+    assert (tmp_path / "latest").read_text() == "a2"
+    engine.destroy()                                 # idempotent
+
+
 def test_chunked_loss_matches_full():
     """gpt_chunked_loss_fn == gpt_loss_fn on full logits (values AND
     grads) — the bench's memory-efficient path must be exact."""
