@@ -1181,6 +1181,12 @@ class DeepSpeedEngine:
         if swapper is not None:
             self._param_swapper = None
             swapper.close()
+        native = getattr(self, "native_offload", None)
+        if native is not None:
+            inner = getattr(native, "swapper", None)
+            if inner is not None:
+                native.swapper = None
+                inner.close()
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
