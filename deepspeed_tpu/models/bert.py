@@ -94,7 +94,8 @@ class BertEncoder(nn.Module):
             attn_dropout_rate=cfg.attn_dropout_rate, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype, ln_epsilon=cfg.ln_epsilon,
             attn_backend=cfg.attn_backend, activation=cfg.activation,
-            sparsity_config=cfg.sparsity_config)
+            sparsity_config=cfg.sparsity_config,
+            sparsity_pattern_len=cfg.max_seq_len)
 
         block_cls = Block
         if cfg.remat != "none":

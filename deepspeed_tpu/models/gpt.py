@@ -66,6 +66,8 @@ class GPTConfig:
     embed_ln: bool = False               # BLOOM word_embeddings_layernorm
     lm_head_bias: bool = False           # GPT-J untied head carries a bias
     seq_parallel: Optional[str] = None   # None=auto, "ulysses", "ring", "none"
+    sparsity_config: Any = None          # block-sparse attention pattern
+                                         # (train + KV-cache serving)
     offload_params: bool = False         # ZeRO-Infinity: block params live in
                                          # host memory, streamed in per scan
                                          # step (requires scan_layers)
@@ -160,7 +162,9 @@ class GPT(nn.Module):
             parallel_residual=cfg.parallel_residual,
             shared_parallel_ln=cfg.shared_parallel_ln,
             attn_use_bias=cfg.attn_use_bias, alibi=cfg.alibi,
-            seq_parallel=cfg.seq_parallel)
+            seq_parallel=cfg.seq_parallel,
+            sparsity_config=cfg.sparsity_config,
+            sparsity_pattern_len=cfg.max_seq_len)
 
         block_cls = Block
         policy = REMAT_POLICIES.get(cfg.remat)

@@ -140,6 +140,19 @@ def _is_qleaf(x):
     return isinstance(x, dict) and set(x.keys()) == {"q", "scale"}
 
 
+def _check_sparse_compat(sparsity_config, bias, causal, alibi=False):
+    """The sparse path's config refusals, shared by the training
+    forward and the KV-cache decode branch so the two can never drift."""
+    if alibi or bias is not None:
+        raise ValueError("sparse attention does not take an additive "
+                         "bias (disable alibi or sparsity_config)")
+    if causal and getattr(sparsity_config, "attention",
+                          "bidirectional") != "unidirectional":
+        raise ValueError(
+            "causal attention needs a sparsity config with "
+            "attention='unidirectional' (the layout encodes causality)")
+
+
 class QDense(nn.Module):
     """DenseGeneral twin that can consume weight-only int8 params.
 
@@ -242,6 +255,8 @@ class SelfAttention(nn.Module):
     alibi: bool = False
     seq_parallel: Optional[str] = None   # None=auto, "ulysses", "ring", "none"
     sparsity_config: Any = None          # SparsityConfig -> block-sparse path
+    sparsity_pattern_len: Optional[int] = None   # the TRAINED pattern length
+                                         # (decode serves this exact pattern)
 
     @nn.compact
     def __call__(self, x, mask=None, bias=None, deterministic=True,
@@ -292,7 +307,49 @@ class SelfAttention(nn.Module):
                 cached_key.value = k_all
                 cached_value.value = v_all
                 cache_index.value = idx + s
-                if s == 1 and mask is None and (
+                # sparsity pattern at decode: the current query rows'
+                # slice of the TRAINED block pattern becomes a key mask
+                # over the cache — same semantics as training, no dense
+                # fallback drift (reference class: sparse models served
+                # by masking, sparse_self_attention.py)
+                pattern = None
+                if self.sparsity_config is not None:
+                    # same config refusals as the training forward —
+                    # silently different serving semantics would be
+                    # worse than the error
+                    _check_sparse_compat(self.sparsity_config, bias,
+                                         self.causal, self.alibi)
+                    # the pattern is pinned to the TRAINED length: random
+                    # block layouts (BigBird) are length-dependent, so
+                    # building at the cache length would silently serve a
+                    # pattern the model never trained with
+                    import numpy as _np
+                    blk = self.sparsity_config.block
+                    plen = self.sparsity_pattern_len or (
+                        max_len if max_len % blk == 0
+                        else (max_len // blk + 1) * blk)
+                    layout = _np.asarray(
+                        self.sparsity_config.make_layout(plen))
+                    nbp = layout.shape[-1]
+                    lay = jnp.asarray(layout.astype(bool))  # [H, nbp, nbp]
+                    # gather rows/cols per position: exact [s, max_len]
+                    # coverage for ANY block-vs-cache-length relation
+                    # (generate() rounds the cache to 128s, which need
+                    # not align with plen or block). Positions beyond
+                    # plen are clamped AND masked off — a query past the
+                    # trained pattern can only occur past max_seq_len,
+                    # which the position embeddings refuse first.
+                    row_pos = idx + jnp.arange(s)
+                    row_blocks = jnp.clip(row_pos // blk, 0, nbp - 1)
+                    col_pos = jnp.arange(max_len)
+                    col_blocks = jnp.clip(col_pos // blk, 0, nbp - 1)
+                    rows = jnp.take(lay, row_blocks, axis=1)  # [H,s,nbp]
+                    pattern = jnp.take(rows, col_blocks, axis=2)
+                    pattern = jnp.logical_and(
+                        pattern, (col_pos < plen)[None, None, :])[None]
+                    # [1, H, s, max_len]; elementwise causality comes
+                    # from the cache validity mask ANDed below
+                if s == 1 and mask is None and pattern is None and (
                         self.dropout_rate == 0.0 or deterministic):
                     # THE serving hot path (reference: softmax_context,
                     # pt_binding.cpp:1197-1244): single-token KV-cache
@@ -321,6 +378,8 @@ class SelfAttention(nn.Module):
                             (0,) * (mask.ndim - 1) + (idx,))
                     mask = cache_mask if mask is None else jnp.logical_and(
                         mask, cache_mask)
+                    if pattern is not None:
+                        mask = jnp.logical_and(mask, pattern)
                     causal = False
 
         if decode_out is not None:
@@ -346,28 +405,30 @@ class SelfAttention(nn.Module):
         if self.dropout_rate > 0.0 and not deterministic:
             dropout_rng = self.make_rng("dropout")
 
-        if self.sparsity_config is not None and decode:
-            # decoding against the KV cache with dense attention would
-            # silently change semantics vs the sparse pattern the model
-            # trained with — refuse rather than mismatch
-            raise NotImplementedError(
-                "KV-cache decoding with a sparsity_config is not "
-                "supported; serve with the dense model or generate via "
-                "full re-forward")
-        if self.sparsity_config is not None:
+        if self.sparsity_config is not None and not decode:
             # Block-sparse pattern path (reference: SparseSelfAttention
             # wired into BERT via SparseAttentionUtils). The layout encodes
             # causality for unidirectional configs; additive bias (ALiBi)
             # and attention dropout have no reference sparse analog.
-            if bias is not None:
-                raise ValueError("sparse attention does not take an additive "
-                                 "bias (disable alibi or sparsity_config)")
-            if causal and getattr(self.sparsity_config, "attention",
-                                  "bidirectional") != "unidirectional":
-                raise ValueError(
-                    "causal attention needs a sparsity config with "
-                    "attention='unidirectional' (the layout encodes "
-                    "causality)")
+            _check_sparse_compat(self.sparsity_config, bias, causal)
+            plen = self.sparsity_pattern_len
+            pinned_mask = None
+            if (plen and plen != q.shape[1]
+                    and not getattr(self.sparsity_config,
+                                    "prefix_stable", True)):
+                # random-block layouts are length-dependent: a forward at
+                # s != trained length must slice the TRAINED pattern.
+                # sparse_attention would AND in its own layout(s) — a
+                # DIFFERENT random pattern — so this case goes straight
+                # to dense attention with the sliced trained mask
+                # (correctness over the kernel's FLOP savings).
+                from ..ops.sparse_attention.sparse_self_attention import \
+                    layout_to_dense_mask
+                sl = q.shape[1]
+                pinned_mask = layout_to_dense_mask(
+                    self.sparsity_config, plen)[:, :, :sl, :sl]
+                if mask is not None:
+                    pinned_mask = jnp.logical_and(pinned_mask, mask)
             if self.dropout_rate > 0.0 and not deterministic:
                 # unlike the bias case this is recoverable — but silent
                 # divergence from the configured rate is not (ADVICE r3)
@@ -377,9 +438,13 @@ class SelfAttention(nn.Module):
                     "configured attention dropout rate "
                     f"{self.dropout_rate} is NOT applied on the sparse "
                     "path (dense attention applies it)")
-            from ..ops.sparse_attention import sparse_attention
-            out = sparse_attention(q, k, v, self.sparsity_config,
-                                   attn_mask=mask)
+            if pinned_mask is not None:
+                out = attention(q, k, v, mask=pinned_mask,
+                                seq_parallel="none")
+            else:
+                from ..ops.sparse_attention import sparse_attention
+                out = sparse_attention(q, k, v, self.sparsity_config,
+                                       attn_mask=mask)
         else:
             out = attention(q, k, v, bias=bias, mask=mask, causal=causal,
                             dropout_rate=self.dropout_rate,
@@ -465,6 +530,7 @@ class Block(nn.Module):
     alibi: bool = False
     seq_parallel: Optional[str] = None
     sparsity_config: Any = None
+    sparsity_pattern_len: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, mask=None, bias=None, deterministic=True,
@@ -478,6 +544,7 @@ class Block(nn.Module):
                              attn_backend=self.attn_backend,
                              alibi=self.alibi, seq_parallel=self.seq_parallel,
                              sparsity_config=self.sparsity_config,
+                             sparsity_pattern_len=self.sparsity_pattern_len,
                              name="attn")
         mlp_cls = self.mlp_factory or (lambda name: MLP(
             d_model=self.d_model, d_ff=self.d_ff, dtype=self.dtype,

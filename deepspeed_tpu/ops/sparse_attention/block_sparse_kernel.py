@@ -126,14 +126,21 @@ def compile_layout(config, seq_len: int) -> Optional[LayoutPlan]:
     if not fine.any(axis=-1).all():
         return None
 
+    causal = getattr(config, "attention", None) == "unidirectional"
     masks: list = []
     mask_ids: dict = {}
 
-    def pattern_id(sub):
-        key_ = sub.tobytes()
+    def pattern_id(sub, rel):
+        """rel: "past" = tile fully before the diagonal, "diag" = the
+        triangular tile (unidirectional semantics are causal at the
+        ELEMENT level — the reference triton kernel's in-block masking)."""
+        key_ = (sub.tobytes(), rel)
         if key_ not in mask_ids:
             expanded = np.kron(sub, np.ones((tile // sub.shape[0],
                                              tile // sub.shape[1]), np.int8))
+            if rel == "diag":
+                expanded = expanded * np.tril(
+                    np.ones((tile, tile), np.int8))
             mask_ids[key_] = len(masks)
             masks.append(expanded.astype(np.int8))
         return mask_ids[key_]
@@ -146,10 +153,13 @@ def compile_layout(config, seq_len: int) -> Optional[LayoutPlan]:
             subrows = fine[h, qi * rq:(qi + 1) * rq] if rq > 1 else \
                 fine[h, qi:qi + 1]
             for ki in range(nq):
+                if causal and ki > qi:
+                    continue   # entirely future: elementwise all-zero
                 sub = subrows[:, ki * rq:(ki + 1) * rq] if rq > 1 else \
                     subrows[:, ki:ki + 1]
                 if sub.any():
-                    pid = pattern_id(np.ascontiguousarray(sub))
+                    rel = "diag" if (causal and ki == qi) else "past"
+                    pid = pattern_id(np.ascontiguousarray(sub), rel)
                     rows[h][qi].append((ki, pid))
                     cols[h][ki].append((qi, pid))
                     total += 1

@@ -35,16 +35,27 @@ def layout_to_dense_mask(config: SparsityConfig, seq_len: int):
     except TypeError:   # unhashable custom attribute: compute uncached
         key = None
     if key is not None and key in _MASK_CACHE:
-        return _MASK_CACHE[key]
+        # cache holds NUMPY: a jnp array built inside one jit trace is a
+        # tracer-backed constant and must not leak into another trace
+        # (e.g. prefill's jit populating it, the decode loop's jit
+        # reusing it)
+        return jnp.asarray(_MASK_CACHE[key])
     layout = config.make_layout(seq_len)
-    mask = jnp.asarray(np.kron(
-        layout, np.ones((config.block, config.block), np.int8))[None]
-        .astype(bool))  # [1, H, S, S]
+    mask_np = np.kron(
+        layout, np.ones((config.block, config.block), np.int8))[None] \
+        .astype(bool)  # [1, H, S, S]
+    if getattr(config, "attention", None) == "unidirectional":
+        # the block layout is tril at BLOCK granularity; unidirectional
+        # semantics are strictly causal at the ELEMENT level (reference:
+        # the triton softmax kernel's triangular masking inside diagonal
+        # blocks) — without this, position i attends up to block-1
+        # future positions inside its own diagonal block
+        mask_np = mask_np & np.tril(np.ones((seq_len, seq_len), bool))
     if key is not None:
         if len(_MASK_CACHE) >= 32:
             _MASK_CACHE.pop(next(iter(_MASK_CACHE)))
-        _MASK_CACHE[key] = mask
-    return mask
+        _MASK_CACHE[key] = mask_np
+    return jnp.asarray(mask_np)
 
 
 def sparse_attention(q, k, v, sparsity_config: SparsityConfig, *,
@@ -101,10 +112,8 @@ def sparse_attention(q, k, v, sparsity_config: SparsityConfig, *,
                                key_padding_mask[:, None, None, :].astype(bool))
     if attn_mask is not None:
         mask = jnp.logical_and(mask, attn_mask.astype(bool))
-    causal = getattr(sparsity_config, "attention", None) == "unidirectional"
-    # layout already encodes causality when unidirectional; causal=False
-    # avoids double-masking
-    del causal
+    # unidirectional causality (block AND element level) is encoded in
+    # the dense mask by layout_to_dense_mask; no separate causal flag
     return attention(q, k, v, mask=mask, softmax_scale=softmax_scale,
                      seq_parallel="none")
 

@@ -38,6 +38,15 @@ class SparsityConfig:
     def make_layout(self, seq_len: int) -> np.ndarray:
         raise NotImplementedError
 
+    @property
+    def prefix_stable(self) -> bool:
+        """True when layout(S)[:s, :s] == layout(s) for every s <= S —
+        i.e. the pattern a prefix sees does not depend on the total
+        length. Random-block configs (BigBird, Variable with
+        num_random_blocks > 0) are NOT prefix-stable: their layouts must
+        be built once at the trained length and sliced."""
+        return getattr(self, "num_random_blocks", 0) == 0
+
     def check_and_propagate_first_head_layout(self, layout: np.ndarray):
         if not self.different_layout_per_head:
             layout[1:] = layout[0]
