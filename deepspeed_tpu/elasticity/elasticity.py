@@ -55,6 +55,12 @@ class ElasticityConfig:
 
     @classmethod
     def from_dict(cls, d: dict) -> "ElasticityConfig":
+        d = dict(d)
+        if "prefer_larger_batch" in d:
+            # the reference's JSON key (elasticity/constants.py:55) —
+            # accept it verbatim so reference configs load unchanged
+            d.setdefault("prefer_larger_batch_size",
+                         d.pop("prefer_larger_batch"))
         known = {f for f in cls.__dataclass_fields__}
         unknown = set(d) - known
         if unknown:

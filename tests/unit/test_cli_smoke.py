@@ -1,0 +1,68 @@
+"""Smoke tests for every bin/ CLI entry (reference analogs: bin/deepspeed,
+ds_report, ds_elastic, ds_ssh, ds_bench + the checkpoint converter).
+Each runs as a real subprocess — catches import breakage, argparse
+regressions and sys.path wiring that in-process tests cannot."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+BIN = os.path.join(REPO, "bin")
+
+
+def _run(args, timeout=120, env_extra=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, timeout=timeout, env=env)
+
+
+@pytest.mark.parametrize("script", [
+    "ds_tpu", "ds_tpu_bench", "ds_tpu_elastic", "ds_tpu_ssh",
+    "ds_tpu_to_universal"])
+def test_help_exits_zero(script):
+    r = _run([os.path.join(BIN, script), "--help"])
+    assert r.returncode == 0, r.stderr[-300:]
+    assert "usage" in r.stdout.lower()
+
+
+def test_report_runs():
+    # ds_tpu_report has no flags: it prints the env + op matrix directly
+    r = _run([os.path.join(BIN, "ds_tpu_report")], timeout=300)
+    assert r.returncode == 0, r.stderr[-300:]
+    assert "environment info" in r.stdout
+
+
+def test_elastic_resolves_config(tmp_path):
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 1024,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 8, "min_time": 0,
+                          "prefer_larger_batch": True, "version": 0.1}}
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps(cfg))
+    r = _run([os.path.join(BIN, "ds_tpu_elastic"), "-c", str(p),
+              "-w", "4"])
+    assert r.returncode == 0, r.stderr[-300:]
+    assert "batch" in r.stdout.lower()
+
+
+def test_to_universal_rejects_bad_mesh(tmp_path):
+    r = _run([os.path.join(BIN, "ds_tpu_to_universal"), str(tmp_path),
+              str(tmp_path / "out"), "--target-mesh", "bogus=2"])
+    assert r.returncode != 0
+    assert "axis" in r.stderr
+
+
+def test_launcher_single_host_exec(tmp_path):
+    script = tmp_path / "hello.py"
+    script.write_text("print('LAUNCHED_OK')\n")
+    r = _run([os.path.join(BIN, "ds_tpu"), str(script)])
+    assert r.returncode == 0, r.stderr[-300:]
+    assert "LAUNCHED_OK" in r.stdout
