@@ -59,8 +59,11 @@ class ElasticityConfig:
         if "prefer_larger_batch" in d:
             # the reference's JSON key (elasticity/constants.py:55) —
             # accept it verbatim so reference configs load unchanged
-            d.setdefault("prefer_larger_batch_size",
-                         d.pop("prefer_larger_batch"))
+            legacy = d.pop("prefer_larger_batch")
+            if d.setdefault("prefer_larger_batch_size", legacy) != legacy:
+                raise ElasticityConfigError(
+                    "prefer_larger_batch and prefer_larger_batch_size "
+                    "are both set and disagree; keep one")
         known = {f for f in cls.__dataclass_fields__}
         unknown = set(d) - known
         if unknown:

@@ -193,27 +193,45 @@ class GPT(nn.Module):
                 raise NotImplementedError(
                     "offload_params is a training feature; serve with a "
                     "non-offloaded config")
-            if (cfg.dropout_rate > 0 or cfg.attn_dropout_rate > 0) \
-                    and not deterministic:
-                raise NotImplementedError(
-                    "offload_params with dropout is unsupported (per-layer "
-                    "rng threading); set dropout rates to 0")
             from ..utils.streaming import stream_in_tree
             stacked = self.scope.get_variable("params", "h")
             blk = Block(**block_kwargs, parent=None)
+            has_dropout = ((cfg.dropout_rate > 0
+                            or cfg.attn_dropout_rate > 0)
+                           and not deterministic)
+            # per-layer rng: fold the layer index into one base dropout
+            # key (the nn.scan path's split_rngs={"dropout": True} analog)
+            drop_base = self.make_rng("dropout") if has_dropout else None
+            # TPU XLA mis-fuses the BACKWARD re-slice of host-space scan
+            # xs when a stacked leaf has ndim<3 ("Shape mismatch between
+            # parameter and its operand ... S(5)" in the transpose while
+            # body, repro'd 2026-07-31 on v5e): the [1,N] dynamic-slice
+            # lands in a kLoop fusion whose parameter drops the host
+            # space. Dodge the fusion shape: give small leaves a dummy
+            # middle axis (free host-space reshape) and restore the block
+            # shape after the h2d fetch.
+            exp = jax.tree.map(
+                lambda a: (a.reshape(a.shape[0], 1, -1)
+                           if a.ndim < 3 else a), stacked)
 
-            def call(p, x):
+            def call(p, x, i):
+                rngs = ({"dropout": jax.random.fold_in(drop_base, i)}
+                        if has_dropout else None)
                 return blk.apply({"params": p}, x, mask, bias,
                                  deterministic, layer_keep_prob, decode,
-                                 positions)
+                                 positions, rngs=rngs)
 
-            def step(carry, p):
+            def step(carry, xs):
+                p, i = xs
                 p = stream_in_tree(p)
+                p = jax.tree.map(lambda a, o: a.reshape(o.shape[1:]),
+                                 p, stacked)
                 f = (jax.checkpoint(call, policy=policy)
                      if cfg.remat != "none" else call)
-                return f(p, carry), None
+                return f(p, carry, i), None
 
-            h, _ = jax.lax.scan(step, h, stacked)
+            h, _ = jax.lax.scan(
+                step, h, (exp, jnp.arange(cfg.n_layers)))
         elif cfg.scan_layers:
             def body(block, carry):
                 x = block(carry, mask, bias, deterministic,

@@ -1,0 +1,46 @@
+"""Entry-point environment fixups shared by the ``bin/`` CLI scripts.
+
+Some worker images ship a ``sitecustomize`` that registers an
+accelerator plugin and re-forces the JAX platform list via
+``jax.config`` at import time — and ``jax.config`` wins over the
+``JAX_PLATFORMS`` env var. A CLI invoked with ``JAX_PLATFORMS=cpu`` on a
+host whose accelerator is unreachable would then hang in backend init
+instead of doing what the user asked. Every CLI entry point calls
+:func:`honor_jax_platforms_env` before touching anything that may
+initialize a backend (same workaround as ``tests/conftest.py`` and
+``__graft_entry__.py``).
+"""
+
+import os
+
+
+def honor_jax_platforms_env():
+    """Make ``JAX_PLATFORMS`` authoritative over a sitecustomize's
+    ``jax.config`` platform override. No-op when the env var is unset or
+    the backend is already initialized."""
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+        try:
+            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        except Exception as e:
+            # backend already initialized: too late to redirect — say so
+            # instead of silently proceeding on the wrong platform (the
+            # hang this helper exists to prevent)
+            import sys
+            print(f"[host_env] warning: could not apply "
+                  f"JAX_PLATFORMS={os.environ['JAX_PLATFORMS']!r} "
+                  f"({e}); backend may already be initialized on another "
+                  f"platform", file=sys.stderr)
+
+
+def force_host_device_count(n: int):
+    """Request an ``n``-device virtual CPU backend (the CI/fake mesh).
+    Must run before backend init."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={int(n)}").strip()
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
