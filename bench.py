@@ -93,6 +93,68 @@ def _train_bench(preset, config_extra, micro, gas, steps, np, jax, jnp, ds,
             "loss": round(float(loss), 3)}
 
 
+def bench_zero_inference(np, jax, jnp, ds, models, preset="gpt2-6.7b",
+                         tokens=3):
+    """ZeRO-Inference (reference: DeepSpeedZeRoOffload standalone for
+    inference, parameter_offload.py:166): serve a bf16 model whose
+    weights exceed HBM by streaming the block kernels from the
+    accelerator host's pinned memory per layer. 6.7B bf16 = 12.9GB of
+    kernels on a 16GB chip (the int8 path quantizes; this path doesn't).
+    Init lands the kernels straight in host space (out_shardings), so
+    peak HBM never holds the full model."""
+    import dataclasses
+    import flax.core.meta as flax_meta
+    from jax.sharding import SingleDeviceSharding
+    from deepspeed_tpu.inference.generation import (init_cache, _prefill,
+                                                    _decode_loop)
+    dev = jax.devices()[0]
+    GPT = models.GPT
+    mcfg = dataclasses.replace(models.GPT2_PRESETS[preset],
+                               dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+                               scan_layers=True, max_seq_len=2048)
+    model = GPT(mcfg)
+    ids = jnp.ones((1, 16), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda r: flax_meta.unbox(model.init(r, ids))["params"],
+        jax.random.PRNGKey(0))
+    host = SingleDeviceSharding(dev, memory_kind="pinned_host")
+    devs = SingleDeviceSharding(dev, memory_kind="device")
+    out_sh = dict(jax.tree.map(lambda _: devs, shapes))
+    out_sh["h"] = jax.tree.map(
+        lambda s: host if len(s.shape) >= 3 else devs, shapes["h"])
+    params = jax.jit(
+        lambda r: flax_meta.unbox(model.init(r, ids))["params"],
+        out_shardings=out_sh)(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    hb = sum(x.nbytes for x in jax.tree.leaves(params["h"])
+             if x.sharding.memory_kind == "pinned_host")
+    eng = ds.init_inference(GPT(mcfg), params=params, dtype=jnp.bfloat16,
+                            offload_params=True, max_tokens=128)
+    cache = init_cache(eng.module, eng.params, 1, 128)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, mcfg.vocab_size, size=(1, 32)),
+                         jnp.int32)
+    logits, cache = _prefill(eng.module, eng.params, cache, prompt,
+                             jnp.arange(32), None)
+    last = jnp.argmax(logits[:, -1, :], axis=-1)
+    lat = []
+    for i in range(tokens + 1):          # +1 warm-up (compile)
+        t0 = time.time()
+        toks, cache = _decode_loop(eng.module, eng.params, cache, last,
+                                   jnp.int32(32 + i), 1, 0.0, None, None,
+                                   jax.random.PRNGKey(1), None)
+        last = toks[:, -1]
+        _ = np.asarray(last)
+        lat.append(time.time() - t0)
+    warm = sorted(lat[1:])[len(lat[1:]) // 2]
+    return {"model": preset + "-bf16-offload",
+            "host_streamed_gb": round(hb / 1e9, 1),
+            "s_per_token": round(warm, 2),
+            "effective_host_bw_gbps": round(hb / 1e9 / warm, 1),
+            "note": "weights exceed HBM; kernels stream from pinned host "
+                    "memory per layer (ZeRO-Inference)"}
+
+
 def bench_1p3b(np, jax, jnp, ds, models):
     """North star: GPT-2 1.3B, ZeRO-2 + streamed host Adam offload.
 
@@ -261,20 +323,36 @@ def bench_sparse_kernel(np, jax, jnp, seq=8192, heads=8, d=64, batch=2):
             best = min(best, time.time() - t0)
         return best / REPS * 1e3
 
+    # both paths are opaque pallas_calls (no DCE asymmetry); subtract the
+    # dispatch+fetch floor, which at REPS=8 is a material fraction of a
+    # ms-scale kernel on this tunneled rig
+    t_floor = clock(lambda q, k, v: q[:1, :1, :1, :1])
     t_sparse = clock(lambda q, k, v: sparse_attention(q, k, v, cfg,
-                                                      backend="pallas"))
+                                                      backend="pallas")) \
+        - t_floor
     t_dense = clock(lambda q, k, v: attention(q, k, v, causal=False,
-                                              seq_parallel="none"))
+                                              seq_parallel="none")) \
+        - t_floor
     return {"seq": seq, "layout_density": round(plan.density, 3),
             "sparse_ms": round(t_sparse, 2), "dense_ms": round(t_dense, 2),
+            "harness_floor_ms": round(t_floor, 2),
             "speedup": round(t_dense / t_sparse, 2)}
 
 
-def bench_fused_epilogue(np, jax, jnp, d=4096, reps=30):
+def bench_fused_epilogue(np, jax, jnp, d=4096, reps=100):
     """Substantiates the design claim that XLA fuses the bias+GELU
     epilogue into the matmul (why there is no hand-written gelu kernel;
     reference hand-fuses it in csrc/transformer/gelu_kernels.cu): the
-    fused chain must cost ~the bare matmul."""
+    fused chain must cost ~the bare matmul.
+
+    Harness notes (2026-07-31, after a flawed first version): (a) the
+    carried reduction must consume the FULL output — reducing o[0,0]
+    lets XLA shrink some variants but not others, which read as a fake
+    25-35% "epilogue overhead"; (b) a trivial-op floor run is subtracted
+    (sum+carry costs ~0.34ms/rep here). Measured sound: epilogue ~2%,
+    matmul ~122 TFLOPS — and a hand-written Pallas matmul+gelu kernel
+    benched 22% SLOWER than the XLA chain, confirming the no-kernel
+    design."""
     import time as _t
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((d, d)), jnp.bfloat16)
@@ -286,17 +364,27 @@ def bench_fused_epilogue(np, jax, jnp, d=4096, reps=30):
         def g(x, w, b):
             def body(c, _):
                 o = fn(x + c, w, b)
-                return c + o[0, 0] * jnp.bfloat16(1e-9), None
+                # full-output reduction: nothing is DCE-able
+                s = jnp.sum(o.astype(jnp.float32)).astype(jnp.bfloat16)
+                return c + s * jnp.bfloat16(1e-12), None
             c, _ = jax.lax.scan(body, jnp.bfloat16(0.), None, length=reps)
             return c
         _ = np.asarray(g(x, w, b))
-        t0 = _t.time()
-        _ = np.asarray(g(x, w, b))
-        return (_t.time() - t0) / reps * 1e3
+        best = float("inf")
+        for _i in range(3):
+            t0 = _t.time()
+            _ = np.asarray(g(x, w, b))
+            best = min(best, _t.time() - t0)
+        return best / reps * 1e3
 
-    t_mm = loop(lambda x, w, b: jnp.dot(x, w))
-    t_full = loop(lambda x, w, b: jax.nn.gelu(jnp.dot(x, w) + b))
-    return {"matmul_ms": round(t_mm, 3), "matmul_bias_gelu_ms": round(t_full, 3),
+    t_floor = loop(lambda x, w, b: x[:1, :1])
+    t_mm = loop(lambda x, w, b: jnp.dot(x, w)) - t_floor
+    t_full = loop(lambda x, w, b: jax.nn.gelu(jnp.dot(x, w) + b)) - t_floor
+    tflops = 2 * d ** 3 / (t_mm * 1e-3) / 1e12
+    return {"matmul_ms": round(t_mm, 3),
+            "matmul_bias_gelu_ms": round(t_full, 3),
+            "matmul_tflops": round(tflops, 1),
+            "harness_floor_ms": round(t_floor, 3),
             "epilogue_overhead_pct": round((t_full / t_mm - 1) * 100, 1)}
 
 
@@ -354,6 +442,10 @@ def main():
     # bf16 weights + cache exceed HBM; 6.7GB int8 + bf16 embeddings fit)
     run("decode_int8_6p7b", bench_decode, np, jax, jnp, models,
         preset="gpt2-6.7b", int8=True)
+    # same 6.7B servable WITHOUT quantization: bf16 weights exceed HBM
+    # and stream from pinned host memory (ZeRO-Inference)
+    run("decode_6p7b_bf16_zero_inference", bench_zero_inference,
+        np, jax, jnp, ds, models)
     run("gpt2_1p3b_zero_offload", bench_1p3b, np, jax, jnp, ds, models)
     run("gpt2_125m_zero1", bench_125m, np, jax, jnp, ds, models)
 

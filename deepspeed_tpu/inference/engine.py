@@ -35,7 +35,8 @@ class InferenceEngine:
                  replace_with_kernel_inject: bool = False,
                  injection_policy=None, max_tokens: int = 1024,
                  mesh=None, quantize_weights: bool = False,
-                 quantize_min_size: int = 4096, **kwargs):
+                 quantize_min_size: int = 4096,
+                 offload_params: bool = False, **kwargs):
         dist.init_distributed()
         # serving never fake-quantizes activations: clear any rule table a
         # compression-training engine left in this process (the table is
@@ -111,6 +112,57 @@ class InferenceEngine:
                 f"int8 weight-only quantization: "
                 f"{nb['quantized']/1e6:.1f}MB vs "
                 f"{nb['dense_equivalent']/1e6:.1f}MB dense", ranks=[0])
+
+        self._zero_inference = False
+        if offload_params:
+            # ZeRO-Inference (reference: DeepSpeedZeRoOffload standalone
+            # for inference, runtime/zero/parameter_offload.py:166):
+            # weights larger than HBM live in the accelerator host's
+            # memory and stream per layer through the decode scan. The
+            # per-token cost is host-link-bandwidth-bound — the mode
+            # trades latency for model size (serve bf16 models whose
+            # weights alone exceed the chip).
+            mcfg = getattr(self.module, "config", None)
+            if mcfg is None or not hasattr(mcfg, "offload_params"):
+                raise ValueError(
+                    "offload_params serving needs a model with "
+                    "parameter-streaming support (deepspeed_tpu.models "
+                    "with scan_layers=True)")
+            if not getattr(mcfg, "scan_layers", False):
+                raise ValueError(
+                    "offload_params serving requires scan_layers=True "
+                    "(the scan step is the fetch granularity)")
+            if not getattr(mcfg, "offload_params", False):
+                import dataclasses
+                self.module = type(self.module)(
+                    dataclasses.replace(mcfg, offload_params=True))
+            if self.params is not None:
+                self.params = self._place_offloaded(self.params)
+            self._zero_inference = True
+            log_dist("ZeRO-Inference: block params in host memory, "
+                     "streamed per layer through the decode scan",
+                     ranks=[0])
+
+    @staticmethod
+    def _place_offloaded(params):
+        """Host-place the stacked block KERNELS (>=3-D leaves of "h");
+        bias/scale leaves (KB-scale) plus embeddings and the final norm
+        stay device-resident — the reference's persistence-threshold
+        semantics, and required on TPU (host-space scan xs with ndim<3
+        leaves hit XLA layout bugs; see models/gpt.py offload branch)."""
+        import jax
+        from ..utils.streaming import to_host_tree
+        from flax.core import meta as _meta
+        params = dict(_meta.unbox(params))
+        if "h" not in params:
+            raise ValueError(
+                "offload_params serving expects scan-stacked block params "
+                f"under 'h'; got keys {sorted(params)}")
+        params["h"] = jax.tree.map(
+            lambda a: (to_host_tree(a) if getattr(a, "ndim", 0) >= 3
+                       else jax.device_put(a, jax.memory.Space.Device)),
+            params["h"])
+        return params
 
     def _load_checkpoint(self, checkpoint):
         from ..module_inject.load_checkpoint import load_model_checkpoint

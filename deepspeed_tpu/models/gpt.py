@@ -189,10 +189,13 @@ class GPT(nn.Module):
             # the backward recompute re-fetches instead of saving device
             # copies. XLA overlaps block k+1's fetch with block k's math
             # (the coordinator's prefetch, scheduled by the compiler).
-            if decode:
-                raise NotImplementedError(
-                    "offload_params is a training feature; serve with a "
-                    "non-offloaded config")
+            #
+            # decode=True is the ZeRO-Inference serving mode (reference:
+            # DeepSpeedZeRoOffload standalone for inference,
+            # parameter_offload.py:166 — weights beyond HBM stream from
+            # host per layer): the stacked KV cache rides the same scan
+            # as xs (sliced per layer) and ys (updated slices restacked),
+            # then is written back to the mutable collection.
             from ..utils.streaming import stream_in_tree
             stacked = self.scope.get_variable("params", "h")
             blk = Block(**block_kwargs, parent=None)
@@ -202,17 +205,17 @@ class GPT(nn.Module):
             # per-layer rng: fold the layer index into one base dropout
             # key (the nn.scan path's split_rngs={"dropout": True} analog)
             drop_base = self.make_rng("dropout") if has_dropout else None
-            # TPU XLA mis-fuses the BACKWARD re-slice of host-space scan
-            # xs when a stacked leaf has ndim<3 ("Shape mismatch between
-            # parameter and its operand ... S(5)" in the transpose while
-            # body, repro'd 2026-07-31 on v5e): the [1,N] dynamic-slice
-            # lands in a kLoop fusion whose parameter drops the host
-            # space. Dodge the fusion shape: give small leaves a dummy
-            # middle axis (free host-space reshape) and restore the block
-            # shape after the h2d fetch.
-            exp = jax.tree.map(
-                lambda a: (a.reshape(a.shape[0], 1, -1)
-                           if a.ndim < 3 else a), stacked)
+            # Only >=3-D stacked leaves (the kernels) live host-side; the
+            # engine's placement keeps <3-D leaves (bias/scale, KB-scale)
+            # DEVICE-resident — the reference's persistence-threshold
+            # semantics (stage3_param_persistence_threshold: small params
+            # stay resident). This is also load-bearing for correctness
+            # on TPU: host-space scan xs with ndim<3 leaves hit XLA
+            # layout bugs (f32 [L,N]: backward re-slice mis-fused losing
+            # the S(5) space; bf16 [L,N]: runtime DMA crash; in-jit
+            # reshape dodges trip "Only handling bitcasts with majormost
+            # dimension of size 1" at scale — all repro'd 2026-07-31 on
+            # v5e). stream_in on an already-device leaf is an identity.
 
             def call(p, x, i):
                 rngs = ({"dropout": jax.random.fold_in(drop_base, i)}
@@ -221,17 +224,36 @@ class GPT(nn.Module):
                                  deterministic, layer_keep_prob, decode,
                                  positions, rngs=rngs)
 
-            def step(carry, xs):
-                p, i = xs
-                p = stream_in_tree(p)
-                p = jax.tree.map(lambda a, o: a.reshape(o.shape[1:]),
-                                 p, stacked)
-                f = (jax.checkpoint(call, policy=policy)
-                     if cfg.remat != "none" else call)
-                return f(p, carry, i), None
+            if decode:
+                if has_dropout:
+                    raise NotImplementedError(
+                        "offload_params decode with live dropout (MC "
+                        "sampling) is unsupported; pass "
+                        "deterministic=True or serve without offload")
+                cache_in = self.get_variable("cache", "h")
 
-            h, _ = jax.lax.scan(
-                step, h, (exp, jnp.arange(cfg.n_layers)))
+                def step_dec(carry, xs):
+                    p, c = xs
+                    p = stream_in_tree(p)
+                    out, vars_out = blk.apply(
+                        {"params": p, "cache": c}, carry, mask, bias,
+                        deterministic, layer_keep_prob, decode, positions,
+                        mutable=["cache"])
+                    return out, vars_out["cache"]
+
+                h, cache_out = jax.lax.scan(
+                    step_dec, h, (stacked, cache_in))
+                self.put_variable("cache", "h", cache_out)
+            else:
+                def step(carry, xs):
+                    p, i = xs
+                    p = stream_in_tree(p)
+                    f = (jax.checkpoint(call, policy=policy)
+                         if cfg.remat != "none" else call)
+                    return f(p, carry, i), None
+
+                h, _ = jax.lax.scan(
+                    step, h, (stacked, jnp.arange(cfg.n_layers)))
         elif cfg.scan_layers:
             def body(block, carry):
                 x = block(carry, mask, bias, deterministic,

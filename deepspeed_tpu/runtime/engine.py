@@ -386,11 +386,17 @@ class DeepSpeedEngine:
         self.param_shardings = jax.tree.map(
             lambda spec: NamedSharding(self.mesh, spec),
             self.param_specs, is_leaf=lambda x: isinstance(x, P))
-        # ZeRO-Infinity: scan-stacked block params ("layers" leading axis)
-        # live in host memory; everything else stays in HBM
+        # ZeRO-Infinity: scan-stacked block KERNELS ("layers" leading
+        # axis, >=3-D) live in host memory; bias/scale leaves (<3-D
+        # stacked, KB-scale) and everything else stay in HBM — the
+        # reference's persistence-threshold semantics
+        # (stage3_param_persistence_threshold: small params stay
+        # resident), and required on TPU: host-space scan xs with ndim<3
+        # leaves hit XLA layout bugs (see models/gpt.py offload branch)
         self._offload_mask = jax.tree.map(
-            lambda n: bool(n and "layers" in n),
-            self._param_names, is_leaf=_tree_names_is_leaf)
+            lambda n, s: bool(n and "layers" in n and len(s.shape) >= 3),
+            self._param_names, self._param_shapes,
+            is_leaf=_tree_names_is_leaf)
         if getattr(self, "_offload_params", False):
             self.param_shardings = jax.tree.map(
                 lambda sh, off: _host_kind(sh) if off else sh,

@@ -40,7 +40,6 @@ def force_host_device_count(n: int):
         os.environ.get("XLA_FLAGS", "")
         + f" --xla_force_host_platform_device_count={int(n)}").strip()
     import jax
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
+    # must not silently degrade: a failed platform switch means the
+    # caller would run on the accelerator with the wrong device count
+    jax.config.update("jax_platforms", "cpu")
