@@ -122,20 +122,9 @@ class InferenceEngine:
             # per-token cost is host-link-bandwidth-bound — the mode
             # trades latency for model size (serve bf16 models whose
             # weights alone exceed the chip).
-            mcfg = getattr(self.module, "config", None)
-            if mcfg is None or not hasattr(mcfg, "offload_params"):
-                raise ValueError(
-                    "offload_params serving needs a model with "
-                    "parameter-streaming support (deepspeed_tpu.models "
-                    "with scan_layers=True)")
-            if not getattr(mcfg, "scan_layers", False):
-                raise ValueError(
-                    "offload_params serving requires scan_layers=True "
-                    "(the scan step is the fetch granularity)")
-            if not getattr(mcfg, "offload_params", False):
-                import dataclasses
-                self.module = type(self.module)(
-                    dataclasses.replace(mcfg, offload_params=True))
+            from ..utils.streaming import ensure_streaming_module
+            self.module = ensure_streaming_module(
+                self.module, context="offload_params serving")
             if self.params is not None:
                 self.params = self._place_offloaded(self.params)
             self._zero_inference = True

@@ -295,16 +295,10 @@ class DeepSpeedEngine:
                 "offload_param requires offload_optimizer.device: cpu "
                 "(params and optimizer state offload together, like the "
                 "reference's ZeRO-Infinity configuration)")
-        mcfg = getattr(self.module, "config", None)
-        if mcfg is None or not hasattr(mcfg, "offload_params"):
-            raise DeepSpeedConfigError(
-                "offload_param needs a model with parameter-streaming "
-                "support (models from deepspeed_tpu.models with "
-                "scan_layers=True)")
-        if not getattr(mcfg, "offload_params", False):
-            import dataclasses
-            self.module = type(self.module)(
-                dataclasses.replace(mcfg, offload_params=True))
+        from ..utils.streaming import ensure_streaming_module
+        self.module = ensure_streaming_module(
+            self.module, error_cls=DeepSpeedConfigError,
+            context="offload_param")
         self._offload_params = True
         if off.device == "nvme":
             # NVMe tier (reference: partitioned_param_swapper.py:36): the

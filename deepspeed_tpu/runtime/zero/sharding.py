@@ -168,6 +168,22 @@ def make_opt_state_rules(stage: int, mesh):
         for i in free:
             if _divisible(shape, i, shard_axes, mesh):
                 axes[i] = shard_axes if len(shard_axes) > 1 else shard_axes[0]
+                return P(*axes)
+        # No free dim divides — stack the ZeRO axes onto an already-
+        # sharded dim instead (largest first). E.g. a scan-stacked qkv
+        # bias ("layers", "qkv"): the qkv dim carries the TP "model"
+        # axis and the layers dim (n_layers, often < dp) can't take the
+        # partition, so without stacking the grad/opt leaves would stay
+        # replicated over DP — silently losing the stage-2 contract.
+        taken = sorted((i for i, a in enumerate(axes) if a is not None),
+                       key=lambda i: -shape[i])
+        for i in taken:
+            existing = axes[i]
+            prior = (tuple(existing) if isinstance(existing, (tuple, list))
+                     else (existing,))
+            combo = (*prior, *shard_axes)
+            if _divisible(shape, i, combo, mesh):
+                axes[i] = combo
                 break
         return P(*axes)
 

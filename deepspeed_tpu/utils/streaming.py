@@ -51,3 +51,28 @@ def to_host_tree(tree):
     """Place a pytree in host memory space (init-time placement)."""
     return jax.tree.map(
         lambda x: jax.device_put(x, jax.memory.Space.Host), tree)
+
+
+def ensure_streaming_module(module, error_cls=ValueError,
+                            context="offload_params"):
+    """Validate that ``module`` supports parameter streaming and return
+    it with ``config.offload_params=True`` set (rebuilding if needed).
+
+    Shared by the training engine (``offload_param`` block) and the
+    inference engine (ZeRO-Inference serving) so the two validation
+    paths cannot drift. Streaming needs a scan-over-layers model from
+    ``deepspeed_tpu.models``: the scan step is the fetch granularity."""
+    mcfg = getattr(module, "config", None)
+    if mcfg is None or not hasattr(mcfg, "offload_params"):
+        raise error_cls(
+            f"{context} needs a model with parameter-streaming support "
+            "(models from deepspeed_tpu.models with scan_layers=True)")
+    if not getattr(mcfg, "scan_layers", False):
+        raise error_cls(
+            f"{context} requires scan_layers=True "
+            "(the scan step is the fetch granularity)")
+    if not getattr(mcfg, "offload_params", False):
+        import dataclasses
+        module = type(module)(
+            dataclasses.replace(mcfg, offload_params=True))
+    return module
