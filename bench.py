@@ -51,14 +51,14 @@ def _floor_subtract(ms, floor_key, keys):
     exceeded per-rep compute — the failure mode recorded 2026-07-31):
     return (None, True) for that key so derived ratios are nulled
     instead of reporting absurd numbers."""
-    out, clamped = {}, False
+    out, invalid = {}, False
     for k in keys:
         d = ms[k] - ms[floor_key]
         if d <= 0:
-            out[k], clamped = None, True
+            out[k], invalid = None, True
         else:
             out[k] = d
-    return out, clamped
+    return out, invalid
 
 
 def _fetch(tree):
@@ -191,17 +191,19 @@ def bench_1p3b(np, jax, jnp, ds, models):
 
     micro=8 fills HBM (micro=16 OOMs at 1.3B/full-remat; lighter remat
     policies — dots/dots_no_batch — fail to compile at micro=8, measured
-    2026-07-31). gas=32 puts the global batch at 256 seqs (262k tokens —
-    ordinary for 1.3B pretraining) and amortizes the once-per-step host
-    moment streaming to its asymptote. Measured sweep on v5e (2026-07-30
-    .. 31): micro4/gas8 61.5, micro8/gas4 67.1, micro8/gas8 80.1,
-    micro8/gas16 89.6, micro8/gas32 95.0 TFLOPS; micro4/gas32/dots 87.5
-    (recompute savings don't beat the fatter micro)."""
+    2026-07-31). gas=64 puts the global batch at 512 seqs (524k tokens —
+    GPT-3 trained its 1.3B config at 1M-token batches, so ordinary) and
+    amortizes the once-per-step host moment streaming near its
+    asymptote. Measured sweep on v5e (2026-07-30 .. 31): micro4/gas8
+    61.5, micro8/gas4 67.1, micro8/gas8 80.1, micro8/gas16 89.6,
+    micro8/gas32 95.1, micro8/gas64 97.8 TFLOPS; micro4/gas32/dots 87.5
+    (recompute savings don't beat the fatter micro); micro8/gas128
+    crashes the TPU worker (2026-07-31) — do not raise further."""
     return _train_bench(
         "gpt2-1.3b",
         {"zero_optimization": {"stage": 2,
                                "offload_optimizer": {"device": "cpu"}}},
-        micro=8, gas=32, steps=3, np=np, jax=jax, jnp=jnp, ds=ds,
+        micro=8, gas=64, steps=3, np=np, jax=jax, jnp=jnp, ds=ds,
         models=models, param_dtype=jnp.bfloat16)
 
 
@@ -326,7 +328,8 @@ def bench_sparse_kernel(np, jax, jnp, seq=8192, heads=8, d=64, batch=2):
     dispatch+fetch RTT (measured 66-133ms on this tunnel, varying run to
     run) is a small per-rep correction: at REPS=8 the floor subtraction
     once produced a NEGATIVE sparse time (BENCH 2026-07-31), so REPS=32
-    and min-of-5 interleaved trials; the result is clamped non-negative."""
+    and min-of-5 interleaved trials; a still-non-positive subtraction is
+    reported as null with an "invalid" marker, never a fake number."""
     from deepspeed_tpu.ops.sparse_attention import (BSLongformerSparsityConfig,
                                                     sparse_attention)
     from deepspeed_tpu.ops.sparse_attention.block_sparse_kernel import \
@@ -361,16 +364,16 @@ def bench_sparse_kernel(np, jax, jnp, seq=8192, heads=8, d=64, batch=2):
            "dense": make(lambda a, b, c: attention(
                a, b, c, causal=False, seq_parallel="none"))}
     ms = _interleaved_ms(np, fns, (q, k, v), REPS)
-    sub, clamped = _floor_subtract(ms, "floor", ("sparse", "dense"))
+    sub, invalid = _floor_subtract(ms, "floor", ("sparse", "dense"))
     t_sparse, t_dense = sub["sparse"], sub["dense"]
     return {"seq": seq, "layout_density": round(plan.density, 3),
             "sparse_ms": t_sparse and round(t_sparse, 2),
             "dense_ms": t_dense and round(t_dense, 2),
             "harness_floor_ms": round(ms["floor"], 2),
             "speedup": round(t_dense / t_sparse, 2)
-            if not clamped else None,
+            if not invalid else None,
             **({"invalid": "floor exceeded a timed variant (RTT drift); "
-                           "derived metrics nulled"} if clamped else {})}
+                           "derived metrics nulled"} if invalid else {})}
 
 
 def bench_fused_epilogue(np, jax, jnp, d=4096, reps=400):
@@ -412,17 +415,17 @@ def bench_fused_epilogue(np, jax, jnp, d=4096, reps=400):
            "mm": make(lambda x, w, b: jnp.dot(x, w)),
            "full": make(lambda x, w, b: jax.nn.gelu(jnp.dot(x, w) + b))}
     ms = _interleaved_ms(np, fns, (x, w, b), reps)
-    sub, clamped = _floor_subtract(ms, "floor", ("mm", "full"))
+    sub, invalid = _floor_subtract(ms, "floor", ("mm", "full"))
     t_mm, t_full = sub["mm"], sub["full"]
     return {"matmul_ms": t_mm and round(t_mm, 3),
             "matmul_bias_gelu_ms": t_full and round(t_full, 3),
             "matmul_tflops": round(2 * d ** 3 / (t_mm * 1e-3) / 1e12, 1)
-            if not clamped else None,
+            if t_mm is not None else None,
             "harness_floor_ms": round(ms["floor"], 3),
             "epilogue_overhead_pct": round((t_full / t_mm - 1) * 100, 1)
-            if not clamped else None,
+            if t_mm is not None and t_full is not None else None,
             **({"invalid": "floor exceeded a timed variant (RTT drift); "
-                           "derived metrics nulled"} if clamped else {})}
+                           "derived metrics nulled"} if invalid else {})}
 
 
 def _device_watchdog(timeout_s=240):
