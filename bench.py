@@ -216,11 +216,14 @@ def bench_125m(np, jax, jnp, ds, models):
 
 
 def bench_decode(np, jax, jnp, models, preset="gpt2-2.7b", prompt=128,
-                 tokens=64, int8=False):
+                 tokens=64, int8=False, throughput_batch=None):
     """Serving p50: largest GPT-class config fitting one chip in bf16,
     Pallas decode-attention kernel, preallocated KV cache. ``int8=True``
     stores weights int8 (per-channel scales) — the weight-only quantized
-    serving path (reference: *_int8 gemms)."""
+    serving path (reference: *_int8 gemms). ``throughput_batch``
+    additionally measures the batched decode loop (weights stream once
+    per step for the whole batch — the serving-throughput side of the
+    latency/throughput trade)."""
     import dataclasses
     from deepspeed_tpu.inference.generation import (init_cache, _prefill,
                                                     _decode_loop)
@@ -300,14 +303,37 @@ def bench_decode(np, jax, jnp, models, preset="gpt2-2.7b", prompt=128,
     for _ in range(10):
         _ = np.asarray(last_t + 0)
     rtt = (time.time() - t0) * 1e3 / 10
-    return {"model": preset + ("-int8" if int8 else ""),
-            "p50_ms_per_token": round(p50, 2),
-            "p90_ms_per_token": round(p90, 2),
-            "amortized_ms_per_token": round(amort, 2),
-            "tokens_per_sec_batch1": round(1e3 / amort, 1),
-            "client_rtt_ms": round(rtt, 2),
-            "note": "p50/p90 are per-dispatch (include client tunnel RTT); "
-                    "amortized = 64-token on-device loop"}
+    result = {"model": preset + ("-int8" if int8 else ""),
+              "p50_ms_per_token": round(p50, 2),
+              "p90_ms_per_token": round(p90, 2),
+              "amortized_ms_per_token": round(amort, 2),
+              "tokens_per_sec_batch1": round(1e3 / amort, 1),
+              "client_rtt_ms": round(rtt, 2),
+              "note": "p50/p90 are per-dispatch (include client tunnel "
+                      "RTT); amortized = 64-token on-device loop"}
+    if throughput_batch:
+        del cache   # free the batch-1 cache before the batched one lands
+        b = throughput_batch
+        bcache = init_cache(model, params, b, cache_len)
+        bprompt = jnp.asarray(rng.integers(0, mcfg.vocab_size,
+                                           size=(b, prompt)), jnp.int32)
+        blogits, bcache = _prefill(model, params, bcache, bprompt,
+                                   jnp.arange(prompt), transform)
+        blast = jnp.argmax(blogits[:, -1, :], axis=-1)
+        bt, bcache = _decode_loop(model, params, bcache, blast,
+                                  jnp.int32(prompt), 64, 0.0, None, None,
+                                  jax.random.PRNGKey(3), transform)
+        _ = np.asarray(bt[0, -1])   # warm the batched 64-step executable
+        t0 = time.time()
+        bt, bcache = _decode_loop(model, params, bcache, bt[:, -1],
+                                  jnp.int32(prompt + 64), 64, 0.0, None,
+                                  None, jax.random.PRNGKey(4), transform)
+        _ = np.asarray(bt[0, -1])
+        bdt = time.time() - t0
+        result[f"tokens_per_sec_batch{b}"] = round(b * 64 / bdt, 1)
+        result[f"amortized_ms_per_token_batch{b}"] = round(
+            bdt * 1e3 / 64, 2)
+    return result
 
 
 def bench_sparse_kernel(np, jax, jnp, seq=8192, heads=8, d=64, batch=2):
@@ -373,7 +399,8 @@ def bench_sparse_kernel(np, jax, jnp, seq=8192, heads=8, d=64, batch=2):
             "speedup": round(t_dense / t_sparse, 2)
             if not invalid else None,
             **({"invalid": "floor exceeded a timed variant (RTT drift); "
-                           "derived metrics nulled"} if invalid else {})}
+                           "metrics depending on a nulled variant are "
+                           "dropped"} if invalid else {})}
 
 
 def bench_fused_epilogue(np, jax, jnp, d=4096, reps=400):
@@ -425,7 +452,8 @@ def bench_fused_epilogue(np, jax, jnp, d=4096, reps=400):
             "epilogue_overhead_pct": round((t_full / t_mm - 1) * 100, 1)
             if t_mm is not None and t_full is not None else None,
             **({"invalid": "floor exceeded a timed variant (RTT drift); "
-                           "derived metrics nulled"} if invalid else {})}
+                           "metrics depending on a nulled variant are "
+                           "dropped"} if invalid else {})}
 
 
 def _device_watchdog(timeout_s=240):
@@ -481,7 +509,7 @@ def main():
     # BASELINE #5 analog) on ONE 16GB chip — only possible int8 (13.4GB
     # bf16 weights + cache exceed HBM; 6.7GB int8 + bf16 embeddings fit)
     run("decode_int8_6p7b", bench_decode, np, jax, jnp, models,
-        preset="gpt2-6.7b", int8=True)
+        preset="gpt2-6.7b", int8=True, throughput_batch=8)
     # same 6.7B servable WITHOUT quantization: bf16 weights exceed HBM
     # and stream from pinned host memory (ZeRO-Inference)
     run("decode_6p7b_bf16_zero_inference", bench_zero_inference,

@@ -34,6 +34,11 @@ DEFAULT_BLOCK_M = 512
 DEFAULT_BLOCK_N = 512
 DEFAULT_BLOCK_K = 1024
 
+# decode (m=1) GEMV tiles: bigger than the matmul tiles — the VPU path
+# has no MXU residency pressure and wants long HBM bursts
+GEMV_BLOCK_N = 2048
+GEMV_BLOCK_K = 1024
+
 
 def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_kb, out_dtype):
     ki = pl.program_id(2)
@@ -50,6 +55,61 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_kb, out_dtype):
     def _emit():
         o_ref[...] = (acc_ref[...] * s_ref[...].astype(jnp.float32)) \
             .astype(out_dtype)
+
+
+def _gemv_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_kb, out_dtype):
+    """Decode GEMV on the VPU. With m=1 the MXU path is bound by weight
+    ingestion into the systolic array (~146 GB/s measured on v5e,
+    2026-07-31 — the array loads weights at a fixed rate no matter how
+    few rows flow through), not by HBM. Elementwise multiply + sublane
+    reduction reads the same int8 bytes but never touches the MXU.
+    ``x`` arrives as a COLUMN [bk, 1] so the product broadcasts along
+    lanes; an in-kernel [1,bk]->[bk,1] transpose would be a cross-vreg
+    shuffle Mosaic compiles catastrophically (hung the backend when
+    tried)."""
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    xc = x_ref[...].astype(jnp.float32)          # [bk, 1]
+    w = q_ref[...].astype(jnp.float32)           # [bk, bn] int8 -> f32
+    acc_ref[...] += jnp.sum(xc * w, axis=0, keepdims=True)
+
+    @pl.when(ki == n_kb - 1)
+    def _emit():
+        o_ref[...] = (acc_ref[...] * s_ref[...].astype(jnp.float32)) \
+            .astype(out_dtype)
+
+
+def _wo_int8_gemv(x, q, scale, block_n, block_k, out_dtype):
+    """m=1 fast path: grid (n_blocks, k_blocks), k innermost; fp32
+    accumulator row persists across the k walk."""
+    from ._common import pick_block
+    k, n = q.shape
+    block_n = pick_block(n, block_n)
+    block_k = pick_block(k, block_k)
+    if block_n * block_k > 8 * 2 ** 20:
+        # ragged dims forced a >8MB VMEM weight tile (pick_block always
+        # returns a divisor, so e.g. a 50257-vocab head yields the whole
+        # dim) — fall back to the matmul path, which has its own guard
+        return None
+    n_kb = k // block_k
+    grid = (n // block_n, n_kb)
+    return pl.pallas_call(
+        functools.partial(_gemv_kernel, n_kb=n_kb, out_dtype=out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_k, 1), lambda ni, ki: (ki, 0)),
+            pl.BlockSpec((block_k, block_n), lambda ni, ki: (ki, ni)),
+            pl.BlockSpec((1, block_n), lambda ni, ki: (0, ni)),
+        ],
+        out_specs=pl.BlockSpec((1, block_n), lambda ni, ki: (0, ni)),
+        out_shape=jax.ShapeDtypeStruct((1, n), out_dtype),
+        scratch_shapes=[pltpu.VMEM((1, block_n), jnp.float32)],
+        interpret=_interpret(),
+    )(x.reshape(k, 1), q, scale.reshape(1, n))
 
 
 def _wo_int8_2d(x, q, scale, block_m, block_n, block_k, out_dtype):
@@ -93,9 +153,8 @@ def _wo_int8_2d(x, q, scale, block_m, block_n, block_k, out_dtype):
     return out[:m] if pad_m else out
 
 
-def wo_int8_matmul(x, q, scale, *, block_m=DEFAULT_BLOCK_M,
-                   block_n=DEFAULT_BLOCK_N, block_k=DEFAULT_BLOCK_K,
-                   out_dtype=None):
+def wo_int8_matmul(x, q, scale, *, block_m=None, block_n=None,
+                   block_k=None, out_dtype=None):
     """``x @ (q * scale)`` with int8 ``q`` dequantized in-kernel.
 
     x: [..., k] activations (bf16/f32); q: [k, n] int8; scale: per-output
@@ -104,10 +163,14 @@ def wo_int8_matmul(x, q, scale, *, block_m=DEFAULT_BLOCK_M,
     Any m is supported (decode m=1 through long-prompt prefill — the m
     dim is tiled at ``block_m`` with zero-padded ragged tails).
 
-    Shapes the kernel cannot tile (n or k not divisible by the block
-    size) fall back to the jnp dequant matmul — numerically identical,
-    but subject to XLA's loop hoisting; serving-size models are always
-    128-aligned in practice.
+    ``block_*``: VMEM tile budget knobs. Defaults differ per path
+    (decode GEMV wants longer tiles than the MXU matmul), so None means
+    "the path's default"; an explicit value is honored on both paths.
+
+    Shapes the kernel cannot tile (ragged dims forcing an oversized
+    VMEM tile) fall back to the jnp dequant matmul — numerically
+    identical, but subject to XLA's loop hoisting; serving-size models
+    are always 128-aligned in practice.
     """
     out_dtype = out_dtype or x.dtype
     k, n = q.shape
@@ -118,7 +181,14 @@ def wo_int8_matmul(x, q, scale, *, block_m=DEFAULT_BLOCK_M,
         scale = jnp.broadcast_to(scale, (n,))
     if scale.size != n:
         raise ValueError(f"scale has {scale.size} elements for n={n}")
-    out = _wo_int8_2d(x2, q, scale, block_m, block_n, block_k, out_dtype)
+    out = None
+    if x2.shape[0] == 1:
+        out = _wo_int8_gemv(x2, q, scale, block_n or GEMV_BLOCK_N,
+                            block_k or GEMV_BLOCK_K, out_dtype)
+    if out is None:
+        out = _wo_int8_2d(x2, q, scale, block_m or DEFAULT_BLOCK_M,
+                          block_n or DEFAULT_BLOCK_N,
+                          block_k or DEFAULT_BLOCK_K, out_dtype)
     if out is None:
         w = (q.astype(jnp.float32) * scale[None, :]).astype(x.dtype)
         out = jnp.dot(x2, w, preferred_element_type=jnp.float32) \
