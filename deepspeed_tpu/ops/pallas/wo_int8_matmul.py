@@ -40,6 +40,20 @@ GEMV_BLOCK_N = 2048
 GEMV_BLOCK_K = 1024
 
 
+def _gemv_enabled() -> bool:
+    """The m=1 VPU GEMV is numerically proven (interpret-mode parity
+    across the shape matrix) but its Mosaic lowering has not yet been
+    timed on a real chip — the axon tunnel died before the perf run
+    (2026-07-31). Opt in with DS_TPU_INT8_GEMV=1; the default stays the
+    measured MXU path so the benchmark artifact can't regress on an
+    unvalidated codepath. Flip the default once hardware numbers exist
+    (analysis says ~5x: MXU weight ingestion caps m=1 at ~146 GB/s vs
+    ~820 GB/s HBM)."""
+    import os
+    val = os.environ.get("DS_TPU_INT8_GEMV", "0").strip().lower()
+    return val not in ("0", "", "false", "no", "off")
+
+
 def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_kb, out_dtype):
     ki = pl.program_id(2)
 
@@ -182,7 +196,7 @@ def wo_int8_matmul(x, q, scale, *, block_m=None, block_n=None,
     if scale.size != n:
         raise ValueError(f"scale has {scale.size} elements for n={n}")
     out = None
-    if x2.shape[0] == 1:
+    if x2.shape[0] == 1 and _gemv_enabled():
         out = _wo_int8_gemv(x2, q, scale, block_n or GEMV_BLOCK_N,
                             block_k or GEMV_BLOCK_K, out_dtype)
     if out is None:

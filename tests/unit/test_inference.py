@@ -124,6 +124,25 @@ class TestGeneration:
         assert a.shape == (2, 10)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    def test_batched_decode_matches_batch1_rows(self):
+        """Batched greedy decode (the serving-throughput mode benched
+        by bench_decode's throughput_batch loop) must produce per-row
+        exactly what each prompt yields alone — the KV cache and decode
+        scan carry no cross-row state."""
+        cfg = GPTConfig(vocab_size=97, max_seq_len=64, d_model=32,
+                        n_layers=2, n_heads=2, dtype=jnp.float32)
+        m = GPT(cfg)
+        rng = jax.random.PRNGKey(3)
+        ids = jax.random.randint(rng, (3, 10), 0, 97)
+        params = m.init(rng, ids)["params"]
+        batched = generate(m, params, ids, max_new_tokens=6,
+                           temperature=0.0)
+        for i in range(3):
+            solo = generate(m, params, ids[i:i + 1], max_new_tokens=6,
+                            temperature=0.0)
+            np.testing.assert_array_equal(np.asarray(batched[i]),
+                                          np.asarray(solo[0]))
+
     def test_eos_fill(self):
         cfg = GPTConfig(vocab_size=17, max_seq_len=32, d_model=16,
                         n_layers=1, n_heads=2, dtype=jnp.float32)

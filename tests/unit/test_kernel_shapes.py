@@ -79,9 +79,13 @@ def test_flash_grad_nonsquare_head():
                                    # returns the whole dim, the VMEM
                                    # guard must route to the fallback
                                    (1, 256, 50257)])
-def test_wo_int8_shape_matrix(m, k, n):
+def test_wo_int8_shape_matrix(m, k, n, monkeypatch):
     from deepspeed_tpu.ops.pallas.wo_int8_matmul import wo_int8_matmul
     from deepspeed_tpu.module_inject.module_quantize import _quantize_array
+    if m == 1:
+        # exercise the opt-in VPU GEMV path (perf-gated off by default
+        # until timed on hardware; numerics must hold regardless)
+        monkeypatch.setenv("DS_TPU_INT8_GEMV", "1")
     key = jax.random.PRNGKey(1)
     x = jax.random.normal(key, (m, k), jnp.float32)
     w = jax.random.normal(jax.random.fold_in(key, 1), (k, n), jnp.float32)
