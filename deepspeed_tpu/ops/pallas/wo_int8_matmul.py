@@ -49,9 +49,8 @@ def _gemv_enabled() -> bool:
     unvalidated codepath. Flip the default once hardware numbers exist
     (analysis says ~5x: MXU weight ingestion caps m=1 at ~146 GB/s vs
     ~820 GB/s HBM)."""
-    import os
-    val = os.environ.get("DS_TPU_INT8_GEMV", "0").strip().lower()
-    return val not in ("0", "", "false", "no", "off")
+    from ...utils import env_flag
+    return env_flag("DS_TPU_INT8_GEMV")
 
 
 def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_kb, out_dtype):

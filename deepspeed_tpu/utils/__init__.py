@@ -3,6 +3,14 @@ from .timer import SynchronizedWallClockTimer, ThroughputTimer
 from .init_on_device import OnDevice
 
 
+def env_flag(name: str, default: str = "0") -> bool:
+    """Boolean env-var parsing shared across the package: '0', '',
+    'false', 'no' and 'off' (any case) are false, everything else true."""
+    import os
+    return os.environ.get(name, default).strip().lower() not in (
+        "0", "", "false", "no", "off")
+
+
 def instrument_w_nvtx(func):
     """Reference: deepspeed/utils/nvtx.py — wrap hot functions in NVTX
     ranges. TPU analog: jax.named_scope annotations land in the XLA

@@ -14,6 +14,22 @@ import deepspeed_tpu
 
 
 def main():
+    from deepspeed_tpu.utils import env_flag
+    smoke = env_flag("DS_TPU_EXAMPLE_SMOKE")
+    if smoke:
+        # CI smoke (offline): a tiny random-init HF GPT-2 — exercises the
+        # same injection + generate path without downloading weights
+        from transformers import GPT2Config, GPT2LMHeadModel
+        hf = GPT2LMHeadModel(GPT2Config(
+            vocab_size=128, n_positions=64, n_embd=32, n_layer=2, n_head=2))
+        engine = deepspeed_tpu.init_inference(
+            hf, mp_size=1, dtype=jnp.float32,
+            replace_with_kernel_inject=True, max_tokens=32)
+        ids = np.arange(8, dtype=np.int64)[None, :] % 128
+        out = engine.generate(ids, max_new_tokens=8, temperature=0.0)
+        print("smoke generated ids:", np.asarray(out)[0].tolist())
+        return
+
     name = sys.argv[1] if len(sys.argv) > 1 else "gpt2"
     from transformers import AutoModelForCausalLM, AutoTokenizer
     tok = AutoTokenizer.from_pretrained(name)

@@ -27,8 +27,20 @@ def synthetic_batches(vocab, global_batch, steps, seed=0):
 
 def main():
     import dataclasses
-    mcfg = dataclasses.replace(GPT2_PRESETS["gpt2-125m"],
-                               dtype=jnp.bfloat16, remat="full")
+    global SEQ, STEPS
+    from deepspeed_tpu.utils import env_flag
+    smoke = env_flag("DS_TPU_EXAMPLE_SMOKE")
+    if smoke:
+        # CI smoke: tiny model + 2 steps on whatever backend is present
+        # (tests/unit/test_examples.py runs this on the CPU mesh)
+        from deepspeed_tpu.models import GPTConfig
+        SEQ, STEPS = 64, 2
+        mcfg = GPTConfig(vocab_size=512, max_seq_len=SEQ, d_model=64,
+                         n_layers=2, n_heads=4, dtype=jnp.float32,
+                         scan_layers=True, remat="full")
+    else:
+        mcfg = dataclasses.replace(GPT2_PRESETS["gpt2-125m"],
+                                   dtype=jnp.bfloat16, remat="full")
 
     def loss_fn(model, params, batch, rng, train):
         ids = batch["input_ids"]
@@ -37,14 +49,15 @@ def main():
         return gpt_chunked_loss_fn(h[:, :-1], wte, ids[:, 1:], chunk=128)
 
     n_chips = len(jax.devices())
+    micro = 2 if smoke else 32
     config = {
-        "train_batch_size": 32 * n_chips,
-        "train_micro_batch_size_per_gpu": 32,
+        "train_batch_size": micro * n_chips,
+        "train_micro_batch_size_per_gpu": micro,
         "optimizer": {"type": "AdamW",
                       "params": {"lr": 6e-4, "weight_decay": 0.1}},
         "scheduler": {"type": "WarmupLR",
                       "params": {"warmup_num_steps": 100}},
-        "bf16": {"enabled": True},
+        "bf16": {"enabled": not smoke},
         "zero_optimization": {"stage": 3},
         "gradient_clipping": 1.0,
         "steps_per_print": 5,
@@ -57,7 +70,8 @@ def main():
     for step, batch in enumerate(synthetic_batches(
             mcfg.vocab_size, config["train_batch_size"], STEPS)):
         loss = engine.train_batch(batch)
-    engine.save_checkpoint("/tmp/gpt2_zero3_ckpt")
+    engine.save_checkpoint(os.environ.get("DS_TPU_EXAMPLE_CKPT_DIR",
+                                          "/tmp/gpt2_zero3_ckpt"))
     print(f"final loss {float(loss):.4f} after {STEPS} steps")
 
 

@@ -26,12 +26,24 @@ SP = 4          # sequence-parallel degree
 
 
 def main():
+    global SEQ, SP
+    from deepspeed_tpu.utils import env_flag
+    smoke = env_flag("DS_TPU_EXAMPLE_SMOKE")
+    if smoke:
+        SEQ, SP = 256, 2
     mesh = build_mesh(MeshSpec(data=-1, seq=SP))
     cfg = GPTConfig(vocab_size=32000, max_seq_len=SEQ, d_model=512,
                     n_layers=8, n_heads=8, dtype=jnp.bfloat16,
                     rotary=True, learned_pos=False,
                     seq_parallel="ring",      # or "ulysses"
                     remat="dots")
+    if smoke:
+        # same attention path, tiny dims (one config so the smoke run
+        # can't silently diverge from the documented example)
+        import dataclasses
+        cfg = dataclasses.replace(cfg, vocab_size=512, d_model=64,
+                                  n_layers=2, n_heads=4,
+                                  dtype=jnp.float32, max_seq_len=SEQ)
 
     def loss_fn(model, params, batch, rng, train):
         ids = batch["input_ids"]
@@ -43,7 +55,7 @@ def main():
         "train_batch_size": 2 * dp,
         "train_micro_batch_size_per_gpu": 2,
         "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
-        "bf16": {"enabled": True},
+        "bf16": {"enabled": not smoke},
         "zero_optimization": {"stage": 2},
         "steps_per_print": 2,
     }
@@ -53,7 +65,7 @@ def main():
         sample_batch={"input_ids": np.zeros((1, SEQ), np.int32)},
         rng=jax.random.PRNGKey(0), mesh=mesh)
 
-    for step in range(5):
+    for step in range(2 if smoke else 5):
         batch = {"input_ids": rng.integers(
             0, cfg.vocab_size, size=(config["train_batch_size"], SEQ),
             dtype=np.int32)}
