@@ -44,6 +44,15 @@ def main():
     mcfg = dataclasses.replace(GPT2_PRESETS["gpt2-125m"],
                                dtype=jnp.bfloat16, max_seq_len=SEQ,
                                remat="full")
+    from deepspeed_tpu.utils import env_flag
+    if env_flag("DS_TPU_EXAMPLE_SMOKE"):
+        # CI smoke (tests/unit/test_examples.py): tiny model, same path
+        from deepspeed_tpu.models import GPTConfig
+        mcfg = GPTConfig(vocab_size=512, max_seq_len=SEQ, d_model=64,
+                         n_layers=2, n_heads=4, dtype=jnp.float32,
+                         scan_layers=True, remat="full")
+        cfg["train_batch_size"] = 2 * n_chips
+        cfg["train_micro_batch_size_per_gpu"] = 2
 
     def loss_fn(model, params, batch, rng, train):
         ids = batch["input_ids"]
