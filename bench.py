@@ -280,6 +280,17 @@ def bench_decode(np, jax, jnp, models, preset="gpt2-2.7b", prompt=128,
     p50 = lat[len(lat) // 2]
     p90 = lat[int(len(lat) * 0.9)]
 
+    # per-call p50 on this rig includes the client<->TPU tunnel RTT (one
+    # host dispatch per token); quantify it so the artifact separates
+    # framework latency from environment latency. The probe must dispatch
+    # a fresh device op and fetch its result — asarray of an
+    # already-fetched array is a host-cache hit and reads ~0.
+    _ = np.asarray(last_t + 0)   # compile the probe op outside the window
+    t0 = time.time()
+    for _ in range(10):
+        _ = np.asarray(last_t + 0)
+    rtt = (time.time() - t0) * 1e3 / 10
+
     # amortized: one scan over 64 tokens on-device (no per-token dispatch).
     # num_steps is a jit-static arg: warm the 64-step executable first so
     # the timed window excludes its compile.
@@ -293,47 +304,112 @@ def bench_decode(np, jax, jnp, models, preset="gpt2-2.7b", prompt=128,
                                jax.random.PRNGKey(2), transform)
     _ = np.asarray(toks[0, -1])
     amort = (time.time() - t0) * 1e3 / 64
-    # per-call p50 on this rig includes the client<->TPU tunnel RTT (one
-    # host dispatch per token); quantify it so the artifact separates
-    # framework latency from environment latency. The probe must dispatch
-    # a fresh device op and fetch its result — asarray of an
-    # already-fetched array is a host-cache hit and reads ~0.
-    _ = np.asarray(last_t + 0)   # compile the probe op outside the window
-    t0 = time.time()
-    for _ in range(10):
-        _ = np.asarray(last_t + 0)
-    rtt = (time.time() - t0) * 1e3 / 10
+
+    # SERVER-SIDE per-token latency (the north-star metric as a real
+    # deployment would see it, where dispatch is local and sub-ms): the
+    # per-dispatch lat[] above is dominated by tunnel RTT, which varies
+    # 66-133ms run to run, so subtracting a point RTT estimate per call
+    # would be noise, not measurement. Instead time K single-dispatch
+    # CH-token device loops: each sample pays ONE RTT for CH tokens, so
+    # per-token = (wall - rtt)/CH attenuates the tunnel's jitter CH-fold.
+    # Sanity anchor: the p50 over samples should sit near the 64-token
+    # amortized figure.
+    pos2 = pos + tokens + 65
+    try:
+        p50_server, p90_server = _server_side_percentiles(
+            np, lambda start, nsteps, key: _fetch_last(
+                np, _decode_loop(model, params, cache, toks[:, -1],
+                                 start, nsteps, 0.0, None, None, key,
+                                 transform)),
+            jax, pos2, rtt)
+    except Exception as e:   # keep the batch-1 metrics measured above
+        p50_server = p90_server = None
+        server_err = f"{type(e).__name__}: {e}"
+    else:
+        server_err = None
     result = {"model": preset + ("-int8" if int8 else ""),
               "p50_ms_per_token": round(p50, 2),
               "p90_ms_per_token": round(p90, 2),
+              "p50_server_ms": p50_server,
+              "p90_server_ms": p90_server,
               "amortized_ms_per_token": round(amort, 2),
               "tokens_per_sec_batch1": round(1e3 / amort, 1),
               "client_rtt_ms": round(rtt, 2),
-              "note": "p50/p90 are per-dispatch (include client tunnel "
-                      "RTT); amortized = 64-token on-device loop"}
+              "note": "p50/p90_ms_per_token are per-dispatch (include "
+                      "client tunnel RTT); p50/p90_server_ms are the "
+                      "device-loop per-token times (RTT amortized over "
+                      "8-token chunks) — the deployment-facing number; "
+                      "amortized = 64-token on-device loop"}
+    if server_err:
+        result["server_percentiles_error"] = server_err
     if throughput_batch:
-        del cache   # free the batch-1 cache before the batched one lands
-        b = throughput_batch
-        bcache = init_cache(model, params, b, cache_len)
-        bprompt = jnp.asarray(rng.integers(0, mcfg.vocab_size,
-                                           size=(b, prompt)), jnp.int32)
-        blogits, bcache = _prefill(model, params, bcache, bprompt,
-                                   jnp.arange(prompt), transform)
-        blast = jnp.argmax(blogits[:, -1, :], axis=-1)
-        bt, bcache = _decode_loop(model, params, bcache, blast,
-                                  jnp.int32(prompt), 64, 0.0, None, None,
-                                  jax.random.PRNGKey(3), transform)
-        _ = np.asarray(bt[0, -1])   # warm the batched 64-step executable
-        t0 = time.time()
-        bt, bcache = _decode_loop(model, params, bcache, bt[:, -1],
-                                  jnp.int32(prompt + 64), 64, 0.0, None,
-                                  None, jax.random.PRNGKey(4), transform)
-        _ = np.asarray(bt[0, -1])
-        bdt = time.time() - t0
-        result[f"tokens_per_sec_batch{b}"] = round(b * 64 / bdt, 1)
-        result[f"amortized_ms_per_token_batch{b}"] = round(
-            bdt * 1e3 / 64, 2)
+        # isolated: an OOM probing the batched cache/prefill must not
+        # destroy the already-measured batch-1 metrics above.
+        try:
+            del cache   # free batch-1 cache before the batched one lands
+            b = throughput_batch
+            bcache = init_cache(model, params, b, cache_len)
+            bprompt = jnp.asarray(rng.integers(0, mcfg.vocab_size,
+                                               size=(b, prompt)), jnp.int32)
+            blogits, bcache = _prefill(model, params, bcache, bprompt,
+                                       jnp.arange(prompt), transform)
+            blast = jnp.argmax(blogits[:, -1, :], axis=-1)
+            bt, bcache = _decode_loop(model, params, bcache, blast,
+                                      jnp.int32(prompt), 64, 0.0, None,
+                                      None, jax.random.PRNGKey(3),
+                                      transform)
+            _ = np.asarray(bt[0, -1])   # warm the batched 64-step exec
+            t0 = time.time()
+            bt, bcache = _decode_loop(model, params, bcache, bt[:, -1],
+                                      jnp.int32(prompt + 64), 64, 0.0,
+                                      None, None, jax.random.PRNGKey(4),
+                                      transform)
+            _ = np.asarray(bt[0, -1])
+            bdt = time.time() - t0
+            result[f"tokens_per_sec_batch{b}"] = round(b * 64 / bdt, 1)
+            result[f"amortized_ms_per_token_batch{b}"] = round(
+                bdt * 1e3 / 64, 2)
+        except Exception as e:
+            result[f"batch{throughput_batch}_error"] = \
+                f"{type(e).__name__}: {e}"
     return result
+
+
+def _fetch_last(np, decode_out):
+    """Block on a _decode_loop result via a scalar fetch (dependency-chain
+    forcing, see _fetch)."""
+    toks, _cache = decode_out
+    return np.asarray(toks[0, -1])
+
+
+def _server_side_percentiles(np, run_chunk, jax, start_pos, rtt_ms,
+                             chunk=8, samples=12):
+    """p50/p90 of per-token device-loop latency: `samples` single-dispatch
+    `chunk`-token loops, each sample = (wall_ms - rtt_ms) / chunk. A
+    non-positive median means the tunnel jitter exceeded the signal — emit
+    (None, None) rather than a fake number (same contract as
+    _floor_subtract)."""
+    import time as _time
+    # warm the chunk-step executable outside the timed window
+    _ = run_chunk(jax.numpy.int32(start_pos), chunk, jax.random.PRNGKey(9))
+    wall_ms = []
+    for j in range(samples):
+        key = jax.random.PRNGKey(100 + j)
+        t0 = _time.time()
+        _ = run_chunk(jax.numpy.int32(start_pos), chunk, key)
+        wall_ms.append((_time.time() - t0) * 1e3)
+    return _per_token_percentiles(wall_ms, rtt_ms, chunk)
+
+
+def _per_token_percentiles(wall_ms_samples, rtt_ms, chunk):
+    """Pure percentile math for _server_side_percentiles, split out so the
+    sub-floor nulling contract is unit-testable with synthetic timings."""
+    per_tok = sorted((w - rtt_ms) / chunk for w in wall_ms_samples)
+    p50 = per_tok[len(per_tok) // 2]
+    p90 = per_tok[int(len(per_tok) * 0.9)]
+    if p50 <= 0:
+        return None, None
+    return round(p50, 2), round(p90, 2)   # p90 >= p50 > 0 (sorted)
 
 
 def bench_sparse_kernel(np, jax, jnp, seq=8192, heads=8, d=64, batch=2):
@@ -456,27 +532,82 @@ def bench_fused_epilogue(np, jax, jnp, d=4096, reps=400):
                            "dropped"} if invalid else {})}
 
 
-def _device_watchdog(timeout_s=240):
-    """Fail fast (with an honest artifact) instead of hanging forever when
-    the tunneled TPU backend is unreachable — jax backend init blocks
-    indefinitely in that state on this rig."""
+def _device_watchdog(probe_timeout_s=None, interval_s=None, window_s=None):
+    """Probe-and-retry across a long window instead of failing on one
+    probe: the tunneled TPU backend on this rig flaps for minutes at a
+    time, and a single-shot probe nulled two consecutive round artifacts
+    while the chip was healthy an hour earlier.
+
+    Each probe runs `jax.devices()` in a SUBPROCESS: a hung backend init
+    is contained (the child is killed on timeout and releases any device
+    lock on exit), whereas an in-process hang wedges jax's backend
+    singleton for the life of the harness. Only after a subprocess probe
+    succeeds do we initialize in-process — threaded, so a flap between
+    the probe and the init still can't hang past the window. If the
+    window closes with no successful init, emit the honest null artifact
+    with the attempt count."""
+    import os
+    import subprocess
     import threading
+    import time as _time
+
+    probe_timeout_s = probe_timeout_s or int(
+        os.environ.get("DS_TPU_BENCH_PROBE_TIMEOUT_S", "120"))
+    interval_s = interval_s or int(
+        os.environ.get("DS_TPU_BENCH_PROBE_INTERVAL_S", "60"))
+    window_s = window_s or int(
+        os.environ.get("DS_TPU_BENCH_PROBE_WINDOW_S", "1800"))
+
+    deadline = _time.monotonic() + window_s
+    attempt = 0
+    init_hangs = 0
     ok = []
 
-    def probe():
+    def _init():
         import jax
         ok.append(len(jax.devices()))
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if not ok:
-        print(json.dumps({
-            "metric": "gpt2_1p3b_zero_offload_train_tokens_per_sec_per_chip",
-            "value": None, "unit": "tokens/s/chip", "vs_baseline": None,
-            "error": f"accelerator backend unreachable after {timeout_s}s "
-                     "(tunnel down?) — no measurements taken"}))
-        raise SystemExit(0)
+    while True:
+        attempt += 1
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=probe_timeout_s, capture_output=True)
+            up = r.returncode == 0
+        except subprocess.TimeoutExpired:
+            up = False
+        if up:
+            # bounded per-attempt join: a flap between the probe and the
+            # in-process init must cost one interval, not the whole
+            # window. jax's backend-init singleton means a later attempt
+            # just re-joins the same pending init — and succeeds as soon
+            # as the tunnel answers.
+            t = threading.Thread(target=_init, daemon=True)
+            t.start()
+            t.join(min(probe_timeout_s,
+                       max(deadline - _time.monotonic(), 1)))
+            if ok:
+                return
+            init_hangs += 1
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            detail = (f"{attempt} probes, {interval_s}s apart"
+                      + (f"; {init_hangs} probe(s) succeeded but "
+                         "in-process backend init then hung (flap "
+                         "between probe and init)" if init_hangs else
+                         "; tunnel down?"))
+            print(json.dumps({
+                "metric":
+                    "gpt2_1p3b_zero_offload_train_tokens_per_sec_per_chip",
+                "value": None, "unit": "tokens/s/chip", "vs_baseline": None,
+                "error": "accelerator backend unreachable for the whole "
+                         f"{window_s}s probe window ({detail}) — no "
+                         "measurements taken"}))
+            raise SystemExit(0)
+        print(f"# probe {attempt}: backend unreachable; retrying in "
+              f"{interval_s}s ({int(remaining)}s left in window)",
+              file=sys.stderr, flush=True)
+        _time.sleep(min(interval_s, max(remaining, 0)))
 
 
 def main():

@@ -1,0 +1,95 @@
+"""Regression tests for bench.py's timing-validity contracts.
+
+Why these exist: the 2026-07-31 gas32 artifact published physically
+impossible microbench values (sparse_ms -0.91, epilogue_overhead_pct
+-33.7) when tunnel-RTT drift exceeded per-rep compute. The harness
+contract since then: any measurement at or below its own harness floor is
+emitted as null with a reason, never as a number. These tests feed the
+pure helpers synthetic noisy timings so that contract can't regress.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import bench
+
+
+class TestFloorSubtract:
+    def test_clean_measurement_passes_through(self):
+        ms = {"floor": 1.0, "sparse": 5.2, "dense": 12.4}
+        sub, invalid = bench._floor_subtract(ms, "floor",
+                                             ("sparse", "dense"))
+        assert not invalid
+        assert abs(sub["sparse"] - 4.2) < 1e-9
+        assert abs(sub["dense"] - 11.4) < 1e-9
+
+    def test_sub_floor_reading_is_nulled_not_negative(self):
+        # the gas32 failure mode: floor (7.24) above the signal (6.33)
+        ms = {"floor": 7.24, "sparse": 6.33, "dense": 13.1}
+        sub, invalid = bench._floor_subtract(ms, "floor",
+                                             ("sparse", "dense"))
+        assert invalid
+        assert sub["sparse"] is None          # NOT -0.91
+        assert sub["dense"] is not None       # unaffected key survives
+
+    def test_exactly_at_floor_is_nulled(self):
+        ms = {"floor": 2.0, "x": 2.0}
+        sub, invalid = bench._floor_subtract(ms, "floor", ("x",))
+        assert invalid and sub["x"] is None
+
+
+class TestServerSidePercentiles:
+    def test_normal_samples(self):
+        # 8-token chunks, ~200ms wall, 60ms RTT -> ~17.5ms/token
+        wall = [199.0, 201.0, 200.0, 198.5, 202.0, 200.5,
+                199.5, 200.2, 201.5, 198.9, 200.8, 199.2]
+        p50, p90 = bench._per_token_percentiles(wall, 60.0, 8)
+        assert p50 is not None and 17.0 < p50 < 18.0
+        assert p90 is not None and p90 >= p50
+
+    def test_rtt_exceeding_wall_is_nulled(self):
+        # tunnel jitter swamps the signal: median subtraction goes <= 0
+        wall = [50.0, 55.0, 48.0, 52.0, 49.0, 51.0]
+        p50, p90 = bench._per_token_percentiles(wall, 60.0, 8)
+        assert p50 is None and p90 is None
+
+    def test_partial_noise_keeps_valid_median(self):
+        # a couple of flapped samples below RTT must not corrupt p50
+        wall = [30.0, 199.0, 201.0, 200.0, 40.0, 200.5,
+                199.5, 200.2, 201.5, 198.9, 200.8, 199.2]
+        p50, _p90 = bench._per_token_percentiles(wall, 60.0, 8)
+        assert p50 is not None and p50 > 0
+
+
+class TestWatchdogEnvKnobs:
+    def test_window_env_is_read(self, monkeypatch):
+        # the watchdog must honor the env knobs tpu_watch.sh relies on;
+        # with a zero-length window and the probe stubbed to fail it must
+        # emit the honest-null artifact and SystemExit(0) immediately.
+        import json
+        import subprocess
+
+        monkeypatch.setenv("DS_TPU_BENCH_PROBE_WINDOW_S", "1")
+        monkeypatch.setenv("DS_TPU_BENCH_PROBE_INTERVAL_S", "1")
+        monkeypatch.setenv("DS_TPU_BENCH_PROBE_TIMEOUT_S", "1")
+
+        def fail_run(*a, **kw):
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
+
+        monkeypatch.setattr(subprocess, "run", fail_run)
+        printed = []
+        monkeypatch.setattr("builtins.print",
+                            lambda *a, **kw: printed.append(a))
+        try:
+            bench._device_watchdog()
+            raised = False
+        except SystemExit as e:
+            raised = e.code == 0
+        assert raised
+        arts = [a[0] for a in printed if a and isinstance(a[0], str)
+                and a[0].startswith("{")]
+        art = json.loads(arts[-1])
+        assert art["value"] is None
+        assert "unreachable" in art["error"]

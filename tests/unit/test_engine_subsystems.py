@@ -544,6 +544,25 @@ class TestNoInvoluntaryRemat:
         assert orule(P(None, "model", "fsdp"), (2, 64, 64),
                      (None, "mlp", "embed")) == P("data", "model", "fsdp")
 
+    def test_stacked_axes_when_no_free_dim_divides(self):
+        """The 4e4623a contract: a scan-stacked qkv bias ("layers", "qkv")
+        whose layers dim doesn't divide the DP degree must STACK the ZeRO
+        partition onto the already-TP-sharded qkv dim — not silently stay
+        DP-replicated (which would drop the stage-2 sharding guarantee for
+        its grad-accum/opt-state leaves)."""
+        from jax.sharding import PartitionSpec as P
+        from deepspeed_tpu.comm.mesh import build_mesh, MeshSpec
+        from deepspeed_tpu.runtime.zero.sharding import make_opt_state_rules
+        mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2))
+        orule = make_opt_state_rules(2, mesh)
+        # layers=5 not divisible by data=2; qkv dim 384 % (model*data)=0
+        spec = orule(P(None, "model"), (5, 384), ("layers", "qkv"))
+        assert spec == P(None, ("model", "data")), spec
+        # and when even stacking can't divide, the param spec is kept
+        # unchanged rather than producing an invalid partition
+        spec = orule(P(None, "model"), (5, 6), ("layers", "qkv"))
+        assert spec == P(None, "model"), spec
+
     def test_zero3_step_compiles_without_involuntary_remat(self):
         """Compile the data2 x fsdp2 x tp2 zero-3 train step in a
         subprocess and grep its stderr: the SPMD partitioner logs
