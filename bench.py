@@ -479,6 +479,59 @@ def bench_sparse_kernel(np, jax, jnp, seq=8192, heads=8, d=64, batch=2):
                            "dropped"} if invalid else {})}
 
 
+def bench_flash_dropout(np, jax, jnp, batch=2, seq=2048, heads=16, d=64,
+                        reps=8):
+    """Fused attention dropout (r5): flash kernel with in-kernel
+    counter-based keep sampling vs the dense O(s^2) softmax+dropout chain
+    it previously fell back to (the r4 tax on every real training config
+    with attention dropout > 0). Also reports the fused kernel's dropout
+    overhead vs plain flash — the VPU hash rides under the MXU matmuls."""
+    from deepspeed_tpu.ops.pallas import flash_attention
+    from deepspeed_tpu.ops.transformer.attention import _reference_attention
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(
+        rng.standard_normal((batch, seq, heads, d)), jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    key = jax.random.PRNGKey(3)
+
+    def make(f):
+        @jax.jit
+        def g(q, k, v):
+            tot = jnp.float32(0)
+            for i in range(reps):
+                o = f(q + jnp.asarray(i, q.dtype) * 1e-6, k, v)
+                tot = tot + o.reshape(-1)[0].astype(jnp.float32)
+            return tot
+        _ = np.asarray(g(q, k, v))   # warm (compile)
+        return g
+
+    fns = {"floor": make(lambda a, b, c: a[:1, :1, :1, :1]),
+           "flash_dropout": make(lambda a, b, c: flash_attention(
+               a, b, c, causal=True, dropout_rate=0.1, dropout_rng=key)),
+           "flash_plain": make(lambda a, b, c: flash_attention(
+               a, b, c, causal=True)),
+           "dense_dropout": make(lambda a, b, c: _reference_attention(
+               a, b, c, causal=True, dropout_rate=0.1, dropout_rng=key,
+               deterministic=False))}
+    ms = _interleaved_ms(np, fns, (q, k, v), reps)
+    sub, invalid = _floor_subtract(
+        ms, "floor", ("flash_dropout", "flash_plain", "dense_dropout"))
+    fd, fp, dd = (sub[k] for k in ("flash_dropout", "flash_plain",
+                                   "dense_dropout"))
+    return {"seq": seq,
+            "flash_dropout_ms": fd and round(fd, 3),
+            "flash_plain_ms": fp and round(fp, 3),
+            "dense_dropout_ms": dd and round(dd, 3),
+            "harness_floor_ms": round(ms["floor"], 3),
+            "speedup_vs_dense": round(dd / fd, 2)
+            if not invalid and fd and dd else None,
+            "dropout_overhead_pct": round((fd / fp - 1) * 100, 1)
+            if not invalid and fd and fp else None,
+            **({"invalid": "floor exceeded a timed variant (RTT drift); "
+                           "metrics depending on a nulled variant are "
+                           "dropped"} if invalid else {})}
+
+
 def bench_fused_epilogue(np, jax, jnp, d=4096, reps=400):
     """Substantiates the design claim that XLA fuses the bias+GELU
     epilogue into the matmul (why there is no hand-written gelu kernel;
@@ -633,6 +686,7 @@ def main():
     # 5.2ms / 12.4ms (2.4x) on a fresh backend — training-engine
     # allocator residue distorts kernel-scale timings, so order matters.
     run("sparse_attention_8k", bench_sparse_kernel, np, jax, jnp)
+    run("flash_dropout_2k", bench_flash_dropout, np, jax, jnp)
     run("fused_epilogue", bench_fused_epilogue, np, jax, jnp)
     run("decode", bench_decode, np, jax, jnp, models)
     run("decode_int8", bench_decode, np, jax, jnp, models, int8=True)

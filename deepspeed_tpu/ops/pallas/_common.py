@@ -4,6 +4,12 @@ import jax
 
 TPU_BACKENDS = ("tpu", "axon")
 
+# The additive masked-out encoding shared by the attention kernels and the
+# mask->bias folding in ops.transformer.attention: kernels classify a row
+# as fully masked via thresholds on NEG_INF/2, so every producer of masked
+# logits must use THIS constant (fp32- and bf16-representable).
+NEG_INF = -1e30
+
 
 def on_tpu() -> bool:
     try:

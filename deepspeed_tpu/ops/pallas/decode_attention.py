@@ -46,7 +46,7 @@ from ._common import interpret_mode as _interpret
 
 DEFAULT_BLOCK_K = 512
 DEFAULT_HEAD_BLOCK = 8
-NEG_INF = -1e30
+from ._common import NEG_INF
 
 
 def _attend_block(q, kbuf, vbuf, start, length, slopes, m_ref, l_ref,

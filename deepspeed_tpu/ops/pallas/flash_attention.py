@@ -1,8 +1,9 @@
-"""Flash attention Pallas kernel (fwd + bwd).
+"""Flash attention Pallas kernel (fwd + bwd) with fused bias/mask/dropout.
 
 TPU-native replacement for the reference's fused CUDA attention
 (csrc/transformer/softmax_kernels.cu + strided batched gemms orchestrated in
-ds_transformer_cuda.cpp; inference variant softmax_context in
+ds_transformer_cuda.cpp, attention dropout in
+csrc/transformer/dropout_kernels.cu; inference variant softmax_context in
 csrc/transformer/inference/). Design:
 
 - layout: kernels run in BHSD ([batch, heads, seq, head_dim]) so block
@@ -19,8 +20,29 @@ csrc/transformer/inference/). Design:
     compiles and runs at any length (16k/32k+).
 - causal mode never computes blocks above the diagonal (dynamic trip
   counts in resident form, compute-predication in streamed form).
+- ``bias``: ONE additive [b|1, h|1, sq|1, sk] operand covering both the
+  reference kernel's attn-mask input and alibi/relative biases (boolean
+  masks are folded to 0/-1e30 by the dispatch layer, the same encoding
+  the causal path uses). Broadcast (size-1) dims stay size-1 all the way
+  into the kernel tile — a [b,1,1,sk] padding mask costs O(b*sk) HBM,
+  never O(s^2).
+- ``dropout``: attention-probability dropout fused into every structure
+  via a COUNTER-BASED keep mask: murmur-style avalanche hashing of
+  (seed, global batch*head, absolute row, absolute col). Stateless
+  per-element sampling means the fwd kernel and all three backward
+  tilings regenerate bit-identical masks with zero operand traffic, and
+  the same pure-jnp helper (attention_dropout_keep) runs OUTSIDE Pallas
+  for the dense path and sequence-parallel layouts — replicated, Ulysses
+  (via head/batch offsets) and dense-reference runs all sample the same
+  bits, which is what makes cross-backend parity exactly testable. The
+  keep mask drops softmax PROBS (post-normalization, scaled 1/(1-rate)),
+  matching the reference's dropout placement; the softmax denominator
+  accumulates UN-dropped probabilities.
 - forward emits the log-sum-exp rows; backward is two passes sharing that
   LSE (no softmax recompute pass): q-major for dQ, k-major for dK/dV.
+  dBias is computed in the custom_vjp bwd rule as a dense recompute that
+  XLA dead-code-eliminates whenever the bias is not being differentiated
+  (the common case: masks and alibi).
 - all matmuls run in the operand dtype (bf16 hot path) with fp32
   accumulation via preferred_element_type — the same bf16-in/fp32-acc
   contract as the XLA einsum path.
@@ -33,16 +55,89 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 512
 RESIDENT_BLOCK_K = 512   # swept on v5e: resident fori prefers 512,
 STREAMED_BLOCK_K = 1024  # the streamed grid prefers 1024
-NEG_INF = -1e30
 
+from ._common import NEG_INF
 from ._common import interpret_mode as _interpret
 
+
+# ---------------------------------------------------------------------------
+# counter-based attention dropout
+# ---------------------------------------------------------------------------
+
+def _mix32(x):
+    """murmur3 finalizer: full avalanche on a uint32 lane."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+def _keep_from_coords(s0, s1, bh, i, j, rate):
+    """Bernoulli(1-rate) keep decision per (seed, flat batch*head, row,
+    col) coordinate. Inputs are broadcastable uint32 arrays/scalars; two
+    avalanche rounds decorrelate the structured (i, j) lattice. Pure jnp,
+    so the SAME code runs inside Pallas kernels (2-D tiles) and outside
+    (4-D full shapes)."""
+    x = ((i * jnp.uint32(0x27D4EB2F)) ^ (j * jnp.uint32(0x165667B1))
+         ^ (bh * jnp.uint32(0x9E3779B1)) ^ s0)
+    x = _mix32(x ^ s1)
+    x = _mix32(x + jnp.uint32(0x9E3779B9))
+    return x >= jnp.uint32(min(int(rate * 2 ** 32), 2 ** 32 - 1))
+
+
+def _seed_words(key):
+    """Two uint32 words from a JAX PRNG key (typed or raw)."""
+    if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+        data = jax.random.key_data(key)
+    else:
+        data = jnp.asarray(key)
+    data = data.astype(jnp.uint32).reshape(-1)
+    w1 = data[-1] if data.size > 1 else jnp.uint32(0x6A09E667)
+    return data[0], w1
+
+
+def attention_dropout_keep(dropout_rng, rate, shape, total_heads=None,
+                           head_offset=0, batch_offset=0,
+                           q_offset=0, k_offset=0):
+    """Full-shape [b, h, sq, sk] keep mask — bit-identical to what the
+    flash kernels sample per tile. ``total_heads``/offsets let a
+    shard_map region (Ulysses: local heads/batch) reproduce the global
+    replicated sample; the defaults are correct for unsharded or
+    GSPMD-sharded (global-view) callers."""
+    u = functools.partial(jax.lax.broadcasted_iota, jnp.uint32, shape)
+    s0, s1 = _seed_words(dropout_rng)
+    bi = u(0) + jnp.uint32(batch_offset)
+    hi = u(1) + jnp.uint32(head_offset)
+    i = u(2) + jnp.uint32(q_offset)
+    j = u(3) + jnp.uint32(k_offset)
+    bh = bi * jnp.uint32(total_heads if total_heads else shape[1]) + hi
+    return _keep_from_coords(s0, s1, bh, i, j, rate)
+
+
+def _tile_keep(sm_ref, bi, hi, q_start, k_start, shape, rate, total_heads):
+    """In-kernel [Bq, Bk] keep tile at absolute coordinates. sm_ref (SMEM,
+    int32[4]): [seed0, seed1, head_offset, batch_offset]."""
+    s0 = sm_ref[0].astype(jnp.uint32)
+    s1 = sm_ref[1].astype(jnp.uint32)
+    gh = jnp.uint32(hi) + sm_ref[2].astype(jnp.uint32)
+    gb = jnp.uint32(bi) + sm_ref[3].astype(jnp.uint32)
+    bh = gb * jnp.uint32(total_heads) + gh
+    i = jax.lax.broadcasted_iota(jnp.uint32, shape, 0) + jnp.uint32(q_start)
+    j = jax.lax.broadcasted_iota(jnp.uint32, shape, 1) + jnp.uint32(k_start)
+    return _keep_from_coords(s0, s1, bh, i, j, rate)
+
+
+# ---------------------------------------------------------------------------
+# shared tile math
+# ---------------------------------------------------------------------------
 
 def _causal_mask(s, q_off, k_off):
     row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + q_off
@@ -57,37 +152,69 @@ from ._common import pick_block as _block
 # constant so tests can lower it to exercise the long-seq structures
 MONOLITHIC_BWD_MAX_SEQ = 4096
 
+# a full-extent [.., Bq, sk] bias tile shares VMEM with K/V in the
+# resident structures; cap its footprint
+_BIAS_TILE_BUDGET = 4 * 2 ** 20
+
 
 def _kv_fits_vmem(s, d, itemsize=2):
     """Lane-padded, double-buffered K+V bytes within a ~12MB budget."""
     return s * max(d, 128) * itemsize * 2 * 2 <= 12 * 2 ** 20
 
 
-def _probs(q, k, lse, scale, causal, q_off, k_off):
+def _probs(q, k, lse, scale, causal, q_off, k_off, bias=None):
     """Probability tile from the saved LSE (one matmul, no running
-    softmax): p = exp(s - lse); causal-masked and fully-masked
-    (lse = -inf) entries come out exactly 0."""
+    softmax): p = exp(s - lse); causal-masked, bias-masked (-1e30) and
+    fully-masked (lse = -inf) entries come out exactly 0."""
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     if causal:
         s = _causal_mask(s, q_off, k_off)
     return jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
 
 
-def _online_step(q, k, v, scale, causal, q_off, k_off, acc, m_acc, l_acc):
-    """One [Bq, Bk] online-softmax update (shared by both structures)."""
+def _online_step(q, k, v, scale, causal, q_off, k_off, acc, m_acc, l_acc,
+                 bias=None, keep=None, inv_keep=1.0):
+    """One [Bq, Bk] online-softmax update (shared by both structures).
+    ``keep`` drops post-softmax probabilities: the denominator l
+    accumulates the UN-dropped sum (true softmax normalizer), the PV
+    numerator the dropped/rescaled one."""
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     if causal:
         s = _causal_mask(s, q_off, k_off)
     m_new = jnp.maximum(m_acc, jnp.max(s, axis=-1, keepdims=True))
     # rows with no visible key yet (m still -inf, e.g. shifted-causal top
-    # rows) must contribute p=0, not exp(-inf - -inf) = 1
+    # rows or fully bias-masked rows) must contribute p=0, not
+    # exp(-inf - -inf) = 1
     p = jnp.where(m_new > NEG_INF / 2, jnp.exp(s - m_new), 0.0)
     alpha = jnp.exp(m_acc - m_new)
+    l_new = l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    if keep is not None:
+        p = jnp.where(keep, p * inv_keep, 0.0)
     # PV matmul in the value dtype (bf16 MXU rate); probs are in [0,1] so
     # the downcast loses at most 2^-9 relative — inside bf16 output noise
     acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
                                 preferred_element_type=jnp.float32)
-    return acc, m_new, l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    return acc, m_new, l_new
+
+
+def _bwd_tile(p, do, v, delta, scale, keep, inv_keep, q_dtype):
+    """Shared backward tile math. With dropout D = keep/(1-rate):
+    o = (P∘D)v / l  =>  dV = (P∘D)ᵀ do,  dS = P∘(D∘(do Vᵀ) - delta)·scale
+    where delta = rowsum(do∘o) — the same delta as the no-dropout case
+    (the dropped terms cancel: delta_i = do_i·o_i either way)."""
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+    if keep is not None:
+        dfac = jnp.where(keep, inv_keep, 0.0)
+        ds = (p * (dfac * dp - delta) * scale).astype(q_dtype)
+        pv = (p * dfac).astype(do.dtype)
+    else:
+        ds = (p * (dp - delta) * scale).astype(q_dtype)
+        pv = p.astype(do.dtype)
+    return ds, pv
 
 
 def _emit_o_lse(acc, m, l, o_ref, lse_ref):
@@ -97,21 +224,50 @@ def _emit_o_lse(acc, m, l, o_ref, lse_ref):
     lse_ref[0, 0] = jnp.where(l > 0.0, m + jnp.log(safe_l), NEG_INF)
 
 
+def _unpack_refs(refs, has_bias, has_drop):
+    """Kernel ref unpacking: [bias_ref?] [sm_ref?] then outputs/scratch."""
+    i = 0
+    bias_ref = refs[i] if has_bias else None
+    i += 1 if has_bias else 0
+    sm_ref = refs[i] if has_drop else None
+    i += 1 if has_drop else 0
+    return (bias_ref, sm_ref) + tuple(refs[i:])
+
+
+def _bias_rows(bias_ref, bias_q_full, row_ds):
+    """Bias tile rows for q rows ``row_ds`` (pl.ds) — all rows when the
+    bias q dim is broadcast (size 1)."""
+    if bias_q_full:
+        return bias_ref[0, 0, row_ds, :]
+    return bias_ref[0, 0, :, :]
+
+
 # ---------------------------------------------------------------------------
 # resident structure: K/V whole in VMEM, fori over k tiles
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                         causal, block_q, block_k, causal_shift):
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
+                         block_k, causal_shift, has_bias, dropout_rate,
+                         total_heads):
+    has_drop = dropout_rate > 0.0
+    bias_ref, sm_ref, o_ref, lse_ref = _unpack_refs(refs, has_bias, has_drop)
+    bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     q = q_ref[0, 0]                                    # [Bq, d] native dtype
     d = q.shape[-1]
     nkb = k_ref.shape[2] // block_k
-    q_off = pl.program_id(2) * block_q + causal_shift
+    q_off = qi * block_q + causal_shift
+    q_abs = qi * block_q                               # dropout coordinates
+    inv_keep = 1.0 / (1.0 - dropout_rate) if has_drop else 1.0
 
     def body(j, carry):
         ks = pl.ds(j * block_k, block_k)
+        bias = bias_ref[0, 0, :, ks] if has_bias else None
+        keep = (_tile_keep(sm_ref, bi, hi, q_abs, j * block_k,
+                           (block_q, block_k), dropout_rate, total_heads)
+                if has_drop else None)
         return _online_step(q, k_ref[0, 0, ks, :], v_ref[0, 0, ks, :],
-                            scale, causal, q_off, j * block_k, *carry)
+                            scale, causal, q_off, j * block_k, *carry,
+                            bias=bias, keep=keep, inv_keep=inv_keep)
 
     trips = (jnp.clip((q_off + block_q - 1) // block_k + 1, 1, nkb)
              if causal else nkb)
@@ -124,9 +280,11 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
 
 
 def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
-                        dq_ref, *, scale, causal, block_q, block_k,
-                        causal_shift):
-    qi = pl.program_id(2)
+                        *refs, scale, causal, block_q, block_k,
+                        causal_shift, has_bias, dropout_rate, total_heads):
+    has_drop = dropout_rate > 0.0
+    bias_ref, sm_ref, dq_ref = _unpack_refs(refs, has_bias, has_drop)
+    bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     q = q_ref[0, 0]
     do = do_ref[0, 0]
     delta = delta_ref[0, 0]
@@ -134,14 +292,19 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
     d = q.shape[-1]
     nkb = k_ref.shape[2] // block_k
     q_off = qi * block_q + causal_shift
+    q_abs = qi * block_q
+    inv_keep = 1.0 / (1.0 - dropout_rate) if has_drop else 1.0
 
     def body(j, acc):
         ks = pl.ds(j * block_k, block_k)
         k = k_ref[0, 0, ks, :]
         v = v_ref[0, 0, ks, :]
-        p = _probs(q, k, lse, scale, causal, q_off, j * block_k)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        bias = bias_ref[0, 0, :, ks] if has_bias else None
+        p = _probs(q, k, lse, scale, causal, q_off, j * block_k, bias=bias)
+        keep = (_tile_keep(sm_ref, bi, hi, q_abs, j * block_k,
+                           (block_q, block_k), dropout_rate, total_heads)
+                if has_drop else None)
+        ds, _ = _bwd_tile(p, do, v, delta, scale, keep, inv_keep, q.dtype)
         return acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     trips = (jnp.clip((q_off + block_q - 1) // block_k + 1, 1, nkb)
@@ -152,14 +315,18 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
 
 
 def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
-                         dk_ref, dv_ref, *, scale, causal, block_q, block_k,
-                         seq_q, causal_shift):
-    ki = pl.program_id(2)
+                         *refs, scale, causal, block_q, block_k,
+                         seq_q, causal_shift, has_bias, bias_q_full,
+                         dropout_rate, total_heads):
+    has_drop = dropout_rate > 0.0
+    bias_ref, sm_ref, dk_ref, dv_ref = _unpack_refs(refs, has_bias, has_drop)
+    bi, hi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     k = k_ref[0, 0]                                    # [Bk, d] this block
     v = v_ref[0, 0]
     d = k.shape[-1]
     nqb = seq_q // block_q
     k_off = ki * block_k
+    inv_keep = 1.0 / (1.0 - dropout_rate) if has_drop else 1.0
 
     if causal:
         # first q block whose bottom row reaches this k block
@@ -177,13 +344,15 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
         do = do_ref[0, 0, qs, :]
         delta = delta_ref[0, 0, qs, :]
         lse = lse_ref[0, 0, qs, :]
+        bias = _bias_rows(bias_ref, bias_q_full, qs) if has_bias else None
         p = _probs(q, k, lse, scale, causal,
-                   j * block_q + causal_shift, k_off)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
+                   j * block_q + causal_shift, k_off, bias=bias)
+        keep = (_tile_keep(sm_ref, bi, hi, j * block_q, k_off,
+                           (block_q, block_k), dropout_rate, total_heads)
+                if has_drop else None)
+        ds, pv = _bwd_tile(p, do, v, delta, scale, keep, inv_keep, q.dtype)
         dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        dv_acc = dv_acc + jnp.dot(p.astype(do.dtype).T, do,
-                                  preferred_element_type=jnp.float32)
+        dv_acc = dv_acc + jnp.dot(pv.T, do, preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 
     dk_acc, dv_acc = jax.lax.fori_loop(
@@ -194,15 +363,23 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
     dv_ref[0, 0] = dv_acc.astype(dv_ref.dtype)
 
 
-def _bwd_kernel_monolithic(q_ref, k_ref, v_ref, o_ref, do_ref,
-                           dq_ref, dk_ref, dv_ref, *, scale, causal, block_q,
-                           seq_q, causal_shift):
+def _bwd_kernel_monolithic(q_ref, k_ref, v_ref, o_ref, do_ref, *refs,
+                           scale, causal, block_q, seq_q, causal_shift,
+                           has_bias, dropout_rate, total_heads):
     """Single-pass resident backward: grid (b, h); K/V (and dK/dV fp32
     accumulators) whole in VMEM, one fori over q blocks recomputing the
     [Bq, S] softmax from (q, k, o). Measured fastest at training lengths
-    (one kernel launch, K/V and q/do each loaded once)."""
+    (one kernel launch, K/V and q/do each loaded once). Bias here is
+    restricted to broadcast-q ([.., 1, sk]) by the dispatch — a full
+    [sq, sk] bias won't fit VMEM at this structure's lengths."""
+    has_drop = dropout_rate > 0.0
+    bias_ref, sm_ref, dq_ref, dk_ref, dv_ref = _unpack_refs(
+        refs, has_bias, has_drop)
+    bi, hi = pl.program_id(0), pl.program_id(1)
     k = k_ref[0, 0]                                    # [S, d] native dtype
     v = v_ref[0, 0]
+    sk = k.shape[0]
+    inv_keep = 1.0 / (1.0 - dropout_rate) if has_drop else 1.0
 
     def body(i, carry):
         dk_acc, dv_acc = carry
@@ -212,22 +389,26 @@ def _bwd_kernel_monolithic(q_ref, k_ref, v_ref, o_ref, do_ref,
         do = do_ref[0, 0, qs, :]
 
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + bias_ref[0, 0, :, :].astype(jnp.float32)   # [1, S]
         if causal:
             s = _causal_mask(s, i * block_q + causal_shift, 0)
         m = jnp.max(s, axis=-1, keepdims=True)
-        p_un = jnp.exp(s - m)
+        # guard fully-masked rows (bias = -1e30 everywhere): m ~ -1e30
+        p_un = jnp.where(m > NEG_INF / 2, jnp.exp(s - m), 0.0)
         l = jnp.sum(p_un, axis=-1, keepdims=True)
-        p = p_un / l                                   # [Bq, S] fp32
+        p = p_un / jnp.where(l > 0.0, l, 1.0)          # [Bq, S] fp32
 
         delta = jnp.sum(do.astype(jnp.float32) * o, axis=-1, keepdims=True)
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta) * scale).astype(q.dtype)
-        pl_ = p.astype(do.dtype)
+        keep = (_tile_keep(sm_ref, bi, hi, i * block_q, 0,
+                           (block_q, sk), dropout_rate, total_heads)
+                if has_drop else None)
+        ds, pv = _bwd_tile(p, do, v, delta, scale, keep, inv_keep, q.dtype)
 
         dq_ref[0, 0, qs, :] = jnp.dot(
             ds, k, preferred_element_type=jnp.float32).astype(dq_ref.dtype)
         dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        dv_acc = dv_acc + jnp.dot(pl_.T, do, preferred_element_type=jnp.float32)
+        dv_acc = dv_acc + jnp.dot(pv.T, do, preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 
     dk_acc, dv_acc = jax.lax.fori_loop(
@@ -241,11 +422,16 @@ def _bwd_kernel_monolithic(q_ref, k_ref, v_ref, o_ref, do_ref,
 # streamed structure: K/V blocks flow through the grid, scratch accumulators
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
-                         m_ref, l_ref, *, scale, causal, block_q, block_k,
-                         causal_shift, nkb):
+def _fwd_kernel_streamed(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
+                         block_k, causal_shift, nkb, has_bias, dropout_rate,
+                         total_heads):
+    has_drop = dropout_rate > 0.0
+    bias_ref, sm_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = _unpack_refs(
+        refs, has_bias, has_drop)
+    bi, hi = pl.program_id(0), pl.program_id(1)
     qi, ki = pl.program_id(2), pl.program_id(3)
     q_off = qi * block_q + causal_shift
+    inv_keep = 1.0 / (1.0 - dropout_rate) if has_drop else 1.0
 
     @pl.when(ki == 0)
     def _init():
@@ -257,9 +443,14 @@ def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
 
     @pl.when(live)
     def _compute():
+        bias = bias_ref[0, 0] if has_bias else None
+        keep = (_tile_keep(sm_ref, bi, hi, qi * block_q, ki * block_k,
+                           (block_q, block_k), dropout_rate, total_heads)
+                if has_drop else None)
         acc, m, l = _online_step(
             q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], scale, causal, q_off,
-            ki * block_k, acc_ref[...], m_ref[...], l_ref[...])
+            ki * block_k, acc_ref[...], m_ref[...], l_ref[...],
+            bias=bias, keep=keep, inv_keep=inv_keep)
         acc_ref[...], m_ref[...], l_ref[...] = acc, m, l
 
     @pl.when(ki == nkb - 1)
@@ -268,10 +459,15 @@ def _fwd_kernel_streamed(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
 
 
 def _dq_kernel_streamed(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
-                        dq_ref, acc_ref, *, scale, causal, block_q, block_k,
-                        causal_shift, nkb):
+                        *refs, scale, causal, block_q, block_k,
+                        causal_shift, nkb, has_bias, dropout_rate,
+                        total_heads):
+    has_drop = dropout_rate > 0.0
+    bias_ref, sm_ref, dq_ref, acc_ref = _unpack_refs(refs, has_bias, has_drop)
+    bi, hi = pl.program_id(0), pl.program_id(1)
     qi, ki = pl.program_id(2), pl.program_id(3)
     q_off = qi * block_q + causal_shift
+    inv_keep = 1.0 / (1.0 - dropout_rate) if has_drop else 1.0
 
     @pl.when(ki == 0)
     def _init():
@@ -283,10 +479,14 @@ def _dq_kernel_streamed(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
     def _compute():
         q = q_ref[0, 0]
         do = do_ref[0, 0]
+        bias = bias_ref[0, 0] if has_bias else None
         p = _probs(q, k_ref[0, 0], lse_ref[0, 0], scale, causal, q_off,
-                   ki * block_k)
-        dp = jnp.dot(do, v_ref[0, 0].T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta_ref[0, 0]) * scale).astype(q.dtype)
+                   ki * block_k, bias=bias)
+        keep = (_tile_keep(sm_ref, bi, hi, qi * block_q, ki * block_k,
+                           (block_q, block_k), dropout_rate, total_heads)
+                if has_drop else None)
+        ds, _ = _bwd_tile(p, do, v_ref[0, 0], delta_ref[0, 0], scale,
+                          keep, inv_keep, q.dtype)
         acc_ref[...] += jnp.dot(ds, k_ref[0, 0],
                                 preferred_element_type=jnp.float32)
 
@@ -296,11 +496,17 @@ def _dq_kernel_streamed(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
 
 
 def _dkv_kernel_streamed(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
-                         dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                         block_q, block_k, causal_shift, nqb):
+                         *refs, scale, causal, block_q, block_k,
+                         causal_shift, nqb, has_bias, dropout_rate,
+                         total_heads):
+    has_drop = dropout_rate > 0.0
+    bias_ref, sm_ref, dk_ref, dv_ref, dk_acc, dv_acc = _unpack_refs(
+        refs, has_bias, has_drop)
+    bi, hi = pl.program_id(0), pl.program_id(1)
     ki, qi = pl.program_id(2), pl.program_id(3)
     q_off = qi * block_q + causal_shift
     k_off = ki * block_k
+    inv_keep = 1.0 / (1.0 - dropout_rate) if has_drop else 1.0
 
     @pl.when(qi == 0)
     def _init():
@@ -313,13 +519,16 @@ def _dkv_kernel_streamed(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
     def _compute():
         q = q_ref[0, 0]
         do = do_ref[0, 0]
+        bias = bias_ref[0, 0] if has_bias else None
         p = _probs(q, k_ref[0, 0], lse_ref[0, 0], scale, causal, q_off,
-                   k_off)
-        dp = jnp.dot(do, v_ref[0, 0].T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta_ref[0, 0]) * scale).astype(q.dtype)
+                   k_off, bias=bias)
+        keep = (_tile_keep(sm_ref, bi, hi, qi * block_q, k_off,
+                           (block_q, block_k), dropout_rate, total_heads)
+                if has_drop else None)
+        ds, pv = _bwd_tile(p, do, v_ref[0, 0], delta_ref[0, 0], scale,
+                           keep, inv_keep, q.dtype)
         dk_acc[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        dv_acc[...] += jnp.dot(p.astype(do.dtype).T, do,
-                               preferred_element_type=jnp.float32)
+        dv_acc[...] += jnp.dot(pv.T, do, preferred_element_type=jnp.float32)
 
     @pl.when(qi == nqb - 1)
     def _emit():
@@ -331,37 +540,111 @@ def _dkv_kernel_streamed(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
 # dispatch
 # ---------------------------------------------------------------------------
 
-def _flash_fwd(q, k, v, scale, causal, block_q):
+def _bias_meta(bias):
+    """(batched, headed, q_full) broadcast flags of a [b', h', sq', sk]
+    bias operand."""
+    return bias.shape[0] > 1, bias.shape[1] > 1, bias.shape[2] > 1
+
+
+def _bias_spec3(bias, block_q):
+    """BlockSpec for 3-D grids (b, h, qi): full sk extent per tile."""
+    bb, bh, bq_full = _bias_meta(bias)
+    sk = bias.shape[3]
+    shape = (1, 1, block_q if bq_full else 1, sk)
+    return pl.BlockSpec(shape, lambda bi, hi, qi: (
+        bi if bb else 0, hi if bh else 0, qi if bq_full else 0, 0))
+
+
+def _bias_spec3_k(bias, block_k, seq_q):
+    """BlockSpec for the resident dkv grid (b, h, ki): full sq extent,
+    one k block."""
+    bb, bh, bq_full = _bias_meta(bias)
+    shape = (1, 1, seq_q if bq_full else 1, block_k)
+    return pl.BlockSpec(shape, lambda bi, hi, ki: (
+        bi if bb else 0, hi if bh else 0, 0, ki))
+
+
+def _bias_spec4(bias, block_q, block_k, q_pos, k_pos):
+    """BlockSpec for 4-D streamed grids; q_pos/k_pos say which grid axes
+    carry the q/k block indices (2, 3) or (3, 2)."""
+    bb, bh, bq_full = _bias_meta(bias)
+    shape = (1, 1, block_q if bq_full else 1, block_k)
+
+    def idx(*g):
+        return (g[0] if bb else 0, g[1] if bh else 0,
+                g[q_pos] if bq_full else 0, g[k_pos])
+
+    return pl.BlockSpec(shape, idx)
+
+
+def _bias_spec2(bias):
+    """BlockSpec for the monolithic (b, h) grid: bias is broadcast-q
+    ([.., 1, sk]) here by construction."""
+    bb, bh, _ = _bias_meta(bias)
+    return pl.BlockSpec((1, 1, 1, bias.shape[3]), lambda bi, hi: (
+        bi if bb else 0, hi if bh else 0, 0, 0))
+
+
+_SM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _extra_ops(bias, seeds, bias_spec):
+    """(operands, specs) for the optional bias/seed inputs."""
+    ops, specs = [], []
+    if bias is not None:
+        ops.append(bias)
+        specs.append(bias_spec)
+    if seeds is not None:
+        ops.append(seeds)
+        specs.append(_SM_SPEC)
+    return tuple(ops), tuple(specs)
+
+
+def _flash_fwd(q, k, v, bias, seeds, scale, causal, dropout_rate,
+               total_heads, block_q):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = _block(sq, min(block_q, sq))
+    has_bias = bias is not None
+    drop = dropout_rate if seeds is not None else 0.0
+    common = dict(scale=scale, causal=causal, has_bias=has_bias,
+                  dropout_rate=drop, total_heads=total_heads)
     out_shape = (jax.ShapeDtypeStruct(q.shape, q.dtype),
                  jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32))
     q_blk3 = pl.BlockSpec((1, 1, block_q, d),
                           lambda bi, hi, qi: (bi, hi, qi, 0))
     lse_blk3 = pl.BlockSpec((1, 1, block_q, 1),
                             lambda bi, hi, qi: (bi, hi, qi, 0))
-    if _kv_fits_vmem(sk, d, q.dtype.itemsize):
+    resident = _kv_fits_vmem(sk, d, q.dtype.itemsize)
+    if has_bias and bias.shape[2] > 1:
+        # a full-extent bias tile [Bq, sk] shares VMEM with resident K/V
+        resident = resident and (
+            block_q * sk * bias.dtype.itemsize <= _BIAS_TILE_BUDGET)
+    if resident:
+        extra, extra_specs = _extra_ops(
+            bias, seeds, _bias_spec3(bias, block_q) if has_bias else None)
         kv_full = pl.BlockSpec((1, 1, sk, d),
                                lambda bi, hi, qi: (bi, hi, 0, 0))
         o, lse = pl.pallas_call(
-            functools.partial(_fwd_kernel_resident, scale=scale,
-                              causal=causal, block_q=block_q,
+            functools.partial(_fwd_kernel_resident, block_q=block_q,
                               block_k=_block(sk, RESIDENT_BLOCK_K),
-                              causal_shift=sk - sq),
+                              causal_shift=sk - sq, **common),
             grid=(b, h, sq // block_q),
-            in_specs=[q_blk3, kv_full, kv_full],
+            in_specs=[q_blk3, kv_full, kv_full, *extra_specs],
             out_specs=(q_blk3, lse_blk3),
             out_shape=out_shape,
             interpret=_interpret(),
-        )(q, k, v)
+        )(q, k, v, *extra)
         return o, lse
     block_k = _block(sk, STREAMED_BLOCK_K)
     nkb = sk // block_k
+    extra, extra_specs = _extra_ops(
+        bias, seeds,
+        _bias_spec4(bias, block_q, block_k, 2, 3) if has_bias else None)
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel_streamed, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          causal_shift=sk - sq, nkb=nkb),
+        functools.partial(_fwd_kernel_streamed, block_q=block_q,
+                          block_k=block_k, causal_shift=sk - sq, nkb=nkb,
+                          **common),
         grid=(b, h, sq // block_q, nkb),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d),
@@ -370,6 +653,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q):
                          lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            *extra_specs,
         ],
         out_specs=(
             pl.BlockSpec((1, 1, block_q, d),
@@ -382,42 +666,93 @@ def _flash_fwd(q, k, v, scale, causal, block_q):
                         pltpu.VMEM((block_q, 1), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v)
+    )(q, k, v, *extra)
     return o, lse
 
 
-def _flash_bwd(scale, causal, block_q, res, g):
-    q, k, v, o, lse = res
+def _dbias_dense(q, k, v, o, lse, g, bias, seeds, scale, causal,
+                 dropout_rate, total_heads):
+    """dBias via dense recompute from the saved LSE, reduced to the bias's
+    broadcast shape. Lives OUTSIDE the Pallas kernels on purpose: when the
+    bias is not differentiated (masks, alibi — the common case) XLA
+    dead-code-eliminates this whole chain, so the flash path pays nothing;
+    when it IS differentiated (T5-style trainable bias) the caller already
+    holds O(s^2) bias storage, and XLA fuses the elementwise chain into
+    the reduction."""
+    f32 = jnp.float32
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(f32), k.astype(f32)) * scale
+    s = s + bias.astype(f32)
+    sq, sk = q.shape[2], k.shape[2]
+    if causal:
+        cm = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+        s = jnp.where(cm, s, NEG_INF)
+    p = jnp.where(lse > NEG_INF / 2, jnp.exp(s - lse), 0.0)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g.astype(f32), v.astype(f32))
+    if dropout_rate > 0.0 and seeds is not None:
+        keep = attention_dropout_keep(
+            seeds[:2], dropout_rate, p.shape, total_heads=total_heads,
+            head_offset=seeds[2], batch_offset=seeds[3])
+        dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+    delta = jnp.sum(g.astype(f32) * o.astype(f32), axis=-1, keepdims=True)
+    dbias_full = p * (dp - delta)
+    reduce_dims = tuple(i for i in range(3) if bias.shape[i] == 1)
+    dbias = jnp.sum(dbias_full, axis=reduce_dims, keepdims=True)
+    return dbias.astype(bias.dtype)
+
+
+def _flash_bwd(scale, causal, dropout_rate, block_q, total_heads, res, g):
+    q, k, v, bias, seeds, o, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    has_bias = bias is not None
+    drop = dropout_rate if seeds is not None else 0.0
+    bias_q_full = has_bias and bias.shape[2] > 1
+    common = dict(scale=scale, causal=causal, has_bias=has_bias,
+                  dropout_rate=drop, total_heads=total_heads)
+
+    dbias = (_dbias_dense(q, k, v, o, lse, g, bias, seeds, scale, causal,
+                          drop, total_heads) if has_bias else None)
+    dseeds = (np.zeros(seeds.shape, jax.dtypes.float0)
+              if seeds is not None else None)
 
     # Training lengths: the single-pass resident backward wins (one
     # launch; K/V, q, do each read once; measured best 125M e2e on v5e).
     # Its VMEM budget: K/V + fp32 dK/dV accumulators + 3 [Bq, S] fp32
-    # tiles — comfortable through 4k.
-    if sk <= MONOLITHIC_BWD_MAX_SEQ and sq <= MONOLITHIC_BWD_MAX_SEQ:
+    # tiles — comfortable through 4k. A full-extent bias can't ride in
+    # this structure (its [sq, sk] tile outgrows VMEM) — two-pass then.
+    if (sk <= MONOLITHIC_BWD_MAX_SEQ and sq <= MONOLITHIC_BWD_MAX_SEQ
+            and not bias_q_full):
         cap = max(128, (2 ** 19 // max(sk, 1)) // 128 * 128)
         bq = math.gcd(sq, min(block_q, sq, cap))
         if bq % 8 != 0:
             bq = sq
+        extra, extra_specs = _extra_ops(
+            bias, seeds, _bias_spec2(bias) if has_bias else None)
         full_q = pl.BlockSpec((1, 1, sq, d), lambda bi, hi: (bi, hi, 0, 0))
         full_k = pl.BlockSpec((1, 1, sk, d), lambda bi, hi: (bi, hi, 0, 0))
-        return pl.pallas_call(
-            functools.partial(_bwd_kernel_monolithic, scale=scale,
-                              causal=causal, block_q=bq, seq_q=sq,
-                              causal_shift=sk - sq),
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_kernel_monolithic, block_q=bq, seq_q=sq,
+                              causal_shift=sk - sq, **common),
             grid=(b, h),
-            in_specs=[full_q, full_k, full_k, full_q, full_q],
+            in_specs=[full_q, full_k, full_k, full_q, full_q, *extra_specs],
             out_specs=(full_q, full_k, full_k),
             out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
                        jax.ShapeDtypeStruct(k.shape, k.dtype),
                        jax.ShapeDtypeStruct(v.shape, v.dtype)),
             interpret=_interpret(),
-        )(q, k, v, o, g)
+        )(q, k, v, o, g, *extra)
+        return (dq, dk, dv, dbias, dseeds)
 
     block_q = _block(sq, min(block_q, sq))
     resident = (_kv_fits_vmem(sk, d, q.dtype.itemsize)
                 and _kv_fits_vmem(sq, d, q.dtype.itemsize))
+    if bias_q_full:
+        # both passes load full-extent bias tiles: [Bq, sk] in dq and
+        # [sq, Bk] in dkv — budget the larger one
+        rbk = _block(sk, RESIDENT_BLOCK_K)
+        resident = resident and (
+            max(block_q * sk, sq * rbk) * bias.dtype.itemsize
+            <= _BIAS_TILE_BUDGET)
     block_k = _block(sk, RESIDENT_BLOCK_K if resident else STREAMED_BLOCK_K)
     nqb, nkb = sq // block_q, sk // block_k
     # delta = rowsum(do * o): cheap elementwise outside the kernels
@@ -431,17 +766,19 @@ def _flash_bwd(scale, causal, block_q, res, g):
                               lambda bi, hi, qi: (bi, hi, qi, 0))
         kv_full = pl.BlockSpec((1, 1, sk, d),
                                lambda bi, hi, qi: (bi, hi, 0, 0))
+        extra, extra_specs = _extra_ops(
+            bias, seeds, _bias_spec3(bias, block_q) if has_bias else None)
         dq = pl.pallas_call(
-            functools.partial(_dq_kernel_resident, scale=scale,
-                              causal=causal, block_q=block_q,
-                              block_k=block_k,
-                              causal_shift=sk - sq),
+            functools.partial(_dq_kernel_resident, block_q=block_q,
+                              block_k=block_k, causal_shift=sk - sq,
+                              **common),
             grid=(b, h, nqb),
-            in_specs=[q_blk, kv_full, kv_full, q_blk, q_stat, q_stat],
+            in_specs=[q_blk, kv_full, kv_full, q_blk, q_stat, q_stat,
+                      *extra_specs],
             out_specs=q_blk,
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             interpret=_interpret(),
-        )(q, k, v, g, delta, lse)
+        )(q, k, v, g, delta, lse, *extra)
 
         k_blk = pl.BlockSpec((1, 1, block_k, d),
                              lambda bi, hi, ki: (bi, hi, ki, 0))
@@ -449,52 +786,64 @@ def _flash_bwd(scale, causal, block_q, res, g):
                               lambda bi, hi, ki: (bi, hi, 0, 0))
         stat_full = pl.BlockSpec((1, 1, sq, 1),
                                  lambda bi, hi, ki: (bi, hi, 0, 0))
+        extra_k, extra_k_specs = _extra_ops(
+            bias, seeds,
+            _bias_spec3_k(bias, block_k, sq) if has_bias else None)
         dk, dv = pl.pallas_call(
-            functools.partial(_dkv_kernel_resident, scale=scale,
-                              causal=causal, block_q=block_q,
+            functools.partial(_dkv_kernel_resident, block_q=block_q,
                               block_k=block_k, seq_q=sq,
-                              causal_shift=sk - sq),
+                              causal_shift=sk - sq,
+                              bias_q_full=bias_q_full, **common),
             grid=(b, h, nkb),
-            in_specs=[q_full, k_blk, k_blk, q_full, stat_full, stat_full],
+            in_specs=[q_full, k_blk, k_blk, q_full, stat_full, stat_full,
+                      *extra_k_specs],
             out_specs=(k_blk, k_blk),
             out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
                        jax.ShapeDtypeStruct(v.shape, v.dtype)),
             interpret=_interpret(),
-        )(q, k, v, g, delta, lse)
-        return dq, dk, dv
+        )(q, k, v, g, delta, lse, *extra_k)
+        return (dq, dk, dv, dbias, dseeds)
 
     q_blk = lambda bi, hi, qi, ki: (bi, hi, qi, 0)
     k_blk = lambda bi, hi, qi, ki: (bi, hi, ki, 0)
+    extra, extra_specs = _extra_ops(
+        bias, seeds,
+        _bias_spec4(bias, block_q, block_k, 2, 3) if has_bias else None)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel_streamed, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          causal_shift=sk - sq, nkb=nkb),
+        functools.partial(_dq_kernel_streamed, block_q=block_q,
+                          block_k=block_k, causal_shift=sk - sq, nkb=nkb,
+                          **common),
         grid=(b, h, nqb, nkb),
         in_specs=[pl.BlockSpec((1, 1, block_q, d), q_blk),
                   pl.BlockSpec((1, 1, block_k, d), k_blk),
                   pl.BlockSpec((1, 1, block_k, d), k_blk),
                   pl.BlockSpec((1, 1, block_q, d), q_blk),
                   pl.BlockSpec((1, 1, block_q, 1), q_blk),
-                  pl.BlockSpec((1, 1, block_q, 1), q_blk)],
+                  pl.BlockSpec((1, 1, block_q, 1), q_blk),
+                  *extra_specs],
         out_specs=pl.BlockSpec((1, 1, block_q, d), q_blk),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, g, delta, lse)
+    )(q, k, v, g, delta, lse, *extra)
 
     kq_k = lambda bi, hi, ki, qi: (bi, hi, ki, 0)
     kq_q = lambda bi, hi, ki, qi: (bi, hi, qi, 0)
+    extra_k, extra_k_specs = _extra_ops(
+        bias, seeds,
+        _bias_spec4(bias, block_q, block_k, 3, 2) if has_bias else None)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel_streamed, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          causal_shift=sk - sq, nqb=nqb),
+        functools.partial(_dkv_kernel_streamed, block_q=block_q,
+                          block_k=block_k, causal_shift=sk - sq, nqb=nqb,
+                          **common),
         grid=(b, h, nkb, nqb),
         in_specs=[pl.BlockSpec((1, 1, block_q, d), kq_q),
                   pl.BlockSpec((1, 1, block_k, d), kq_k),
                   pl.BlockSpec((1, 1, block_k, d), kq_k),
                   pl.BlockSpec((1, 1, block_q, d), kq_q),
                   pl.BlockSpec((1, 1, block_q, 1), kq_q),
-                  pl.BlockSpec((1, 1, block_q, 1), kq_q)],
+                  pl.BlockSpec((1, 1, block_q, 1), kq_q),
+                  *extra_k_specs],
         out_specs=(pl.BlockSpec((1, 1, block_k, d), kq_k),
                    pl.BlockSpec((1, 1, block_k, d), kq_k)),
         out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -502,27 +851,40 @@ def _flash_bwd(scale, causal, block_q, res, g):
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, g, delta, lse)
-    return dq, dk, dv
+    )(q, k, v, g, delta, lse, *extra_k)
+    return (dq, dk, dv, dbias, dseeds)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_attention_bhsd(q, k, v, scale, causal, block_q):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_attention_bhsd(q, k, v, bias, seeds, scale, causal,
+                          dropout_rate, block_q, total_heads):
+    o, _ = _flash_fwd(q, k, v, bias, seeds, scale, causal, dropout_rate,
+                      total_heads, block_q)
     return o
 
 
-def _fwd_rule(q, k, v, scale, causal, block_q):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q)
-    return o, (q, k, v, o, lse)
+def _fwd_rule(q, k, v, bias, seeds, scale, causal, dropout_rate, block_q,
+              total_heads):
+    o, lse = _flash_fwd(q, k, v, bias, seeds, scale, causal, dropout_rate,
+                        total_heads, block_q)
+    return o, (q, k, v, bias, seeds, o, lse)
 
 
 _flash_attention_bhsd.defvjp(_fwd_rule, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, causal=True, softmax_scale=None,
+def flash_attention(q, k, v, *, bias=None, causal=True, softmax_scale=None,
+                    dropout_rate=0.0, dropout_rng=None, dropout_offsets=None,
                     block_q=DEFAULT_BLOCK_Q):
-    """q,k,v: [batch, seq, heads, head_dim] (BSHD). Returns like q."""
+    """q,k,v: [batch, seq, heads, head_dim] (BSHD). Returns like q.
+
+    bias: optional additive [b|1, h|1, sq|1, sk] operand (fold boolean
+    masks to 0/-1e30 before calling — ``ops.transformer.attention`` does).
+    dropout_rate/dropout_rng: fused attention-probability dropout (active
+    when both are set). dropout_offsets: (total_heads, head_offset,
+    batch_offset) so shard_map callers with local head/batch windows
+    sample the same global keep mask as a replicated run.
+    """
     d = q.shape[-1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
     sq = q.shape[1]
@@ -530,6 +892,31 @@ def flash_attention(q, k, v, *, causal=True, softmax_scale=None,
     if sq % bq != 0:
         raise ValueError(f"flash_attention: seq {sq} must be divisible by "
                          f"block_q {bq}")
+    bias4 = None
+    if bias is not None:
+        full = (q.shape[0], q.shape[2], sq)
+        if (bias.ndim != 4 or bias.shape[3] != k.shape[1]
+                or any(bias.shape[i] not in (1, full[i]) for i in range(3))):
+            # dims 0-2 must each be broadcast (1) or full-size: a partial
+            # extent would make the BlockSpec index maps read clamped
+            # (wrong) blocks instead of failing
+            raise ValueError(
+                f"flash_attention: bias must be [b|1, h|1, sq|1, sk], got "
+                f"{bias.shape} for q {q.shape}, sk={k.shape[1]}")
+        # full-extent biases ride VMEM in bf16 (the kernel adds in fp32);
+        # broadcast-q biases (masks, alibi rows) are small — keep fp32
+        bias4 = bias.astype(q.dtype if bias.shape[2] > 1 else jnp.float32)
+    seeds = None
+    total_heads = q.shape[2]
+    rate = 0.0
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        rate = float(dropout_rate)
+        th, ho, bo = dropout_offsets or (q.shape[2], 0, 0)
+        total_heads = int(th)
+        s0, s1 = _seed_words(dropout_rng)
+        seeds = jnp.stack([s0, s1, jnp.uint32(ho),
+                           jnp.uint32(bo)]).astype(jnp.int32)
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-    o = _flash_attention_bhsd(qt, kt, vt, scale, causal, bq)
+    o = _flash_attention_bhsd(qt, kt, vt, bias4, seeds, scale, causal,
+                              rate, bq, total_heads)
     return jnp.swapaxes(o, 1, 2)

@@ -23,13 +23,17 @@ import jax.numpy as jnp
 def _reference_attention(q, k, v, bias=None, mask=None, *, causal=False,
                          softmax_scale=None, dropout_rate=0.0,
                          dropout_rng=None, deterministic=True,
-                         dropout_mask=None):
+                         dropout_mask=None, dropout_offsets=None):
     """q,k,v: [batch, seq, heads, head_dim] (BSHD, the JAX-native layout).
 
-    ``dropout_mask``: precomputed boolean keep mask [b, h, sq, sk] —
-    overrides rng sampling. Sequence-parallel callers pass their local
-    slice of a globally-sampled mask (partitionable threefry makes the
-    slices bit-identical to the replicated sample)."""
+    Dropout samples the SAME counter-based keep mask as the Pallas flash
+    kernel (``ops.pallas.flash_attention.attention_dropout_keep``): bits
+    are a pure function of (rng, batch, head, row, col), so dense and
+    flash backends — and replicated vs sequence-parallel layouts — are
+    bit-identical given the same rng. ``dropout_offsets``
+    (total_heads, head_offset, batch_offset) lets a shard_map-local
+    caller reproduce the global sample. ``dropout_mask`` (a precomputed
+    boolean keep mask) overrides sampling."""
     *_, q_len, _, head_dim = q.shape
     k_len = k.shape[-3]
     scale = softmax_scale if softmax_scale is not None else head_dim ** -0.5
@@ -52,7 +56,11 @@ def _reference_attention(q, k, v, bias=None, mask=None, *, causal=False,
     if dropout_mask is not None:
         probs = jnp.where(dropout_mask, probs / (1.0 - dropout_rate), 0.0)
     elif dropout_rate > 0.0 and not deterministic:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        from ..pallas.flash_attention import attention_dropout_keep
+        th, ho, bo = dropout_offsets or (probs.shape[1], 0, 0)
+        keep = attention_dropout_keep(dropout_rng, dropout_rate, probs.shape,
+                                      total_heads=th, head_offset=ho,
+                                      batch_offset=bo)
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
 
     probs = probs.astype(v.dtype)
@@ -62,16 +70,24 @@ def _reference_attention(q, k, v, bias=None, mask=None, *, causal=False,
 def attention(q, k, v, bias=None, mask=None, *, causal=False,
               softmax_scale=None, dropout_rate=0.0, dropout_rng=None,
               deterministic=True, backend: Optional[str] = None,
-              seq_parallel: Optional[str] = None, ring_block_q: int = 1024):
+              seq_parallel: Optional[str] = None, ring_block_q: int = 1024,
+              dropout_offsets=None):
     """Multi-head attention, BSHD layout.
 
     backend: None = auto (pallas flash kernel on TPU when eligible,
-    reference otherwise) | "reference" | "pallas".
+    reference otherwise) | "reference" | "pallas". Bias, mask and dropout
+    are FUSED into the flash kernel (mask folds into one additive bias
+    operand; dropout samples a counter-based keep mask in-kernel) — only
+    operand shapes the kernel's block specs can't express fall back.
     seq_parallel: None = auto (ulysses when the mesh's ``seq`` axis > 1)
     | "ulysses" | "ring" | "none". Bias, mask and dropout ride along on
-    both sequence-parallel paths (ulysses keeps the replicated path's
-    exact dropout pattern via partitionable threefry; ring samples per
-    k/v block). Only shape constraints fall back.
+    both sequence-parallel paths (ulysses reproduces the replicated
+    path's exact dropout bits via the position-keyed hash + head/batch
+    offsets; ring samples per k/v block). Only shape constraints fall
+    back.
+    dropout_offsets: (total_heads, head_offset, batch_offset) — set by
+    shard_map-local callers (Ulysses) so local tiles sample the global
+    keep mask; leave None under plain jit/pjit (global view).
     """
     sp_mode = _resolve_seq_parallel(seq_parallel, q, bias, mask)
     if sp_mode == "ulysses":
@@ -92,24 +108,40 @@ def attention(q, k, v, bias=None, mask=None, *, causal=False,
                               deterministic=deterministic,
                               block_q=ring_block_q)
 
+    drop_on = dropout_rate > 0.0 and not deterministic
     if backend is None:
-        backend = _auto_backend(q, bias, mask, dropout_rate, deterministic)
-    elif backend == "pallas" and (
-            bias is not None or mask is not None
-            or (dropout_rate > 0.0 and not deterministic)):
-        # the flash kernel takes no bias/mask/dropout operands — honor the
-        # semantics over the explicit backend request (e.g. alibi or
-        # KV-cache masks with attn_backend="pallas").
+        backend = _auto_backend(q, k, bias, mask, drop_on, dropout_rng)
+    elif backend == "pallas" and not _pallas_operands_ok(
+            q, k, bias, mask, drop_on, dropout_rng):
+        # operand shapes the kernel's block specs can't express — honor
+        # the semantics over the explicit backend request
         _warn_pallas_fallback()
         backend = "reference"
     if backend == "pallas":
         from ..pallas import flash_attention
-        return flash_attention(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        return flash_attention(
+            q, k, v, bias=_combined_bias(bias, mask), causal=causal,
+            softmax_scale=softmax_scale,
+            dropout_rate=dropout_rate if drop_on else 0.0,
+            dropout_rng=dropout_rng if drop_on else None,
+            dropout_offsets=dropout_offsets)
     return _reference_attention(q, k, v, bias=bias, mask=mask, causal=causal,
                                 softmax_scale=softmax_scale,
                                 dropout_rate=dropout_rate,
                                 dropout_rng=dropout_rng,
-                                deterministic=deterministic)
+                                deterministic=deterministic,
+                                dropout_offsets=dropout_offsets)
+
+
+def _combined_bias(bias, mask):
+    """Fold a boolean keep mask into the additive bias operand the flash
+    kernel takes (0 where attending, NEG_INF where masked — the encoding
+    the kernels' fully-masked-row thresholds depend on)."""
+    if mask is None:
+        return bias
+    from ..pallas._common import NEG_INF
+    mb = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
+    return mb if bias is None else bias + mb
 
 
 def _resolve_seq_parallel(seq_parallel, q, bias, mask):
@@ -172,8 +204,9 @@ def _warn_sp_fallback():
 @functools.lru_cache(None)
 def _warn_pallas_fallback():
     import warnings
-    warnings.warn("attn_backend='pallas' requested but bias/mask/dropout "
-                  "operands require the reference path; falling back")
+    warnings.warn("attn_backend='pallas' requested but the bias/mask "
+                  "operand shapes (or dropout without an rng) require the "
+                  "reference path; falling back")
 
 
 def _on_tpu():
@@ -190,10 +223,29 @@ def _pallas_available():
         return False
 
 
-def _auto_backend(q, bias, mask, dropout_rate, deterministic):
+def _pallas_operands_ok(q, k, bias, mask, drop_on, dropout_rng):
+    """Shapes the flash kernel's block specs can express: 4-D operands
+    with b/h/sq each full-size or broadcast (1) and sk full; dropout
+    needs an rng to seed the in-kernel hash."""
+    if drop_on and dropout_rng is None:
+        return False
+    b, sq, h, _ = q.shape
+    sk = k.shape[1]
+
+    def ok(t):
+        return t is None or (
+            t.ndim == 4
+            and t.shape[0] in (1, b) and t.shape[1] in (1, h)
+            and t.shape[2] in (1, sq) and t.shape[3] == sk)
+
+    return ok(bias) and ok(mask)
+
+
+def _auto_backend(q, k, bias, mask, drop_on, dropout_rng):
     head_dim = q.shape[-1]
     seq = q.shape[-3]
-    eligible = (_on_tpu() and _pallas_available() and bias is None
-                and mask is None and (dropout_rate == 0.0 or deterministic)
-                and head_dim in (64, 128, 256) and seq % 128 == 0)
+    eligible = (_on_tpu() and _pallas_available()
+                and head_dim in (64, 128, 256) and seq % 128 == 0
+                and _pallas_operands_ok(q, k, bias, mask, drop_on,
+                                        dropout_rng))
     return "pallas" if eligible else "reference"

@@ -80,10 +80,13 @@ def ulysses_attention(q, k, v, *, bias=None, mask=None, causal=False,
 
     bias/mask ([b|1, h|1, sq|1, sk]) ride into the region pre-sharded on
     the head dim to match the post-all-to-all head layout — no extra
-    collective. Dropout keeps EXACT parity with the replicated path: the
-    keep mask is sampled at global [b, h, sq, sk] shape with a sharding
-    constraint, and partitionable threefry generates each device's slice
-    bit-identically to the unsharded sample.
+    collective. Dropout keeps EXACT parity with the replicated path with
+    ZERO operand traffic: the attention core's counter-based keep hash is
+    keyed on GLOBAL (batch, head, row, col) coordinates, so each device
+    passes its head/batch offsets and regenerates precisely its tile of
+    the replicated sample — nothing of shape [sq, sk] is ever
+    materialized (on TPU the flash kernel samples in-tile; the dense
+    fallback fuses the hash into the softmax chain).
     """
     mesh = mesh or get_global_mesh()
     sp = mesh.shape[axis_name]
@@ -120,40 +123,45 @@ def ulysses_attention(q, k, v, *, bias=None, mask=None, causal=False,
     spec = _qkv_spec(q.shape, mesh, batch_axes, axis_name, head_axis)
     head_sub = ((head_axis, axis_name) if tp > 1 else (axis_name,))
 
-    keep = None
-    if dropout_on:
-        # global-shape sample, sharded like the local logits: each device
-        # generates exactly its [b, h/(tp*sp), sq, sk] slice
-        keep = jax.random.bernoulli(
-            dropout_rng, 1.0 - dropout_rate,
-            (q.shape[0], n_heads, seq_len, k.shape[1]))
-        keep = jax.lax.with_sharding_constraint(
-            keep, jax.sharding.NamedSharding(
-                mesh, _bhqk_spec(keep.shape, mesh, batch_axes, head_sub)))
-
     extras = [(name, t) for name, t in
-              (("bias", bias), ("mask", mask), ("keep", keep))
+              (("bias", bias), ("mask", mask),
+               ("dropout_rng", dropout_rng if dropout_on else None))
               if t is not None]
-    extra_specs = tuple(_bhqk_spec(t.shape, mesh, batch_axes, head_sub)
-                        for _, t in extras)
+    extra_specs = tuple(P() if name == "dropout_rng"
+                        else _bhqk_spec(t.shape, mesh, batch_axes, head_sub)
+                        for name, t in extras)
     extra_names = tuple(name for name, _ in extras)
+
+    # which batch axes the q spec actually shards (batch offset inputs)
+    batch_used = spec[0]
+    batch_used = (() if batch_used is None else
+                  batch_used if isinstance(batch_used, tuple)
+                  else (batch_used,))
 
     def local_fn(q, k, v, *extra):
         ops = dict(zip(extra_names, extra))
         # [b, s/sp, h, d] -> [b, s, h/sp, d]: the head<->seq swap
         q, k, v = (lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
                                   tiled=True) for t in (q, k, v))
-        if ops:
-            # operands force the dense core (the flash kernel takes no
-            # bias/mask/dropout) — same rule as the attention() dispatch
-            from ..ops.transformer.attention import _reference_attention
-            out = _reference_attention(
-                q, k, v, bias=ops.get("bias"), mask=ops.get("mask"),
-                causal=causal, softmax_scale=softmax_scale,
-                dropout_rate=dropout_rate, dropout_mask=ops.get("keep"),
-                deterministic=not dropout_on)
-        else:
-            out = attn_fn(q, k, v, causal=causal, softmax_scale=softmax_scale)
+        kwargs = {n: t for n, t in ops.items() if n != "dropout_rng"}
+        if dropout_on:
+            # global coordinates of this device's head/batch window, so
+            # the core's position-keyed dropout hash regenerates exactly
+            # the replicated sample's tile (see module docstring)
+            h_per_dev = local_heads // sp
+            head_off = lax.axis_index(axis_name) * h_per_dev
+            if tp > 1:
+                head_off = head_off + lax.axis_index(head_axis) * local_heads
+            batch_off = 0
+            for a in batch_used:
+                batch_off = batch_off * mesh.shape[a] + lax.axis_index(a)
+            batch_off = batch_off * q.shape[0]
+            kwargs.update(dropout_rate=dropout_rate,
+                          dropout_rng=ops["dropout_rng"],
+                          deterministic=False,
+                          dropout_offsets=(n_heads, head_off, batch_off))
+        out = attn_fn(q, k, v, causal=causal, softmax_scale=softmax_scale,
+                      **kwargs)
         # [b, s, h/sp, d] -> [b, s/sp, h, d]
         return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
