@@ -429,22 +429,22 @@ class SelfAttention(nn.Module):
                     self.sparsity_config, plen)[:, :, :sl, :sl]
                 if mask is not None:
                     pinned_mask = jnp.logical_and(pinned_mask, mask)
-            if self.dropout_rate > 0.0 and not deterministic:
-                # unlike the bias case this is recoverable — but silent
-                # divergence from the configured rate is not (ADVICE r3)
-                from ..utils.logging import warn_once
-                warn_once(
-                    "sparse attention has no dropout operand: the "
-                    "configured attention dropout rate "
-                    f"{self.dropout_rate} is NOT applied on the sparse "
-                    "path (dense attention applies it)")
+            # attention dropout rides both sparse sub-paths (r5): the
+            # block-sparse kernel fuses the flash kernel's counter-based
+            # keep hash; the dense-mask fallback samples identical bits
             if pinned_mask is not None:
                 out = attention(q, k, v, mask=pinned_mask,
+                                dropout_rate=self.dropout_rate,
+                                dropout_rng=dropout_rng,
+                                deterministic=deterministic,
                                 seq_parallel="none")
             else:
                 from ..ops.sparse_attention import sparse_attention
                 out = sparse_attention(q, k, v, self.sparsity_config,
-                                       attn_mask=mask)
+                                       attn_mask=mask,
+                                       dropout_rate=self.dropout_rate,
+                                       dropout_rng=dropout_rng,
+                                       deterministic=deterministic)
         else:
             out = attention(q, k, v, bias=bias, mask=mask, causal=causal,
                             dropout_rate=self.dropout_rate,

@@ -40,11 +40,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..pallas._common import NEG_INF
 from ..pallas._common import interpret_mode as _interpret
+from ..pallas.flash_attention import _seed_words, _tile_keep
 
 DEFAULT_TILE = 256     # fewer, fatter loop iterations when seq % 256 == 0
 MIN_TILE = 128
-NEG_INF = -1e30
 
 
 # ---------------------------------------------------------------------------
@@ -207,11 +208,16 @@ def _masked_scores(q, k_ref, mask_ref, ki, pid, scale, tile):
     return s, live, k
 
 
-def _fwd_kernel(idx_ref, pid_ref, cnt_ref,                 # SMEM
-                q_ref, k_ref, v_ref, mask_ref,             # VMEM in
-                o_ref, m_ref, l_ref, *, scale, d, tile):
-    hi, qi = pl.program_id(1), pl.program_id(2)
+def _fwd_kernel(*refs, scale, d, tile, dropout_rate, total_heads):
+    # refs: [idx, pid, cnt, seeds?] (SMEM) + [q, k, v, masks] + outputs
+    has_drop = dropout_rate > 0.0
+    (idx_ref, pid_ref, cnt_ref), rest = refs[:3], refs[3:]
+    sm_ref = rest[0] if has_drop else None
+    q_ref, k_ref, v_ref, mask_ref, o_ref, m_ref, l_ref = rest[1 if has_drop
+                                                              else 0:]
+    bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     q = q_ref[0, 0]
+    inv_keep = 1.0 / (1.0 - dropout_rate) if has_drop else 1.0
 
     def body(j, carry):
         acc, m_acc, l_acc = carry
@@ -223,6 +229,13 @@ def _fwd_kernel(idx_ref, pid_ref, cnt_ref,                 # SMEM
         p = jnp.where(live, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_acc - m_new)
         l_new = l_acc * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if has_drop:
+            # same counter-based keep bits as the flash kernel: the
+            # dense-mask fallback path samples identically, so the two
+            # sparse paths stay bit-compatible under dropout
+            keep = _tile_keep(sm_ref, bi, hi, qi * tile, ki * tile,
+                              (tile, tile), dropout_rate, total_heads)
+            p = jnp.where(keep, p * inv_keep, 0.0)
         acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
                                     preferred_element_type=jnp.float32)
         return acc, m_new, l_new
@@ -238,14 +251,18 @@ def _fwd_kernel(idx_ref, pid_ref, cnt_ref,                 # SMEM
     l_ref[0, 0] = safe
 
 
-def _dq_kernel(idx_ref, pid_ref, cnt_ref,
-               q_ref, k_ref, v_ref, do_ref, dl_ref, m_ref, l_ref, mask_ref,
-               dq_ref, *, scale, d, tile):
-    hi, qi = pl.program_id(1), pl.program_id(2)
+def _dq_kernel(*refs, scale, d, tile, dropout_rate, total_heads):
+    has_drop = dropout_rate > 0.0
+    (idx_ref, pid_ref, cnt_ref), rest = refs[:3], refs[3:]
+    sm_ref = rest[0] if has_drop else None
+    (q_ref, k_ref, v_ref, do_ref, dl_ref, m_ref, l_ref, mask_ref,
+     dq_ref) = rest[1 if has_drop else 0:]
+    bi, hi, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     q = q_ref[0, 0]
     do = do_ref[0, 0]
     delta = dl_ref[0, 0]
     m, l = m_ref[0, 0], l_ref[0, 0]
+    inv_keep = 1.0 / (1.0 - dropout_rate) if has_drop else 1.0
 
     def body(j, acc):
         ki = idx_ref[hi, qi, j]
@@ -254,6 +271,10 @@ def _dq_kernel(idx_ref, pid_ref, cnt_ref,
         v = v_ref[0, 0, pl.ds(ki * tile, tile), :]
         p = jnp.where(live, jnp.exp(s - m), 0.0) / l
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if has_drop:
+            keep = _tile_keep(sm_ref, bi, hi, qi * tile, ki * tile,
+                              (tile, tile), dropout_rate, total_heads)
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
         ds = (p * (dp - delta) * scale).astype(q.dtype)
         return acc + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
@@ -262,12 +283,16 @@ def _dq_kernel(idx_ref, pid_ref, cnt_ref,
     dq_ref[0, 0] = acc.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(idx_ref, pid_ref, cnt_ref,
-                q_ref, k_ref, v_ref, do_ref, dl_ref, m_ref, l_ref, mask_ref,
-                dk_ref, dv_ref, *, scale, d, tile):
-    hi, ki = pl.program_id(1), pl.program_id(2)
+def _dkv_kernel(*refs, scale, d, tile, dropout_rate, total_heads):
+    has_drop = dropout_rate > 0.0
+    (idx_ref, pid_ref, cnt_ref), rest = refs[:3], refs[3:]
+    sm_ref = rest[0] if has_drop else None
+    (q_ref, k_ref, v_ref, do_ref, dl_ref, m_ref, l_ref, mask_ref,
+     dk_ref, dv_ref) = rest[1 if has_drop else 0:]
+    bi, hi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     k = k_ref[0, 0]                          # this column's k tile
     v = v_ref[0, 0]
+    inv_keep = 1.0 / (1.0 - dropout_rate) if has_drop else 1.0
 
     def body(j, carry):
         dk_acc, dv_acc = carry
@@ -285,8 +310,15 @@ def _dkv_kernel(idx_ref, pid_ref, cnt_ref,
                       * scale, NEG_INF)
         p = jnp.where(live, jnp.exp(s - m), 0.0) / l
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if has_drop:
+            keep = _tile_keep(sm_ref, bi, hi, qi * tile, ki * tile,
+                              (tile, tile), dropout_rate, total_heads)
+            dfac = jnp.where(keep, inv_keep, 0.0)
+            dp = dp * dfac
+            pl_ = (p * dfac).astype(do.dtype)
+        else:
+            pl_ = p.astype(do.dtype)
         ds = (p * (dp - delta) * scale).astype(q.dtype)
-        pl_ = p.astype(do.dtype)
         dk_acc = dk_acc + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         dv_acc = dv_acc + jnp.dot(pl_.T, do, preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
@@ -315,103 +347,134 @@ def _specs(d, S, U, tile):
     return tile_q, full_kv, stat_q, full_stat, masks
 
 
-def _sparse_fwd(q, k, v, masks, idx, pid, cnt, scale, tile):
+def _drop_args(seeds):
+    """(extra scalar-prefetch operands, n_scalar, static kwargs pieces)."""
+    return ((seeds,), 4) if seeds is not None else ((), 3)
+
+
+def _sparse_fwd(q, k, v, masks, idx, pid, cnt, scale, tile, seeds=None,
+                dropout_rate=0.0, total_heads=1):
     b, h, S, d = q.shape
     U = masks.shape[0]
     tile_q, full_kv, stat_q, _, mask_spec = _specs(d, S, U, tile)
+    extra, nsp = _drop_args(seeds)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=nsp,
         grid=(b, h, S // tile),
         in_specs=[tile_q, full_kv, full_kv, mask_spec],
         out_specs=[tile_q, stat_q, stat_q])
     o, m, l = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, d=d, tile=tile),
+        functools.partial(_fwd_kernel, scale=scale, d=d, tile=tile,
+                          dropout_rate=dropout_rate if seeds is not None
+                          else 0.0, total_heads=total_heads),
         grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
                    jax.ShapeDtypeStruct((b, h, S, 1), jnp.float32),
                    jax.ShapeDtypeStruct((b, h, S, 1), jnp.float32)),
         interpret=_interpret(),
-    )(idx, pid, cnt, q, k, v, masks)
+    )(idx, pid, cnt, *extra, q, k, v, masks)
     return o, m, l
 
 
-def _sparse_dq(q, k, v, do, delta, m, l, masks, idx, pid, cnt, scale, tile):
+def _sparse_dq(q, k, v, do, delta, m, l, masks, idx, pid, cnt, scale, tile,
+               seeds=None, dropout_rate=0.0, total_heads=1):
     b, h, S, d = q.shape
     U = masks.shape[0]
     tile_q, full_kv, stat_q, _, mask_spec = _specs(d, S, U, tile)
+    extra, nsp = _drop_args(seeds)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=nsp,
         grid=(b, h, S // tile),
         in_specs=[tile_q, full_kv, full_kv, tile_q, stat_q, stat_q, stat_q,
                   mask_spec],
         out_specs=[tile_q])
     (dq,) = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, d=d, tile=tile),
+        functools.partial(_dq_kernel, scale=scale, d=d, tile=tile,
+                          dropout_rate=dropout_rate if seeds is not None
+                          else 0.0, total_heads=total_heads),
         grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),),
         interpret=_interpret(),
-    )(idx, pid, cnt, q, k, v, do, delta, m, l, masks)
+    )(idx, pid, cnt, *extra, q, k, v, do, delta, m, l, masks)
     return dq
 
 
-def _sparse_dkv(q, k, v, do, delta, m, l, masks, idx, pid, cnt, scale, tile):
+def _sparse_dkv(q, k, v, do, delta, m, l, masks, idx, pid, cnt, scale, tile,
+                seeds=None, dropout_rate=0.0, total_heads=1):
     b, h, S, d = q.shape
     U = masks.shape[0]
     _, full_kv, _, full_stat, mask_spec = _specs(d, S, U, tile)
     tile_k = pl.BlockSpec((1, 1, tile, d),
                           lambda bi, hi, ki, *_: (bi, hi, ki, 0))
+    extra, nsp = _drop_args(seeds)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=nsp,
         grid=(b, h, S // tile),
         in_specs=[full_kv, tile_k, tile_k, full_kv, full_stat, full_stat,
                   full_stat, mask_spec],
         out_specs=[tile_k, tile_k])
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, d=d, tile=tile),
+        functools.partial(_dkv_kernel, scale=scale, d=d, tile=tile,
+                          dropout_rate=dropout_rate if seeds is not None
+                          else 0.0, total_heads=total_heads),
         grid_spec=grid_spec,
         out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)),
         interpret=_interpret(),
-    )(idx, pid, cnt, q, k, v, do, delta, m, l, masks)
+    )(idx, pid, cnt, *extra, q, k, v, do, delta, m, l, masks)
     return dk, dv
 
 
 @functools.lru_cache(maxsize=16)
-def _build_sparse_fn(plan_key, scale):
+def _build_sparse_fn(plan_key, scale, dropout_rate, total_heads):
     """custom_vjp'd BHSD sparse attention bound to one compiled plan.
-    The plan's arrays are jit constants (they ARE the program)."""
+    The plan's arrays are jit constants (they ARE the program). With
+    dropout_rate > 0 the function takes a seeds operand (int32[4]:
+    [seed0, seed1, head_offset, batch_offset]) feeding the in-kernel
+    counter-based keep hash shared with the flash kernel."""
     plan = _PLAN_CACHE[plan_key]
     masks = jnp.asarray(plan.masks)
     kv = (jnp.asarray(plan.kv_idx), jnp.asarray(plan.kv_pid),
           jnp.asarray(plan.kv_cnt))
     qt = (jnp.asarray(plan.qt_idx), jnp.asarray(plan.qt_pid),
           jnp.asarray(plan.qt_cnt))
+    dkw = dict(dropout_rate=dropout_rate, total_heads=total_heads)
 
     @jax.custom_vjp
-    def fn(q, k, v):
-        o, _, _ = _sparse_fwd(q, k, v, masks, *kv, scale, plan.tile)
+    def fn(q, k, v, seeds):
+        o, _, _ = _sparse_fwd(q, k, v, masks, *kv, scale, plan.tile,
+                              seeds=seeds, **dkw)
         return o
 
-    def fwd(q, k, v):
-        o, m, l = _sparse_fwd(q, k, v, masks, *kv, scale, plan.tile)
-        return o, (q, k, v, o, m, l)
+    def fwd(q, k, v, seeds):
+        o, m, l = _sparse_fwd(q, k, v, masks, *kv, scale, plan.tile,
+                              seeds=seeds, **dkw)
+        return o, (q, k, v, seeds, o, m, l)
 
     def bwd(res, g):
-        q, k, v, o, m, l = res
+        q, k, v, seeds, o, m, l = res
         delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                         axis=-1, keepdims=True)
-        dq = _sparse_dq(q, k, v, g, delta, m, l, masks, *kv, scale, plan.tile)
+        dq = _sparse_dq(q, k, v, g, delta, m, l, masks, *kv, scale,
+                        plan.tile, seeds=seeds, **dkw)
         dk, dv = _sparse_dkv(q, k, v, g, delta, m, l, masks, *qt, scale,
-                             plan.tile)
-        return dq, dk, dv
+                             plan.tile, seeds=seeds, **dkw)
+        dseeds = (np.zeros(seeds.shape, jax.dtypes.float0)
+                  if seeds is not None else None)
+        return dq, dk, dv, dseeds
 
     fn.defvjp(fwd, bwd)
     return fn
 
 
-def block_sparse_attention(q, k, v, sparsity_config, *, softmax_scale=None):
+def block_sparse_attention(q, k, v, sparsity_config, *, softmax_scale=None,
+                           dropout_rate=0.0, dropout_rng=None,
+                           dropout_offsets=None):
     """q/k/v: [batch, seq, heads, head_dim] (BSHD). Sparse Pallas path;
-    returns None when the layout can't be tiled (caller falls back)."""
+    returns None when the layout can't be tiled (caller falls back).
+    Attention-probability dropout (reference: the Triton softmax kernel's
+    fused dropout) samples the flash kernel's position-keyed hash —
+    active when both dropout_rate and dropout_rng are set."""
     b, s, h, d = q.shape
     plan = compile_layout(sparsity_config, s)
     if plan is None or plan.n_heads != h:
@@ -421,7 +484,17 @@ def block_sparse_attention(q, k, v, sparsity_config, *, softmax_scale=None):
     except TypeError:
         return None   # uncacheable config: dense fallback
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
-    fn = _build_sparse_fn(plan_key, float(scale))
+    seeds = None
+    rate = 0.0
+    total_heads = h
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        rate = float(dropout_rate)
+        th, ho, bo = dropout_offsets or (h, 0, 0)
+        total_heads = int(th)
+        s0, s1 = _seed_words(dropout_rng)
+        seeds = jnp.stack([s0, s1, jnp.uint32(ho),
+                           jnp.uint32(bo)]).astype(jnp.int32)
+    fn = _build_sparse_fn(plan_key, float(scale), rate, total_heads)
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-    o = fn(qt, kt, vt)
+    o = fn(qt, kt, vt, seeds)
     return jnp.swapaxes(o, 1, 2)

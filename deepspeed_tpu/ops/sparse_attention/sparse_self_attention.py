@@ -60,17 +60,27 @@ def layout_to_dense_mask(config: SparsityConfig, seq_len: int):
 
 def sparse_attention(q, k, v, sparsity_config: SparsityConfig, *,
                      softmax_scale=None, key_padding_mask=None,
-                     attn_mask=None, backend: Optional[str] = None):
+                     attn_mask=None, backend: Optional[str] = None,
+                     dropout_rate=0.0, dropout_rng=None,
+                     deterministic=True):
     """q/k/v [batch, seq, heads, head_dim]; pattern from the config
-    (reference: SparseSelfAttention.forward).
+    (reference: SparseSelfAttention.forward, with the Triton softmax
+    kernel's fused attention dropout).
 
     backend: None = auto (Pallas kernel when the layout tiles and no
     extra masks are given), "pallas" = require the kernel, "dense" =
-    force the dense-mask path."""
+    force the dense-mask path. Dropout (dropout_rate > 0, deterministic
+    False, an rng given) is fused into the kernel via the flash kernel's
+    counter-based keep hash; the dense-mask path samples the identical
+    bits, so both paths agree bit-for-bit under dropout."""
     if backend not in (None, "dense", "pallas"):
         raise ValueError(f"sparse_attention backend must be None, 'dense' "
                          f"or 'pallas', got {backend!r}")
     s = q.shape[1]
+    drop_on = dropout_rate > 0.0 and not deterministic
+    if drop_on and dropout_rng is None:
+        raise ValueError("sparse_attention: dropout_rate > 0 with "
+                         "deterministic=False requires dropout_rng")
     if backend != "dense":
         extra_masks = key_padding_mask is not None or attn_mask is not None
         if backend == "pallas" and extra_masks:
@@ -96,8 +106,10 @@ def sparse_attention(q, k, v, sparsity_config: SparsityConfig, *,
         # XLA path; backend="pallas" forces it anyway (tests)
         if not extra_masks and (backend == "pallas" or on_tpu()):
             from .block_sparse_kernel import block_sparse_attention
-            out = block_sparse_attention(q, k, v, sparsity_config,
-                                         softmax_scale=softmax_scale)
+            out = block_sparse_attention(
+                q, k, v, sparsity_config, softmax_scale=softmax_scale,
+                dropout_rate=dropout_rate if drop_on else 0.0,
+                dropout_rng=dropout_rng if drop_on else None)
             if out is not None:
                 return out
             if backend == "pallas":
@@ -115,7 +127,8 @@ def sparse_attention(q, k, v, sparsity_config: SparsityConfig, *,
     # unidirectional causality (block AND element level) is encoded in
     # the dense mask by layout_to_dense_mask; no separate causal flag
     return attention(q, k, v, mask=mask, softmax_scale=softmax_scale,
-                     seq_parallel="none")
+                     dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                     deterministic=not drop_on, seq_parallel="none")
 
 
 class SparseSelfAttention(nn.Module):
