@@ -69,13 +69,14 @@ def initialize(args=None,
             engine = HostDrivenPipelineEngine(
                 model, cfg, loss_fn=loss_fn, sample_batch=sample_batch,
                 rng=rng, optimizer=optimizer, lr_scheduler=lr_scheduler,
-                mesh=mesh)
+                mesh=mesh, params=model_parameters)
         else:
             from .runtime.pipe.engine import PipelineEngine
             engine = PipelineEngine(model, cfg, loss_fn=loss_fn,
                                     sample_batch=sample_batch, rng=rng,
                                     mesh=mesh, optimizer=optimizer,
-                                    lr_scheduler=lr_scheduler)
+                                    lr_scheduler=lr_scheduler,
+                                    params=model_parameters)
     else:
         engine = DeepSpeedEngine(model, cfg, loss_fn=loss_fn,
                                  params=model_parameters,

@@ -61,7 +61,7 @@ class PipelineEngine(DeepSpeedEngine):
 
     def __init__(self, module: PipelineModule, config, *, loss_fn=None,
                  sample_batch=None, rng=None, mesh=None, optimizer=None,
-                 lr_scheduler=None):
+                 lr_scheduler=None, params=None):
         self.pipe = module
         if isinstance(config, dict):
             config = DeepSpeedConfig.from_dict(config)
@@ -70,7 +70,7 @@ class PipelineEngine(DeepSpeedEngine):
         loss_fn = loss_fn or module.loss_fn
         if loss_fn is None:
             raise DeepSpeedConfigError("PipelineModule requires a loss_fn")
-        super().__init__(module, config, loss_fn=loss_fn,
+        super().__init__(module, config, loss_fn=loss_fn, params=params,
                          sample_batch=sample_batch, rng=rng, mesh=mesh,
                          optimizer=optimizer, lr_scheduler=lr_scheduler)
         self.num_stages = dist.pp_world_size(self.mesh)
@@ -93,11 +93,12 @@ class PipelineEngine(DeepSpeedEngine):
 
     def _init_params(self, params, sample_batch):
         module = self.pipe
-        if params is not None:
-            raise NotImplementedError(
-                "pass sample_batch; pre-built params unsupported for pipeline")
         if sample_batch is None:
-            raise DeepSpeedConfigError("PipelineEngine needs sample_batch")
+            raise DeepSpeedConfigError(
+                "PipelineEngine needs sample_batch"
+                + (" (with params= it still derives the partitioning "
+                   "metadata from a tiny abstract init)" if params is not None
+                   else ""))
         ids = jnp.asarray(_init_kwargs(sample_batch)["input_ids"])
         r_embed, r_block, r_head = jax.random.split(self.rng, 3)
 
@@ -121,6 +122,36 @@ class PipelineEngine(DeepSpeedEngine):
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
             {"embed": emb_v, "blocks": blk_v, "head": head_v})
         self._build_param_shardings()
+
+        if params is not None:
+            # pre-built tree (e.g. a restored checkpoint): validate
+            # against the abstract init, then PARTITION it across the
+            # stage/TP/ZeRO axes with one device_put — loading a
+            # pretrained model into the pipeline is just a placement
+            import flax.core.meta as flax_meta
+            params = flax_meta.unbox(params)
+            want = self._param_shapes
+            if jax.tree.structure(params) != jax.tree.structure(want):
+                raise DeepSpeedConfigError(
+                    "params= tree structure does not match this "
+                    "PipelineModule's {embed, blocks, head} variables: "
+                    f"got {jax.tree.structure(params)}, want "
+                    f"{jax.tree.structure(want)}")
+            mismatch = [
+                f"{jax.tree_util.keystr(path)}: {p.shape}!={w.shape}"
+                for (path, p), w in zip(
+                    jax.tree_util.tree_flatten_with_path(params)[0],
+                    jax.tree.leaves(want))
+                if tuple(p.shape) != tuple(w.shape)]
+            if mismatch:
+                raise DeepSpeedConfigError(
+                    "params= shapes do not match the PipelineModule "
+                    f"(first mismatches: {mismatch[:3]})")
+            self.params = jax.jit(
+                lambda t: jax.tree.map(
+                    lambda p, w: p.astype(w.dtype), t, want),
+                out_shardings=self.param_shardings)(params)
+            return
 
         init_fn = jax.jit(
             lambda: jax.tree.map(

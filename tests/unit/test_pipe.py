@@ -110,7 +110,7 @@ def pipe_loss_fn(logits, batch):
     return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
 
 
-def make_pipe_engine(stages=4, n_micro=2):
+def make_pipe_engine(stages=4, n_micro=2, model_parameters=None, seed=7):
     block_kwargs = dict(n_heads=MCFG.n_heads, d_model=MCFG.d_model,
                         d_ff=MCFG.ffn_dim, causal=True, dtype=jnp.float32)
     module = PipelineModule(
@@ -130,8 +130,9 @@ def make_pipe_engine(stages=4, n_micro=2):
         0, VOCAB, size=(config["train_batch_size"], SEQ), dtype=np.int32)}
     engine, _, _, _ = ds.initialize(
         model=module, config=config, loss_fn=pipe_loss_fn,
+        model_parameters=model_parameters,
         sample_batch={"input_ids": batch["input_ids"][:1]},
-        rng=jax.random.PRNGKey(7), mesh=mesh)
+        rng=jax.random.PRNGKey(seed), mesh=mesh)
     return engine, batch
 
 
@@ -170,6 +171,33 @@ def test_pipeline_with_dp_axis():
     l0 = float(engine.train_batch(batch))
     l1 = float(engine.train_batch(batch))
     assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+
+def test_pipeline_accepts_prebuilt_params():
+    """VERDICT r4 #9: load-checkpoint-then-pipeline — a pre-built params
+    tree is validated and PARTITIONED across the stage mesh, and the
+    engine computes exactly what the originating engine did."""
+    engine, batch = make_pipe_engine(stages=4, n_micro=2)
+    params0 = jax.tree.map(np.asarray, engine.params)  # "the checkpoint"
+    want = float(engine.eval_batch(batch))
+
+    engine2, _ = make_pipe_engine(stages=4, n_micro=2,
+                                  model_parameters=params0, seed=99)
+    got = float(engine2.eval_batch(batch))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # placement actually happened: blocks are stage-sharded, and the
+    # engine trains from the restored state
+    leaf = jax.tree.leaves(engine2.params["blocks"])[0]
+    assert "stage" in str(leaf.sharding.spec)
+    assert np.isfinite(float(engine2.train_batch(batch)))
+
+
+def test_pipeline_prebuilt_params_mismatch_raises():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+    engine, batch = make_pipe_engine(stages=4, n_micro=2)
+    bad = jax.tree.map(lambda a: np.asarray(a)[..., :1], engine.params)
+    with pytest.raises(DeepSpeedConfigError, match="shapes"):
+        make_pipe_engine(stages=4, n_micro=2, model_parameters=bad)
 
 
 def test_blocks_sharded_over_stage():
@@ -237,6 +265,48 @@ class TestHostDrivenPipeline:
         assert isinstance(engine, HostDrivenPipelineEngine)
         losses = [float(engine.train_batch(batch)) for _ in range(8)]
         assert losses[-1] < losses[0] - 0.05, losses
+
+    def test_prebuilt_flat_params_partitioned_across_stages(self):
+        """params= as a flat per-layer list is split by the module's
+        stage boundaries (load-checkpoint-then-pipeline for the
+        host-driven executor)."""
+        module = self._hetero_module()
+        config = {"train_batch_size": 8, "gradient_accumulation_steps": 2,
+                  "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                  "steps_per_print": 1000}
+        rng = np.random.default_rng(2)
+        batch = {"input_ids": rng.integers(0, VOCAB, size=(8, SEQ),
+                                           dtype=np.int32)}
+        engine, _, _, _ = ds.initialize(
+            model=module, config=config, loss_fn=pipe_loss_fn,
+            sample_batch={"input_ids": batch["input_ids"][:1]},
+            rng=jax.random.PRNGKey(3))
+        want = float(engine.eval_batch(batch))
+        flat = [lp for stage in engine.params for lp in stage]
+
+        engine2, _, _, _ = ds.initialize(
+            model=self._hetero_module(), config=dict(config),
+            loss_fn=pipe_loss_fn, model_parameters=flat,
+            rng=jax.random.PRNGKey(44))
+        assert [len(s) for s in engine2.params] == \
+            [len(s) for s in engine.params]
+        got = float(engine2.eval_batch(batch))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+        from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+        with pytest.raises(DeepSpeedConfigError, match="flat list"):
+            ds.initialize(model=self._hetero_module(), config=dict(config),
+                          loss_fn=pipe_loss_fn, model_parameters=flat[:-1],
+                          rng=jax.random.PRNGKey(45))
+        # wrong-dimension checkpoint with a sample_batch: named-leaf error
+        # up front, not an XLA shape error inside the first stage
+        bad = [jax.tree.map(lambda a: np.asarray(a)[..., :1], lp)
+               for lp in flat]
+        with pytest.raises(DeepSpeedConfigError, match="shapes"):
+            ds.initialize(model=self._hetero_module(), config=dict(config),
+                          loss_fn=pipe_loss_fn, model_parameters=bad,
+                          sample_batch={"input_ids": batch["input_ids"][:1]},
+                          rng=jax.random.PRNGKey(46))
 
     def test_executor_matches_sequential(self):
         """Loss from the instruction-stream execution == running the same
