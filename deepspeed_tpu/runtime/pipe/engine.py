@@ -129,24 +129,13 @@ class PipelineEngine(DeepSpeedEngine):
             # stage/TP/ZeRO axes with one device_put — loading a
             # pretrained model into the pipeline is just a placement
             import flax.core.meta as flax_meta
+            from ...utils.tree import validate_params_tree
             params = flax_meta.unbox(params)
             want = self._param_shapes
-            if jax.tree.structure(params) != jax.tree.structure(want):
-                raise DeepSpeedConfigError(
-                    "params= tree structure does not match this "
-                    "PipelineModule's {embed, blocks, head} variables: "
-                    f"got {jax.tree.structure(params)}, want "
-                    f"{jax.tree.structure(want)}")
-            mismatch = [
-                f"{jax.tree_util.keystr(path)}: {p.shape}!={w.shape}"
-                for (path, p), w in zip(
-                    jax.tree_util.tree_flatten_with_path(params)[0],
-                    jax.tree.leaves(want))
-                if tuple(p.shape) != tuple(w.shape)]
-            if mismatch:
-                raise DeepSpeedConfigError(
-                    "params= shapes do not match the PipelineModule "
-                    f"(first mismatches: {mismatch[:3]})")
+            try:
+                validate_params_tree(params, want)
+            except ValueError as e:
+                raise DeepSpeedConfigError(str(e)) from None
             self.params = jax.jit(
                 lambda t: jax.tree.map(
                     lambda p, w: p.astype(w.dtype), t, want),

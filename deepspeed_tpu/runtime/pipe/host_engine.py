@@ -171,24 +171,11 @@ class HostDrivenPipelineEngine:
                 out.append(stage)
             return out
 
-        want = jax.eval_shape(build)
-        if jax.tree.structure(params) != jax.tree.structure(want):
-            raise DeepSpeedConfigError(
-                "params= variable tree structure does not match this "
-                "PipelineModule's layers: got "
-                f"{jax.tree.structure(params)}, want "
-                f"{jax.tree.structure(want)}")
-        mismatch = [
-            f"{jax.tree_util.keystr(path)}: {tuple(p.shape)}!="
-            f"{tuple(w.shape)}"
-            for (path, p), w in zip(
-                jax.tree_util.tree_flatten_with_path(params)[0],
-                jax.tree.leaves(want))
-            if tuple(p.shape) != tuple(w.shape)]
-        if mismatch:
-            raise DeepSpeedConfigError(
-                "params= shapes do not match the PipelineModule "
-                f"(first mismatches: {mismatch[:3]})")
+        from ...utils.tree import validate_params_tree
+        try:
+            validate_params_tree(params, jax.eval_shape(build))
+        except ValueError as e:
+            raise DeepSpeedConfigError(str(e)) from None
 
     def _place_micro(self, tree):
         """Shard a micro batch's leading dim over the data axis (no-op

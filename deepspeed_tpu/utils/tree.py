@@ -70,6 +70,27 @@ def _flatten_one_level(node):
     return children, treedef
 
 
+def validate_params_tree(params, want, what="params="):
+    """Fail fast with named leaves when a provided params tree doesn't
+    match an expected shape tree (e.g. a wrong-dimension checkpoint),
+    instead of an opaque XLA shape error later. Raises ValueError — the
+    pipeline engines wrap it in DeepSpeedConfigError."""
+    if jax.tree.structure(params) != jax.tree.structure(want):
+        raise ValueError(
+            f"{what} tree structure does not match the expected variables: "
+            f"got {jax.tree.structure(params)}, want "
+            f"{jax.tree.structure(want)}")
+    mismatch = [
+        f"{jtu.keystr(path)}: {tuple(p.shape)}!={tuple(w.shape)}"
+        for (path, p), w in zip(jtu.tree_flatten_with_path(params)[0],
+                                jax.tree.leaves(want))
+        if tuple(p.shape) != tuple(w.shape)]
+    if mismatch:
+        raise ValueError(
+            f"{what} shapes do not match the module "
+            f"(first mismatches: {mismatch[:3]})")
+
+
 def clip_grads_by_global_norm(grads, gnorm, clip):
     """Scale a grad tree so its global norm is at most ``clip`` — the one
     shared implementation for every non-optax step path (streamed host
