@@ -1,0 +1,29 @@
+"""Opt-in wrapper for the real-data convergence harness (reference
+analog: tests/model/Megatron_GPT2/run_sanity_check.py — model-level
+loss-curve checks kept out of the fast unit lane).
+
+Run with:  pytest tests/model -m real_data
+or directly:  python tests/model/run_convergence.py --preset tiny
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[2]
+
+
+@pytest.mark.real_data
+def test_tiny_gpt_converges_on_real_corpus_with_engine_optax_parity():
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tests/model/run_convergence.py"),
+         "--preset", "tiny", "--steps", "150"],
+        capture_output=True, text=True, timeout=1500)
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no report emitted:\n{r.stdout}\n{r.stderr}"
+    report = json.loads(lines[-1])
+    assert report["result"] == "PASS", report
+    assert r.returncode == 0
