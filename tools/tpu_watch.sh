@@ -7,6 +7,17 @@
 # interactive work in between).
 cd /root/repo || exit 1
 mkdir -p benchmarks/results
+
+# pathspec commit with retry: never sweep concurrently-staged WIP into an
+# artifact commit; retry rides out a transient index.lock
+commit_artifact() {
+  msg="$1"; shift
+  for i in 1 2 3; do
+    git add "$@" && git commit -q -m "${msg}" -- "$@" && return 0
+    sleep 5
+  done
+  return 1
+}
 while true; do
   if timeout 90 python -c "import jax; assert jax.default_backend() == 'tpu'; jax.devices()" >/dev/null 2>&1; then
     ts=$(date -u +%Y-%m-%dT%H%M%SZ)
@@ -21,12 +32,22 @@ while true; do
     # or a nonzero exit counts as a failed capture.
     if [ $rc -eq 0 ] && ! grep -q 'accelerator backend unreachable' "${out}"; then
       echo "[tpu_watch] bench done:"; tail -c 2000 "${out}"
-      for i in 1 2 3; do
-        # pathspec commit: never sweep concurrently-staged WIP into the
-        # artifact commit
-        git add "${out}" "${log}" && git commit -q -m "Bench artifact ${ts} (tpu_watch capture)" -- "${out}" "${log}" && break
-        sleep 5
-      done
+      commit_artifact "Bench artifact ${ts} (tpu_watch capture)" "${out}" "${log}"
+      # chip is up and quiet: also capture the int8 GEMV routing numbers
+      # (VERDICT #3) — staged + subprocess-guarded, can't wedge the loop.
+      # One-shot: skip once any gemv artifact is committed (a COMPLETE
+      # run, exit 0); partial/diagnostic JSONs are still committed but
+      # don't stop a later complete attempt.
+      if ! ls benchmarks/results/gemv_r5_*.done >/dev/null 2>&1; then
+        gout="benchmarks/results/gemv_r5_${ts}.json"
+        if timeout 2400 python tools/validate_gemv.py >"${gout}" 2>"${gout}.log"; then
+          touch "${gout%.json}.done"
+          echo "[tpu_watch] gemv validation complete:"; cat "${gout}"
+        else
+          echo "[tpu_watch] gemv validation incomplete (diagnostic JSON kept):"; cat "${gout}"
+        fi
+        commit_artifact "int8 GEMV hardware numbers ${ts} (tpu_watch capture)" "${gout}" "${gout}.log"
+      fi
       sleep 3600
     else
       echo "[tpu_watch] capture failed (bench exit=${rc}); keeping log, shelving artifact"

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Staged hardware validation of the m=1 int8 VPU GEMV decode path.
+
+VERDICT r4 #3: the GEMV (ops/pallas/wo_int8_matmul.py) is
+correctness-proven in interpret mode but was never timed on a chip — the
+tunnel died first — so int8 decode currently delivers capacity without
+speedup (the MXU path is weight-ingestion-bound at ~146 GB/s). This tool
+produces the routing decision's numbers.
+
+Design constraints (learned 2026-07-31): a pathological Mosaic lowering
+can WEDGE the tunneled backend for hours, so every stage runs in its own
+subprocess with a hard timeout (the child is killed and releases the
+device), and shapes escalate small -> large. Run it directly, or let
+tools/tpu_watch.sh invoke it after a successful bench capture.
+
+Output: ONE JSON line
+  {"stage1_ok": ..., "mxu_gbps": ..., "gemv_gbps": ..., "speedup": ...,
+   "recommend_default_gemv": bool}
+Exit 0 iff all stages completed (regardless of which path won).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+# stage timeouts are generous for first-compile on a live chip; tunable
+# down for smoke-testing the guard paths
+T1 = int(os.environ.get("DS_TPU_GEMV_STAGE1_TIMEOUT_S", "420"))
+T2 = int(os.environ.get("DS_TPU_GEMV_STAGE2_TIMEOUT_S", "600"))
+
+STAGE = r"""
+import os, sys, time
+import numpy as np
+import jax, jax.numpy as jnp
+flag, k, n, reps = sys.argv[1] == "1", int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
+os.environ["DS_TPU_INT8_GEMV"] = "1" if flag else "0"
+assert jax.default_backend() == "tpu", "not on TPU"
+sys.path.insert(0, "/root/repo")
+from deepspeed_tpu.ops.pallas.wo_int8_matmul import wo_int8_matmul
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.standard_normal((1, k)), jnp.bfloat16)
+q = jnp.asarray(rng.integers(-127, 127, size=(k, n)), jnp.int8)
+s = jnp.asarray(np.abs(rng.standard_normal((1, n))) * 0.01, jnp.float32)
+
+# correctness vs the dequant reference before timing anything
+got = np.asarray(wo_int8_matmul(x, q, s), np.float32)
+want = np.asarray(x.astype(jnp.float32) @ (q.astype(jnp.float32) * s), np.float32)
+err = float(np.max(np.abs(got - want)) / (np.max(np.abs(want)) + 1e-9))
+assert err < 2e-2, f"parity failed: rel err {err}"
+
+@jax.jit
+def g(x, q, s):
+    tot = jnp.float32(0)
+    for i in range(reps):
+        o = wo_int8_matmul(x + jnp.bfloat16(i) * 1e-6, q, s)
+        tot += o.reshape(-1)[0].astype(jnp.float32)
+    return tot
+
+_ = np.asarray(g(x, q, s))
+best = float("inf")
+for _ in range(3):
+    t0 = time.time()
+    _ = np.asarray(g(x, q, s))
+    best = min(best, time.time() - t0)
+print("RESULT", k * n / 1e9 / (best / reps), err)
+"""
+
+
+def run_stage(flag, k, n, reps, timeout):
+    try:
+        r = subprocess.run([sys.executable, "-c", STAGE,
+                            "1" if flag else "0", str(k), str(n), str(reps)],
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout {timeout}s (Mosaic wedge guard fired)"
+    if r.returncode != 0:
+        return None, (r.stderr or r.stdout).strip()[-300:]
+    for line in r.stdout.splitlines():
+        if line.startswith("RESULT"):
+            _, gbps, err = line.split()
+            return float(gbps), None
+    return None, "no RESULT line"
+
+
+def main():
+    out = {}
+    # stage 1: small shapes, GEMV path — the wedge-risk probe
+    gbps, err = run_stage(True, 512, 1024, 32, timeout=T1)
+    out["stage1_ok"] = err is None
+    if err is not None:
+        out["stage1_error"] = err
+        out["recommend_default_gemv"] = False
+        print(json.dumps(out))
+        return 1
+    # stage 2: decode-realistic shapes, both paths
+    mxu, e1 = run_stage(False, 4096, 16384, 64, timeout=T2)
+    gemv, e2 = run_stage(True, 4096, 16384, 64, timeout=T2)
+    out["mxu_gbps"] = mxu and round(mxu, 1)
+    out["gemv_gbps"] = gemv and round(gemv, 1)
+    if e1:
+        out["mxu_error"] = e1
+    if e2:
+        out["gemv_error"] = e2
+    if mxu and gemv:
+        out["speedup"] = round(gemv / mxu, 2)
+        # VERDICT acceptance: flip the default at >= 2x
+        out["recommend_default_gemv"] = gemv >= 2 * mxu
+    else:
+        out["recommend_default_gemv"] = False
+    print(json.dumps(out))
+    return 0 if (mxu and gemv) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
