@@ -227,6 +227,71 @@ class TestDispatch:
                                    rtol=2e-5, atol=2e-5)
 
 
+def test_fully_masked_rows_emit_zeros_and_zero_grads():
+    """Rows whose every key is masked out must produce exactly 0 output
+    (safe-denominator path) and exactly 0 gradients — not NaN from
+    exp(-inf - -inf) chains. The reference dense path softmaxes a
+    uniform row instead; all-masked rows are a kernel-only contract."""
+    q, k, v = _qkv(seed=15)
+    mask = jnp.ones((B, 1, S, S), bool).at[:, :, :8, :].set(False)
+    out = _flash(q, k, v, mask=mask, causal=True)
+    np.testing.assert_array_equal(np.asarray(out[:, :8]), 0.0)
+    assert np.isfinite(np.asarray(out)).all()
+    g = jax.grad(lambda q, k, v: (_flash(q, k, v, mask=mask, causal=True)
+                                  ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for name, t in zip("qkv", g):
+        assert np.isfinite(np.asarray(t)).all(), f"d{name} has NaN/inf"
+    np.testing.assert_array_equal(np.asarray(g[0][:, :8]), 0.0)
+
+
+def test_dropout_stable_under_remat():
+    """jax.checkpoint replays the forward during backward; the
+    counter-based seeds are operands, so the replayed keep mask is
+    bit-identical and remat grads equal non-remat grads. (A stateful
+    PRNG would silently decorrelate fwd and replay here.)"""
+    q, k, v = _qkv(seed=13)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    plain = lambda q, k, v: _flash(q, k, v, dropout=True)
+    remat = jax.checkpoint(plain)
+    base = jax.grad(loss(plain), argnums=(0, 1, 2))(q, k, v)
+    ckpt = jax.grad(loss(remat), argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", base, ckpt):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f"d{name} differs under remat")
+
+
+def test_cross_attention_shapes_with_operands():
+    """sq != sk (the causal_shift path): operands + dropout must use the
+    right absolute coordinates on both the short-q and long-k sides."""
+    rng = np.random.default_rng(14)
+    sq, sk = 128, 256
+    q = jnp.asarray(rng.standard_normal((B, sq, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, sk, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, sk, H, D)), jnp.float32)
+    mask = jnp.ones((B, 1, 1, sk), bool).at[:, :, :, -11:].set(False)
+    keep = fa.attention_dropout_keep(KEY, RATE, (B, H, sq, sk))
+    want = _reference_attention(q, k, v, mask=mask, causal=True,
+                                dropout_rate=RATE, dropout_mask=keep,
+                                deterministic=False)
+    got = _flash(q, k, v, mask=mask, dropout=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    gw = jax.grad(lambda q, k, v: (_reference_attention(
+        q, k, v, mask=mask, causal=True, dropout_rate=RATE,
+        dropout_mask=keep, deterministic=False) ** 2).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(lambda q, k, v: (_flash(q, k, v, mask=mask,
+                                          dropout=True) ** 2).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gw, gg):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=f"d{name} (cross-attn)")
+
+
 class TestUlyssesFlashDropout:
     """Ulysses + dropout now runs the flash kernel per shard — no global
     [sq, sk] keep mask, no dense core."""
