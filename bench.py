@@ -61,6 +61,22 @@ def _floor_subtract(ms, floor_key, keys):
     return out, invalid
 
 
+def _unrolled_timer(np, jax, jnp, f, args, reps):
+    """REPS independent applications UNROLLED inside one jit (each on a
+    perturbed first input, one scalar reduced per application): the one
+    dispatch+fetch RTT amortizes over reps without lax.scan loop overhead
+    polluting ms-scale kernels. Shared by the kernel microbenches."""
+    @jax.jit
+    def g(*a):
+        tot = jnp.float32(0)
+        for i in range(reps):
+            o = f(a[0] + jnp.asarray(i, a[0].dtype) * 1e-6, *a[1:])
+            tot = tot + o.reshape(-1)[0].astype(jnp.float32)
+        return tot
+    _ = np.asarray(g(*args))   # warm (compile)
+    return g
+
+
 def _fetch(tree):
     """Force the dependency chain with a device->host scalar copy
     (block_until_ready can ack early through remote-relay backends)."""
@@ -446,17 +462,7 @@ def bench_sparse_kernel(np, jax, jnp, seq=8192, heads=8, d=64, batch=2):
                              jnp.bfloat16)
     q, k, v = mk(), mk(), mk()
     REPS = 32
-
-    def make(f):
-        @jax.jit
-        def g(q, k, v):
-            tot = jnp.float32(0)
-            for i in range(REPS):
-                o = f(q + jnp.asarray(i, q.dtype) * 1e-6, k, v)
-                tot = tot + o.reshape(-1)[0].astype(jnp.float32)
-            return tot
-        _ = np.asarray(g(q, k, v))   # warm (compile)
-        return g
+    make = lambda f: _unrolled_timer(np, jax, jnp, f, (q, k, v), REPS)
 
     # both paths are opaque pallas_calls (no DCE asymmetry); subtract the
     # dispatch+fetch floor
@@ -493,17 +499,7 @@ def bench_flash_dropout(np, jax, jnp, batch=2, seq=2048, heads=16, d=64,
         rng.standard_normal((batch, seq, heads, d)), jnp.bfloat16)
     q, k, v = mk(), mk(), mk()
     key = jax.random.PRNGKey(3)
-
-    def make(f):
-        @jax.jit
-        def g(q, k, v):
-            tot = jnp.float32(0)
-            for i in range(reps):
-                o = f(q + jnp.asarray(i, q.dtype) * 1e-6, k, v)
-                tot = tot + o.reshape(-1)[0].astype(jnp.float32)
-            return tot
-        _ = np.asarray(g(q, k, v))   # warm (compile)
-        return g
+    make = lambda f: _unrolled_timer(np, jax, jnp, f, (q, k, v), reps)
 
     fns = {"floor": make(lambda a, b, c: a[:1, :1, :1, :1]),
            "flash_dropout": make(lambda a, b, c: flash_attention(

@@ -104,6 +104,15 @@ def _seed_words(key):
     return data[0], w1
 
 
+def pack_dropout_seeds(dropout_rng, head_offset=0, batch_offset=0):
+    """int32[4] SMEM operand for the in-kernel keep hash:
+    [seed0, seed1, head_offset, batch_offset]. Shared by the flash and
+    block-sparse kernels."""
+    s0, s1 = _seed_words(dropout_rng)
+    return jnp.stack([s0, s1, jnp.uint32(head_offset),
+                      jnp.uint32(batch_offset)]).astype(jnp.int32)
+
+
 def attention_dropout_keep(dropout_rng, rate, shape, total_heads=None,
                            head_offset=0, batch_offset=0,
                            q_offset=0, k_offset=0):
@@ -913,9 +922,7 @@ def flash_attention(q, k, v, *, bias=None, causal=True, softmax_scale=None,
         rate = float(dropout_rate)
         th, ho, bo = dropout_offsets or (q.shape[2], 0, 0)
         total_heads = int(th)
-        s0, s1 = _seed_words(dropout_rng)
-        seeds = jnp.stack([s0, s1, jnp.uint32(ho),
-                           jnp.uint32(bo)]).astype(jnp.int32)
+        seeds = pack_dropout_seeds(dropout_rng, ho, bo)
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     o = _flash_attention_bhsd(qt, kt, vt, bias4, seeds, scale, causal,
                               rate, bq, total_heads)

@@ -42,7 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..pallas._common import NEG_INF
 from ..pallas._common import interpret_mode as _interpret
-from ..pallas.flash_attention import _seed_words, _tile_keep
+from ..pallas.flash_attention import pack_dropout_seeds, _tile_keep
 
 DEFAULT_TILE = 256     # fewer, fatter loop iterations when seq % 256 == 0
 MIN_TILE = 128
@@ -491,9 +491,7 @@ def block_sparse_attention(q, k, v, sparsity_config, *, softmax_scale=None,
         rate = float(dropout_rate)
         th, ho, bo = dropout_offsets or (h, 0, 0)
         total_heads = int(th)
-        s0, s1 = _seed_words(dropout_rng)
-        seeds = jnp.stack([s0, s1, jnp.uint32(ho),
-                           jnp.uint32(bo)]).astype(jnp.int32)
+        seeds = pack_dropout_seeds(dropout_rng, ho, bo)
     fn = _build_sparse_fn(plan_key, float(scale), rate, total_heads)
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     o = fn(qt, kt, vt, seeds)

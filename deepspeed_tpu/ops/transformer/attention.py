@@ -157,8 +157,8 @@ def _resolve_seq_parallel(seq_parallel, q, bias, mask):
         if seq_parallel in ("ulysses", "ring"):
             _warn_sp_no_axis()  # explicit request, but no seq axis to use
         return "none"
-    # bias/mask/dropout ride along (sharded operands / partitionable
-    # threefry); only SHAPES disqualify: decode-time q (seq=1 chunks,
+    # bias/mask/dropout ride along (sharded operands / the position-keyed
+    # keep hash); only SHAPES disqualify: decode-time q (seq=1 chunks,
     # XLA all-gathers the seq shards transparently) and operands whose
     # broadcast dims the region specs can't express (b/h/sq must be 1 or
     # full-size, the forms every model in models/ produces).

@@ -105,26 +105,36 @@ class HostDrivenPipelineEngine:
             if sample_batch is None:
                 raise DeepSpeedConfigError("HostDrivenPipelineEngine needs "
                                            "sample_batch (or params=)")
-            ids = jnp.asarray(sample_batch["input_ids"]
-                              if isinstance(sample_batch, dict)
-                              else sample_batch)
-            from flax.core import meta as flax_meta
-            params: List[List[Any]] = []
-            x = ids
-            key = self.rng
-            for layers in self.stage_layers:
-                stage_params = []
-                for layer in layers:
-                    key, sub = jax.random.split(key)
-                    variables = flax_meta.unbox(layer.init(sub, x))
-                    stage_params.append(variables)
-                    x = layer.apply(variables, x)
-                params.append(stage_params)
+            params = self._build_stage_params(self._sample_ids(sample_batch))
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             rep = NamedSharding(self.mesh, P())
             params = jax.tree.map(lambda a: jax.device_put(a, rep), params)
         self.params = params
+
+    @staticmethod
+    def _sample_ids(sample_batch):
+        return jnp.asarray(sample_batch["input_ids"]
+                           if isinstance(sample_batch, dict)
+                           else sample_batch)
+
+    def _build_stage_params(self, ids):
+        """Per-stage per-layer variables from the module's init chain —
+        run directly for fresh init, or under jax.eval_shape (abstract
+        ids, zero FLOPs) as the validation oracle for a pre-built tree."""
+        from flax.core import meta as flax_meta
+        params: List[List[Any]] = []
+        x = ids
+        key = self.rng
+        for layers in self.stage_layers:
+            stage_params = []
+            for layer in layers:
+                key, sub = jax.random.split(key)
+                variables = flax_meta.unbox(layer.init(sub, x))
+                stage_params.append(variables)
+                x = layer.apply(variables, x)
+            params.append(stage_params)
+        return params
 
     def _partition_prebuilt(self, prebuilt):
         """Partition a provided params tree across stages: accepts a FLAT
@@ -155,25 +165,12 @@ class HostDrivenPipelineEngine:
         """Fail fast with named leaves on a wrong-dimension checkpoint
         (same contract as the SPMD engine's params= path) instead of an
         opaque XLA shape error inside the first jitted stage."""
-        from flax.core import meta as flax_meta
-        ids = jnp.asarray(sample_batch["input_ids"]
-                          if isinstance(sample_batch, dict) else sample_batch)
-
-        def build():
-            x, key, out = ids, self.rng, []
-            for layers in self.stage_layers:
-                stage = []
-                for layer in layers:
-                    key, sub = jax.random.split(key)
-                    v = flax_meta.unbox(layer.init(sub, x))
-                    stage.append(v)
-                    x = layer.apply(v, x)
-                out.append(stage)
-            return out
-
         from ...utils.tree import validate_params_tree
+        ids = self._sample_ids(sample_batch)
+        want = jax.eval_shape(self._build_stage_params,
+                              jax.ShapeDtypeStruct(ids.shape, ids.dtype))
         try:
-            validate_params_tree(params, jax.eval_shape(build))
+            validate_params_tree(params, want)
         except ValueError as e:
             raise DeepSpeedConfigError(str(e)) from None
 

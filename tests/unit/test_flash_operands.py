@@ -49,13 +49,9 @@ def _dense(q, k, v, bias=None, mask=None, causal=True, dropout=False):
 
 def _flash(q, k, v, bias=None, mask=None, causal=True, dropout=False,
            **kw):
-    from deepspeed_tpu.ops.pallas._common import NEG_INF
-    comb = bias
-    if mask is not None:
-        mb = jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
-        comb = mb if bias is None else bias + mb
+    from deepspeed_tpu.ops.transformer.attention import _combined_bias
     return fa.flash_attention(
-        q, k, v, bias=comb, causal=causal,
+        q, k, v, bias=_combined_bias(bias, mask), causal=causal,
         dropout_rate=RATE if dropout else 0.0,
         dropout_rng=KEY if dropout else None, **kw)
 
