@@ -5,6 +5,12 @@ attention runs either as Ulysses (all-to-all head<->seq swap) or ring
 attention (K/V blocks rotating by ppermute). Per-chip activation memory
 scales 1/seq_parallel_degree, so context length scales with the ring.
 
+Attention + residual dropout are ON, as in a real pretraining config:
+the attention core fuses the keep mask into the flash kernel from a
+position-keyed hash, so dropout costs no operand traffic and nothing of
+shape [seq, seq] is ever materialized — the config that used to force
+the dense O(s^2) fallback under sequence parallelism.
+
 Run (e.g. 8-way virtual CPU mesh):
   XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
     python examples/train_long_context_sp.py
@@ -36,6 +42,7 @@ def main():
                     n_layers=8, n_heads=8, dtype=jnp.bfloat16,
                     rotary=True, learned_pos=False,
                     seq_parallel="ring",      # or "ulysses"
+                    dropout_rate=0.1, attn_dropout_rate=0.1,
                     remat="dots")
     if smoke:
         # same attention path, tiny dims (one config so the smoke run
@@ -47,7 +54,8 @@ def main():
 
     def loss_fn(model, params, batch, rng, train):
         ids = batch["input_ids"]
-        logits = model.apply(params, ids, deterministic=not train)
+        logits = model.apply(params, ids, deterministic=not train,
+                             rngs={"dropout": rng} if train else {})
         return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
 
     dp = mesh.shape["data"]
