@@ -42,15 +42,54 @@ GEMV_BLOCK_K = 1024
 
 def _gemv_enabled() -> bool:
     """The m=1 VPU GEMV is numerically proven (interpret-mode parity
-    across the shape matrix) but its Mosaic lowering has not yet been
-    timed on a real chip — the axon tunnel died before the perf run
-    (2026-07-31). Opt in with DS_TPU_INT8_GEMV=1; the default stays the
-    measured MXU path so the benchmark artifact can't regress on an
-    unvalidated codepath. Flip the default once hardware numbers exist
-    (analysis says ~5x: MXU weight ingestion caps m=1 at ~146 GB/s vs
-    ~820 GB/s HBM)."""
-    from ...utils import env_flag
-    return env_flag("DS_TPU_INT8_GEMV")
+    across the shape matrix) but its Mosaic lowering had not been timed
+    on a real chip when this shipped — the axon tunnel died before the
+    perf run (2026-07-31), so routing is CALIBRATION-DRIVEN:
+
+    - DS_TPU_INT8_GEMV=1 / =0 forces the path either way;
+    - otherwise, if a committed hardware-calibration artifact
+      (benchmarks/results/gemv_r5_*.json, produced by
+      tools/validate_gemv.py — tools/tpu_watch.sh runs it automatically
+      on the first tunnel-up window) recommends the GEMV at >= 2x the
+      MXU path, it becomes the default;
+    - with no artifact, the default stays the measured MXU path so the
+      benchmark can't regress on an unvalidated codepath (analysis says
+      ~5x: MXU weight ingestion caps m=1 at ~146 GB/s vs ~820 GB/s HBM).
+    """
+    import os
+    # any SET value (including '' / '0', false per env_flag) is an
+    # explicit override; only an absent variable defers to calibration
+    if os.environ.get("DS_TPU_INT8_GEMV") is not None:
+        from ...utils import env_flag
+        return env_flag("DS_TPU_INT8_GEMV")
+    return _gemv_calibration()
+
+
+@functools.lru_cache(None)
+def _gemv_calibration() -> bool:
+    """Newest committed gemv calibration artifact's recommendation, False
+    when none exists (source checkouts only — the artifact dir isn't
+    shipped in wheels, which is fine: calibration is per-fleet anyway)."""
+    import glob
+    import json
+    import os
+    root = os.environ.get(
+        "DS_TPU_GEMV_CALIBRATION_DIR",
+        os.path.join(os.path.dirname(__file__), "..", "..", "..",
+                     "benchmarks", "results"))
+    arts = sorted(glob.glob(os.path.join(root, "gemv_r5_*.json")))
+    for path in reversed(arts):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        # only COMPLETE runs (both paths measured -> "speedup" present)
+        # carry routing authority; a later wedged/partial diagnostic must
+        # not revoke an earlier successful calibration
+        if "speedup" in rec and "recommend_default_gemv" in rec:
+            return bool(rec["recommend_default_gemv"])
+    return False
 
 
 def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_kb, out_dtype):

@@ -24,10 +24,9 @@ requires_tpu = pytest.mark.skipif(
 
 
 def _measure(flag_on, monkeypatch, k=4096, n=16384, reps=64):
-    if flag_on:
-        monkeypatch.setenv("DS_TPU_INT8_GEMV", "1")
-    else:
-        monkeypatch.delenv("DS_TPU_INT8_GEMV", raising=False)
+    # explicit both ways: unset now means calibration-driven routing, and
+    # a committed artifact would silently turn the "MXU arm" into GEMV
+    monkeypatch.setenv("DS_TPU_INT8_GEMV", "1" if flag_on else "0")
     from deepspeed_tpu.ops.pallas.wo_int8_matmul import wo_int8_matmul
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((1, k)), jnp.bfloat16)
