@@ -379,6 +379,20 @@ class TestGemvCalibrationRouting:
 class TestWOInt8Matmul:
     """Fused-dequant int8 matmul (reference: pt_binding.cpp int8 gemms)."""
 
+    @pytest.fixture(autouse=True)
+    def _no_calibration(self, monkeypatch, tmp_path):
+        """Pin calibration-driven m=1 routing to its no-artifact default:
+        once tpu_watch commits a real gemv_r5_*.json into
+        benchmarks/results, unset-env test runs would silently flip to
+        the GEMV path and lose MXU coverage."""
+        import importlib
+        mod = importlib.import_module(
+            "deepspeed_tpu.ops.pallas.wo_int8_matmul")
+        monkeypatch.setenv("DS_TPU_GEMV_CALIBRATION_DIR", str(tmp_path))
+        mod._gemv_calibration.cache_clear()
+        yield
+        mod._gemv_calibration.cache_clear()
+
     def _mk(self, m, k, n, seed=0):
         key = jax.random.PRNGKey(seed)
         x = jax.random.normal(key, (m, k), jnp.float32)

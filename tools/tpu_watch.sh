@@ -2,11 +2,14 @@
 # Continuous axon-tunnel watcher: on every tunnel-up window, run bench.py
 # once, save the artifact under benchmarks/results/, and commit it. Probes
 # use a hard timeout in a subprocess so a hung jax.devices() never wedges
-# anything; after a successful capture it idles an hour before the next
-# (one artifact per up-window is plenty; the chip should stay free for
-# interactive work in between).
+# anything; after a successful capture it idles an hour before the next.
+# The watcher EXITS after two successful bench captures: the evidence
+# exists by then, and the chip must stay free for the driver's own
+# end-of-round bench run (whose probe-retry window is ~30 min — shorter
+# than an extra watcher capture could hold the chip).
 cd /root/repo || exit 1
 mkdir -p benchmarks/results
+captures=0
 
 # pathspec commit with retry: never sweep concurrently-staged WIP into an
 # artifact commit; retry rides out a transient index.lock
@@ -21,39 +24,49 @@ commit_artifact() {
 while true; do
   if timeout 90 python -c "import jax; assert jax.default_backend() == 'tpu'; jax.devices()" >/dev/null 2>&1; then
     ts=$(date -u +%Y-%m-%dT%H%M%SZ)
-    out="benchmarks/results/bench_r5_${ts}.json"
-    log="benchmarks/results/bench_r5_${ts}.log"
-    echo "[tpu_watch] tunnel LIVE at ${ts}; running bench"
-    DS_TPU_BENCH_PROBE_WINDOW_S=300 timeout 3600 python bench.py >"${out}" 2>"${log}"
-    rc=$?
-    # A null top-level value with measured sub-benches is a PARTIAL
-    # artifact (one sub-bench crashed) — still worth committing. Only the
-    # watchdog's no-measurement artifact (its distinctive error string)
-    # or a nonzero exit counts as a failed capture.
-    if [ $rc -eq 0 ] && ! grep -q 'accelerator backend unreachable' "${out}"; then
-      echo "[tpu_watch] bench done:"; tail -c 2000 "${out}"
-      commit_artifact "Bench artifact ${ts} (tpu_watch capture)" "${out}" "${log}"
-      # chip is up and quiet: also capture the int8 GEMV routing numbers
-      # (VERDICT #3) — staged + subprocess-guarded, can't wedge the loop.
-      # One-shot: skip once any gemv artifact is committed (a COMPLETE
-      # run, exit 0); partial/diagnostic JSONs are still committed but
-      # don't stop a later complete attempt.
-      if ! ls benchmarks/results/gemv_r5_*.done >/dev/null 2>&1; then
-        gout="benchmarks/results/gemv_r5_${ts}.json"
-        if timeout 2400 python tools/validate_gemv.py >"${gout}" 2>"${gout}.log"; then
-          touch "${gout%.json}.done"
-          echo "[tpu_watch] gemv validation complete:"; cat "${gout}"
-        else
-          echo "[tpu_watch] gemv validation incomplete (diagnostic JSON kept):"; cat "${gout}"
+    bench_ok=1
+    if [ "${captures}" -lt 2 ]; then
+      out="benchmarks/results/bench_r5_${ts}.json"
+      log="benchmarks/results/bench_r5_${ts}.log"
+      echo "[tpu_watch] tunnel LIVE at ${ts}; running bench"
+      DS_TPU_BENCH_PROBE_WINDOW_S=300 timeout 3600 python bench.py >"${out}" 2>"${log}"
+      rc=$?
+      # A null top-level value with measured sub-benches is a PARTIAL
+      # artifact (one sub-bench crashed) — still worth committing. Only
+      # the watchdog's no-measurement artifact (its distinctive error
+      # string) or a nonzero exit counts as a failed capture.
+      if [ $rc -eq 0 ] && ! grep -q 'accelerator backend unreachable' "${out}"; then
+        echo "[tpu_watch] bench done:"; tail -c 2000 "${out}"
+        # the capture only counts once it is actually in git
+        if commit_artifact "Bench artifact ${ts} (tpu_watch capture)" "${out}" "${log}"; then
+          captures=$((captures + 1))
         fi
-        commit_artifact "int8 GEMV hardware numbers ${ts} (tpu_watch capture)" "${gout}" "${gout}.log"
+      else
+        bench_ok=0
+        echo "[tpu_watch] capture failed (bench exit=${rc}); keeping log, shelving artifact"
+        mv "${out}" "${out}.failed" 2>/dev/null
       fi
-      sleep 3600
-    else
-      echo "[tpu_watch] capture failed (bench exit=${rc}); keeping log, shelving artifact"
-      mv "${out}" "${out}.failed" 2>/dev/null
-      sleep 600
     fi
+    # int8 GEMV routing numbers (VERDICT #3): retried on every up-window
+    # until a COMPLETE run is captured AND committed (the .done sentinel
+    # is written only then); partial diagnostics are committed but don't
+    # end the retries. Staged + subprocess-guarded, can't wedge the loop.
+    if ! ls benchmarks/results/gemv_r5_*.done >/dev/null 2>&1; then
+      gout="benchmarks/results/gemv_r5_${ts}.json"
+      if timeout 2400 python tools/validate_gemv.py >"${gout}" 2>"${gout}.log"; then
+        echo "[tpu_watch] gemv validation complete:"; cat "${gout}"
+        commit_artifact "int8 GEMV hardware numbers ${ts} (tpu_watch capture)" "${gout}" "${gout}.log" \
+          && touch "${gout%.json}.done"
+      else
+        echo "[tpu_watch] gemv validation incomplete (diagnostic JSON kept):"; cat "${gout}"
+        commit_artifact "int8 GEMV diagnostic ${ts} (tpu_watch capture)" "${gout}" "${gout}.log"
+      fi
+    fi
+    if [ "${captures}" -ge 2 ] && ls benchmarks/results/gemv_r5_*.done >/dev/null 2>&1; then
+      echo "[tpu_watch] bench x${captures} + gemv calibration committed; exiting to leave the chip free"
+      exit 0
+    fi
+    if [ "${bench_ok}" -eq 1 ]; then sleep 3600; else sleep 600; fi
   else
     echo "[tpu_watch] tunnel down at $(date -u +%H:%M:%S)"
     sleep 120
