@@ -600,12 +600,15 @@ def _device_watchdog(probe_timeout_s=None, interval_s=None, window_s=None):
     import threading
     import time as _time
 
-    probe_timeout_s = probe_timeout_s or int(
-        os.environ.get("DS_TPU_BENCH_PROBE_TIMEOUT_S", "120"))
-    interval_s = interval_s or int(
-        os.environ.get("DS_TPU_BENCH_PROBE_INTERVAL_S", "60"))
-    window_s = window_s or int(
-        os.environ.get("DS_TPU_BENCH_PROBE_WINDOW_S", "1800"))
+    if probe_timeout_s is None:
+        probe_timeout_s = int(
+            os.environ.get("DS_TPU_BENCH_PROBE_TIMEOUT_S", "120"))
+    if interval_s is None:
+        interval_s = int(
+            os.environ.get("DS_TPU_BENCH_PROBE_INTERVAL_S", "60"))
+    if window_s is None:
+        window_s = int(
+            os.environ.get("DS_TPU_BENCH_PROBE_WINDOW_S", "1800"))
 
     deadline = _time.monotonic() + window_s
     attempt = 0

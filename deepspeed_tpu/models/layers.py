@@ -409,7 +409,7 @@ class SelfAttention(nn.Module):
             # Block-sparse pattern path (reference: SparseSelfAttention
             # wired into BERT via SparseAttentionUtils). The layout encodes
             # causality for unidirectional configs; additive bias (ALiBi)
-            # and attention dropout have no reference sparse analog.
+            # has no reference sparse analog (dropout does ride it — below).
             _check_sparse_compat(self.sparsity_config, bias, causal)
             plen = self.sparsity_pattern_len
             pinned_mask = None

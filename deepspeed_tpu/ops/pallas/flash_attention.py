@@ -113,6 +113,19 @@ def pack_dropout_seeds(dropout_rng, head_offset=0, batch_offset=0):
                       jnp.uint32(batch_offset)]).astype(jnp.int32)
 
 
+def resolve_dropout(dropout_rate, dropout_rng, dropout_offsets,
+                    default_heads):
+    """(rate, seeds, total_heads) for a kernel dispatch — the ONE place
+    the offsets contract is interpreted, shared by the flash and
+    block-sparse dispatchers so they can never sample different bits.
+    rate 0 / missing rng disables (seeds None)."""
+    if dropout_rate <= 0.0 or dropout_rng is None:
+        return 0.0, None, int(default_heads)
+    th, ho, bo = dropout_offsets or (default_heads, 0, 0)
+    return float(dropout_rate), pack_dropout_seeds(dropout_rng, ho, bo), \
+        int(th)
+
+
 def attention_dropout_keep(dropout_rng, rate, shape, total_heads=None,
                            head_offset=0, batch_offset=0,
                            q_offset=0, k_offset=0):
@@ -709,7 +722,8 @@ def _dbias_dense(q, k, v, o, lse, g, bias, seeds, scale, causal,
     return dbias.astype(bias.dtype)
 
 
-def _flash_bwd(scale, causal, dropout_rate, block_q, total_heads, res, g):
+def _flash_bwd(scale, causal, dropout_rate, block_q, total_heads,
+               bias_grad, res, g):
     q, k, v, bias, seeds, o, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -719,8 +733,17 @@ def _flash_bwd(scale, causal, dropout_rate, block_q, total_heads, res, g):
     common = dict(scale=scale, causal=causal, has_bias=has_bias,
                   dropout_rate=drop, total_heads=total_heads)
 
-    dbias = (_dbias_dense(q, k, v, o, lse, g, bias, seeds, scale, causal,
-                          drop, total_heads) if has_bias else None)
+    # bias_grad=False (statically known non-trainable bias, e.g. a folded
+    # mask): zero cotangent at the bias's own (broadcast) shape — the
+    # dense O(s^2) recompute is never built, which matters in EAGER grads
+    # where XLA's DCE can't elide it
+    if not has_bias:
+        dbias = None
+    elif bias_grad:
+        dbias = _dbias_dense(q, k, v, o, lse, g, bias, seeds, scale,
+                             causal, drop, total_heads)
+    else:
+        dbias = jnp.zeros_like(bias)
     dseeds = (np.zeros(seeds.shape, jax.dtypes.float0)
               if seeds is not None else None)
 
@@ -864,16 +887,16 @@ def _flash_bwd(scale, causal, dropout_rate, block_q, total_heads, res, g):
     return (dq, dk, dv, dbias, dseeds)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash_attention_bhsd(q, k, v, bias, seeds, scale, causal,
-                          dropout_rate, block_q, total_heads):
+                          dropout_rate, block_q, total_heads, bias_grad):
     o, _ = _flash_fwd(q, k, v, bias, seeds, scale, causal, dropout_rate,
                       total_heads, block_q)
     return o
 
 
 def _fwd_rule(q, k, v, bias, seeds, scale, causal, dropout_rate, block_q,
-              total_heads):
+              total_heads, bias_grad):
     o, lse = _flash_fwd(q, k, v, bias, seeds, scale, causal, dropout_rate,
                         total_heads, block_q)
     return o, (q, k, v, bias, seeds, o, lse)
@@ -884,11 +907,15 @@ _flash_attention_bhsd.defvjp(_fwd_rule, _flash_bwd)
 
 def flash_attention(q, k, v, *, bias=None, causal=True, softmax_scale=None,
                     dropout_rate=0.0, dropout_rng=None, dropout_offsets=None,
-                    block_q=DEFAULT_BLOCK_Q):
+                    bias_grad=True, block_q=DEFAULT_BLOCK_Q):
     """q,k,v: [batch, seq, heads, head_dim] (BSHD). Returns like q.
 
     bias: optional additive [b|1, h|1, sq|1, sk] operand (fold boolean
     masks to 0/-1e30 before calling — ``ops.transformer.attention`` does).
+    bias_grad=False declares the bias non-trainable (masks, alibi): the
+    backward rule then emits a zero cotangent instead of the dense dBias
+    recompute — under jit the recompute is DCE'd anyway when unused, but
+    eager-mode grads would otherwise pay its O(s^2) cost.
     dropout_rate/dropout_rng: fused attention-probability dropout (active
     when both are set). dropout_offsets: (total_heads, head_offset,
     batch_offset) so shard_map callers with local head/batch windows
@@ -915,15 +942,9 @@ def flash_attention(q, k, v, *, bias=None, causal=True, softmax_scale=None,
         # full-extent biases ride VMEM in bf16 (the kernel adds in fp32);
         # broadcast-q biases (masks, alibi rows) are small — keep fp32
         bias4 = bias.astype(q.dtype if bias.shape[2] > 1 else jnp.float32)
-    seeds = None
-    total_heads = q.shape[2]
-    rate = 0.0
-    if dropout_rate > 0.0 and dropout_rng is not None:
-        rate = float(dropout_rate)
-        th, ho, bo = dropout_offsets or (q.shape[2], 0, 0)
-        total_heads = int(th)
-        seeds = pack_dropout_seeds(dropout_rng, ho, bo)
+    rate, seeds, total_heads = resolve_dropout(
+        dropout_rate, dropout_rng, dropout_offsets, q.shape[2])
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     o = _flash_attention_bhsd(qt, kt, vt, bias4, seeds, scale, causal,
-                              rate, bq, total_heads)
+                              rate, bq, total_heads, bool(bias_grad))
     return jnp.swapaxes(o, 1, 2)

@@ -42,7 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..pallas._common import NEG_INF
 from ..pallas._common import interpret_mode as _interpret
-from ..pallas.flash_attention import pack_dropout_seeds, _tile_keep
+from ..pallas.flash_attention import resolve_dropout, _tile_keep
 
 DEFAULT_TILE = 256     # fewer, fatter loop iterations when seq % 256 == 0
 MIN_TILE = 128
@@ -484,14 +484,8 @@ def block_sparse_attention(q, k, v, sparsity_config, *, softmax_scale=None,
     except TypeError:
         return None   # uncacheable config: dense fallback
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
-    seeds = None
-    rate = 0.0
-    total_heads = h
-    if dropout_rate > 0.0 and dropout_rng is not None:
-        rate = float(dropout_rate)
-        th, ho, bo = dropout_offsets or (h, 0, 0)
-        total_heads = int(th)
-        seeds = pack_dropout_seeds(dropout_rng, ho, bo)
+    rate, seeds, total_heads = resolve_dropout(
+        dropout_rate, dropout_rng, dropout_offsets, h)
     fn = _build_sparse_fn(plan_key, float(scale), rate, total_heads)
     qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
     o = fn(qt, kt, vt, seeds)

@@ -124,7 +124,10 @@ def attention(q, k, v, bias=None, mask=None, *, causal=False,
             softmax_scale=softmax_scale,
             dropout_rate=dropout_rate if drop_on else 0.0,
             dropout_rng=dropout_rng if drop_on else None,
-            dropout_offsets=dropout_offsets)
+            dropout_offsets=dropout_offsets,
+            # a mask-only combined bias is statically non-trainable: let
+            # eager grads skip the dense dBias recompute
+            bias_grad=bias is not None)
     return _reference_attention(q, k, v, bias=bias, mask=mask, causal=causal,
                                 softmax_scale=softmax_scale,
                                 dropout_rate=dropout_rate,

@@ -223,6 +223,25 @@ class TestDispatch:
                                    rtol=2e-5, atol=2e-5)
 
 
+def test_bias_grad_false_emits_zero_cotangent():
+    """bias_grad=False (statically non-trainable bias, e.g. a folded
+    mask): the bias cotangent is exact zeros at the bias's shape and the
+    q/k/v grads are unchanged — the eager-mode escape from the dense
+    dBias recompute."""
+    q, k, v = _qkv(seed=16)
+    bias = jnp.asarray(np.random.default_rng(4).standard_normal(
+        (1, H, 1, S)), jnp.float32)
+    gb = jax.grad(lambda b_: (fa.flash_attention(
+        q, k, v, bias=b_, causal=True, bias_grad=False) ** 2).sum())(bias)
+    assert gb.shape == bias.shape
+    np.testing.assert_array_equal(np.asarray(gb), 0.0)
+    gq1 = jax.grad(lambda q: (fa.flash_attention(
+        q, k, v, bias=bias, causal=True, bias_grad=False) ** 2).sum())(q)
+    gq2 = jax.grad(lambda q: (fa.flash_attention(
+        q, k, v, bias=bias, causal=True) ** 2).sum())(q)
+    np.testing.assert_array_equal(np.asarray(gq1), np.asarray(gq2))
+
+
 def test_fully_masked_rows_emit_zeros_and_zero_grads():
     """Rows whose every key is masked out must produce exactly 0 output
     (safe-denominator path) and exactly 0 gradients — not NaN from

@@ -36,7 +36,7 @@ import jax, jax.numpy as jnp
 flag, k, n, reps = sys.argv[1] == "1", int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4])
 os.environ["DS_TPU_INT8_GEMV"] = "1" if flag else "0"
 assert jax.default_backend() == "tpu", "not on TPU"
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, sys.argv[5])   # repo root, from the parent
 from deepspeed_tpu.ops.pallas.wo_int8_matmul import wo_int8_matmul
 rng = np.random.default_rng(0)
 x = jnp.asarray(rng.standard_normal((1, k)), jnp.bfloat16)
@@ -67,10 +67,14 @@ print("RESULT", k * n / 1e9 / (best / reps), err)
 """
 
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 def run_stage(flag, k, n, reps, timeout):
     try:
         r = subprocess.run([sys.executable, "-c", STAGE,
-                            "1" if flag else "0", str(k), str(n), str(reps)],
+                            "1" if flag else "0", str(k), str(n), str(reps),
+                            REPO_ROOT],
                            capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
         return None, f"timeout {timeout}s (Mosaic wedge guard fired)"
