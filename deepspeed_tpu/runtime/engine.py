@@ -798,10 +798,18 @@ class DeepSpeedEngine:
         metrics["skipped"] = jnp.int32(0 if finite else 1)
         return new_scaler, metrics
 
-    def train_batch(self, batch: Dict[str, Any]):
+    def train_batch(self, batch: Dict[str, Any], **loss_kwargs):
         """One full optimizer step over a global batch
         [train_batch_size, ...] (reference: PipelineEngine.train_batch
-        naming; for the base engine this fuses fwd+bwd+step)."""
+        naming; for the base engine this fuses fwd+bwd+step).
+
+        ``loss_kwargs``: extra keyword operands forwarded to
+        ``loss_fn(model, params, batch, rng, train, **loss_kwargs)`` as
+        TRACED arrays (stable shapes across steps -> no recompiles, no
+        per-microbatch splitting, no batch-dim constraint). The channel
+        for inputs that aren't per-example data — e.g. the other model's
+        parameters in adversarial (GAN) training, auxiliary targets, or
+        schedule scalars."""
         cfg = self.config
         gas = cfg.gradient_accumulation_steps
         micro_global = cfg.train_micro_batch_size_per_gpu * self.dp_world_size
@@ -838,7 +846,7 @@ class DeepSpeedEngine:
         self._sync_activation_quantization()
         scaler = self.loss_scale_state or init_loss_scale(1.0)
         rng = jax.random.fold_in(self.rng, self.global_steps + 1)
-        extra = {}
+        extra = dict(loss_kwargs)
         if (self.progressive_layer_drop is not None
                 and self._loss_accepts("layer_keep_prob")):
             theta = self.progressive_layer_drop.update_state(self.global_steps)
@@ -966,11 +974,13 @@ class DeepSpeedEngine:
     # reference-style forward / backward / step calling convention
     # ------------------------------------------------------------------
 
-    def forward(self, batch: Dict[str, Any]):
+    def forward(self, batch: Dict[str, Any], **loss_kwargs):
         """Compute loss AND cache grads for the following backward()
         (autodiff needs the forward anyway; caching avoids recompute).
         Applies the same curriculum truncation / PLD theta as the fused
-        train_batch path."""
+        train_batch path. ``loss_kwargs`` is the same traced extra-operand
+        channel train_batch accepts (see there) — both calling
+        conventions stay capability-equal."""
         self._ensure_params_resident()
         self._sync_activation_quantization()
         if "fwd_grads" not in self._compiled:
@@ -1006,7 +1016,7 @@ class DeepSpeedEngine:
                 lambda x: x[:, :seqlen]
                 if (hasattr(x, "ndim") and x.ndim >= 2
                     and x.shape[1] > seqlen) else x, batch)
-        extra = {}
+        extra = dict(loss_kwargs)
         if (self.progressive_layer_drop is not None
                 and self._loss_accepts("layer_keep_prob")):
             theta = self.progressive_layer_drop.update_state(self.global_steps)
@@ -1105,15 +1115,16 @@ class DeepSpeedEngine:
             log_dist(f"step={self.global_steps} lr={self.get_lr():.3e} "
                      f"grad_norm={float(gnorm):.3f}", ranks=[0])
 
-    def eval_batch(self, batch: Dict[str, Any]):
+    def eval_batch(self, batch: Dict[str, Any], **loss_kwargs):
         self._ensure_params_resident()
         self._sync_activation_quantization()
         if "eval" not in self._compiled:
             model, loss_fn = self.module, self._loss_fn
             self._compiled["eval"] = jax.jit(
-                lambda p, b: loss_fn(model, p, b, jax.random.PRNGKey(0), False))
+                lambda p, b, e: loss_fn(model, p, b, jax.random.PRNGKey(0),
+                                        False, **e))
         batch = self._place_batch(batch, with_gas_dim=False)
-        return self._compiled["eval"](self.params, batch)
+        return self._compiled["eval"](self.params, batch, loss_kwargs)
 
     # ------------------------------------------------------------------
     # accessors (reference: engine.py:464-762 config property zoo)
