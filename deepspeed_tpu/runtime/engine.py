@@ -339,6 +339,20 @@ class DeepSpeedEngine:
                     "XLA; use stage3_param_persistence_threshold to control "
                     "which params stay replicated")
 
+    def _remember_extra(self, extra, loss_kwargs):
+        """Record the step's extra-operand STRUCTURE for later consumers
+        (flops-profiler lowering; MoQ eigenvalue refresh). Caller
+        loss_kwargs are remembered as abstract ShapeDtypeStructs — keeping
+        live values would pin (and, once the producing engine's next
+        donated step deletes them, dangle) another model's buffers between
+        steps; engine-internal scalars stay concrete."""
+        abstract_kwargs = {
+            k: jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
+                                               jnp.result_type(a)), v)
+            for k, v in loss_kwargs.items()}
+        self._last_extra = {**extra, **abstract_kwargs}
+
     def _init_params(self, params, sample_batch):
         cfg = self.config
         zcfg = cfg.zero_optimization
@@ -851,7 +865,7 @@ class DeepSpeedEngine:
                 and self._loss_accepts("layer_keep_prob")):
             theta = self.progressive_layer_drop.update_state(self.global_steps)
             extra["layer_keep_prob"] = jnp.float32(theta)  # traced: no recompile
-        self._last_extra = extra
+        self._remember_extra(extra, loss_kwargs)
         if (self.moq_quantizer is not None
                 and self.moq_quantizer.config.eigenvalue_enabled
                 and self.config.eigenvalue.enabled):
@@ -955,6 +969,16 @@ class DeepSpeedEngine:
             return
         from .eigenvalue import post_process_eigenvalues
         model, loss_fn, rng = self.module, self._loss_fn, self.rng
+        if any(isinstance(leaf, jax.ShapeDtypeStruct)
+               for leaf in jax.tree.leaves(self._last_extra,
+                                           is_leaf=lambda x: isinstance(
+                                               x, jax.ShapeDtypeStruct))):
+            from ..utils.logging import warn_once
+            warn_once("MoQ eigenvalue refresh skipped: loss_kwargs operands "
+                      "are remembered only abstractly (live cross-engine "
+                      "buffers must not be retained between steps) and the "
+                      "HVP loop needs their values")
+            return
         mb, extra = self._last_eval_batch, dict(self._last_extra)
         values = self._eigenvalue.compute_eigenvalue(
             lambda p: loss_fn(model, p, mb, rng, True, **extra),
@@ -1021,7 +1045,7 @@ class DeepSpeedEngine:
                 and self._loss_accepts("layer_keep_prob")):
             theta = self.progressive_layer_drop.update_state(self.global_steps)
             extra["layer_keep_prob"] = jnp.float32(theta)
-        self._last_extra = extra
+        self._remember_extra(extra, loss_kwargs)
         batch = self._place_batch(batch, with_gas_dim=False)
         rng = jax.random.fold_in(self.rng, self.micro_steps + 1)
         self.timers(FORWARD_GLOBAL_TIMER).start()
