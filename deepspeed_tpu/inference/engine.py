@@ -38,12 +38,12 @@ class InferenceEngine:
                  quantize_min_size: int = 4096,
                  offload_params: bool = False, **kwargs):
         dist.init_distributed()
-        # serving never fake-quantizes activations: clear any rule table a
-        # compression-training engine left in this process (the table is
-        # process-global; a distillation teacher served next to a
-        # quantized student must run clean)
-        from ..models.layers import set_activation_quantization
-        set_activation_quantization(None)
+        # serving never fake-quantizes activations. The rule table is
+        # process-global, so DON'T clear it (a concurrently-training
+        # compression engine would silently lose fake-quant on its next
+        # retrace); instead this engine's own traces run under a
+        # rules-suspended scope (_clean_trace below) — a distillation
+        # teacher serves clean while the student keeps quantizing.
         self.module = model
         self.dtype = dtype
         self.mp_world_size = mp_size
@@ -57,11 +57,18 @@ class InferenceEngine:
         self._compiled: Dict[str, Any] = {}
         self._param_transform = None
 
+        # remember the architecture config + policy for checkpoint loading
+        # (a raw HF state dict can't describe its own architecture)
+        import flax.linen as nn
+        self._hf_config = (None if isinstance(model, nn.Module)
+                           else getattr(model, "config", model))
+        self._injection_policy = injection_policy
+
         if replace_with_kernel_inject and model is not None:
             from ..module_inject.replace_module import replace_transformer_layer
             self.module, self.params = replace_transformer_layer(
                 model, params=self.params, policy=injection_policy,
-                dtype=dtype, mesh=mesh)
+                dtype=dtype, mesh=mesh, checkpoint=checkpoint)
             self._injected = True
 
         if self.params is None and checkpoint is not None:
@@ -155,8 +162,9 @@ class InferenceEngine:
 
     def _load_checkpoint(self, checkpoint):
         from ..module_inject.load_checkpoint import load_model_checkpoint
-        self.params = load_model_checkpoint(self.module, checkpoint, self.mesh,
-                                            dtype=self.dtype)
+        self.params = load_model_checkpoint(
+            self.module, checkpoint, self.mesh, dtype=self.dtype,
+            policy=self._injection_policy, hf_config=self._hf_config)
 
     def forward(self, *args, **kwargs):
         """Jitted module forward (compiled once per shape — the XLA analog
@@ -175,7 +183,9 @@ class InferenceEngine:
                 lambda p, a, kw: module.apply(
                     {"params": transform(p) if transform else p},
                     *a, **kw, **static))
-        return self._compiled[key](self.params, args, arrays)
+        from ..models.layers import activation_quantization_suspended
+        with activation_quantization_suspended():
+            return self._compiled[key](self.params, args, arrays)
 
     __call__ = forward
 
@@ -200,5 +210,7 @@ class InferenceEngine:
             cache_len = min(cache_len, model_max)
         kwargs.setdefault("max_len", cache_len)
         kwargs.setdefault("param_transform", self._param_transform)
-        return _generate(self.module, self.params, input_ids,
-                         max_new_tokens=max_new_tokens, **kwargs)
+        from ..models.layers import activation_quantization_suspended
+        with activation_quantization_suspended():
+            return _generate(self.module, self.params, input_ids,
+                             max_new_tokens=max_new_tokens, **kwargs)

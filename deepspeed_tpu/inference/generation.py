@@ -34,9 +34,8 @@ def init_cache(module, params, batch_size: int, max_len: int):
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shape)
 
 
-@partial(jax.jit, static_argnums=(0, 5))
-def _prefill(module, params, cache, input_ids, positions,
-             param_transform=None):
+def _prefill_impl(module, params, cache, input_ids, positions,
+                  param_transform=None):
     if param_transform is not None:
         params = param_transform(params)
     logits, vars_out = module.apply(
@@ -45,47 +44,96 @@ def _prefill(module, params, cache, input_ids, positions,
     return logits, vars_out["cache"]
 
 
+_prefill = jax.jit(_prefill_impl, static_argnums=(0, 5))
+# generate() flows the cache linearly, so its entry copy can be donated —
+# at serving scale the cache is GB-class and the duplicate costs real HBM
+# headroom. Callers that deliberately REUSE a cache across calls (bench's
+# percentile sampling, tests) use the non-donating _prefill/_decode_loop.
+_prefill_donating = jax.jit(_prefill_impl, static_argnums=(0, 5),
+                            donate_argnums=(2,))
+
+
+def _sampling_mode(temperature, top_k, top_p):
+    """STRUCTURE (which sampling features are active) is compile-time;
+    the VALUES stay traced so a temperature/top-k/top-p sweep reuses one
+    executable (the engine.forward contract — weak #10 — applied to the
+    decode loop). Concrete Python numbers decide the flags; traced
+    inputs keep the feature on with the value as an operand."""
+    greedy = isinstance(temperature, (int, float)) and temperature == 0.0
+    has_k = top_k is not None and not (isinstance(top_k, int) and top_k <= 0)
+    has_p = top_p is not None and not (
+        isinstance(top_p, (int, float)) and top_p >= 1.0)
+    t = jnp.float32(0.0 if temperature is None else temperature)
+    k = jnp.int32(0 if top_k is None else top_k)
+    p = jnp.float32(1.0 if top_p is None else top_p)
+    return greedy, has_k, has_p, t, k, p
+
+
 def _sample(logits, rng, temperature, top_k, top_p):
-    """logits: [batch, vocab] -> [batch] token ids."""
-    if temperature == 0.0:
+    """logits: [batch, vocab] -> [batch] token ids (values may be traced)."""
+    greedy, has_k, has_p, t, k, p = _sampling_mode(temperature, top_k, top_p)
+    return _sample_impl(logits, rng, t, k, p, greedy, has_k, has_p)
+
+
+def _sample_impl(logits, rng, t, k, p, greedy, has_k, has_p):
+    if greedy:
         return jnp.argmax(logits, axis=-1)
-    logits = logits.astype(jnp.float32) / temperature
-    if top_k is not None and top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+    logits = logits.astype(jnp.float32) / t
+    if has_k:
+        # k-th largest via a traced slice into the ascending sort
+        asc = jnp.sort(logits, axis=-1)
+        kth = jax.lax.dynamic_slice_in_dim(
+            asc, jnp.clip(asc.shape[-1] - k, 0, asc.shape[-1] - 1), 1,
+            axis=-1)
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p is not None and top_p < 1.0:
+    if has_p:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # smallest set with cumulative prob >= top_p: keep logits >= cutoff
-        keep = cum - probs < top_p
+        keep = cum - probs < p
         cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
                          keepdims=True)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-@partial(jax.jit, static_argnums=(0, 5, 6, 7, 8, 10))
-def _decode_loop(module, params, cache, last_token, start_pos,
-                 num_steps: int, temperature: float, top_k, top_p, rng,
-                 param_transform=None):
+def _decode_loop_impl(module, params, cache, last_token, start_pos,
+                      num_steps, t, k, p, rng, param_transform,
+                      greedy, has_k, has_p):
     """Scan num_steps single-token forwards; returns [batch, num_steps]."""
 
     def step(carry, i):
         cache, token, pos = carry
         # transform INSIDE the body: int8 weights stay the resident copy;
         # the dequantized operands are step-transient (fused into the dots)
-        p = param_transform(params) if param_transform is not None else params
+        p_ = param_transform(params) if param_transform is not None else params
         logits, vars_out = module.apply(
-            {"params": p, "cache": cache}, token[:, None], decode=True,
+            {"params": p_, "cache": cache}, token[:, None], decode=True,
             positions=pos[None], mutable=["cache"])
-        nxt = _sample(logits[:, -1, :], jax.random.fold_in(rng, i),
-                      temperature, top_k, top_p)
+        nxt = _sample_impl(logits[:, -1, :], jax.random.fold_in(rng, i),
+                           t, k, p, greedy, has_k, has_p)
         return (vars_out["cache"], nxt, pos + 1), nxt
 
     (cache, _, _), tokens = jax.lax.scan(
         step, (cache, last_token, start_pos), jnp.arange(num_steps))
     return jnp.transpose(tokens), cache
+
+
+_decode_jit = jax.jit(_decode_loop_impl,
+                      static_argnums=(0, 5, 10, 11, 12, 13))
+_decode_jit_donating = jax.jit(_decode_loop_impl,
+                               static_argnums=(0, 5, 10, 11, 12, 13),
+                               donate_argnums=(2,))
+
+
+def _decode_loop(module, params, cache, last_token, start_pos,
+                 num_steps: int, temperature: float, top_k, top_p, rng,
+                 param_transform=None, donate_cache: bool = False):
+    greedy, has_k, has_p, t, k, p = _sampling_mode(temperature, top_k, top_p)
+    fn = _decode_jit_donating if donate_cache else _decode_jit
+    return fn(module, params, cache, last_token, start_pos, num_steps,
+              t, k, p, rng, param_transform, greedy, has_k, has_p)
 
 
 def generate(module, params, input_ids, *, max_new_tokens: int = 32,
@@ -122,15 +170,17 @@ def generate(module, params, input_ids, *, max_new_tokens: int = 32,
     # `total` are never valid — the in-kernel length mask covers them)
     cache_len = (total + 127) // 128 * 128
     cache = init_cache(module, params, b, cache_len)
-    logits, cache = _prefill(module, params, cache, input_ids,
-                             jnp.arange(prompt_len), param_transform)
+    logits, cache = _prefill_donating(module, params, cache, input_ids,
+                                      jnp.arange(prompt_len),
+                                      param_transform)
     first = _sample(logits[:, -1, :], rng, temperature, top_k, top_p)
 
     if max_new_tokens > 1:
         rest, cache = _decode_loop(
             module, params, cache, first, jnp.int32(prompt_len),
             max_new_tokens - 1, temperature, top_k, top_p,
-            jax.random.fold_in(rng, 2**31), param_transform)
+            jax.random.fold_in(rng, 2**31), param_transform,
+            donate_cache=True)
         out = jnp.concatenate([input_ids, first[:, None], rest], axis=1)
     else:
         out = jnp.concatenate([input_ids, first[:, None]], axis=1)

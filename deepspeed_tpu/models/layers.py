@@ -92,6 +92,24 @@ def set_activation_quantization(rules):
     _ACT_QUANT_RULES = list(rules or [])
 
 
+class activation_quantization_suspended:
+    """Context manager: trace with the rule table empty, then restore.
+    Lets an InferenceEngine (e.g. a distillation teacher) compile clean
+    forwards in the same process as a compression-training engine whose
+    global rules must survive its own retraces."""
+
+    def __enter__(self):
+        global _ACT_QUANT_RULES
+        self._saved = _ACT_QUANT_RULES
+        _ACT_QUANT_RULES = []
+        return self
+
+    def __exit__(self, *exc):
+        global _ACT_QUANT_RULES
+        _ACT_QUANT_RULES = self._saved
+        return False
+
+
 def _maybe_quantize_activation(x, module_path):
     if not _ACT_QUANT_RULES:
         return x
@@ -137,6 +155,9 @@ def dense_init(names, scale=1.0):
 
 
 def _is_qleaf(x):
+    """THE quantized-leaf predicate: a {"q", "scale"} dict produced by
+    module_inject.module_quantize (which imports this — one definition,
+    or QDense and the quantizer silently disagree on the layout)."""
     return isinstance(x, dict) and set(x.keys()) == {"q", "scale"}
 
 

@@ -73,11 +73,20 @@ def _load_torch_file(path: str) -> Dict[str, np.ndarray]:
     if "module" in sd and isinstance(sd["module"], dict):
         sd = sd["module"]  # reference engine checkpoints nest under 'module'
     out = {}
+    dropped = []
     for k, v in sd.items():
         if hasattr(v, "detach"):
             v = v.detach().cpu()
             v = v.float() if v.is_floating_point() else v
             out[k] = v.numpy()
+        elif isinstance(v, np.ndarray):
+            out[k] = v          # checkpoints re-saved with numpy values
+        else:
+            dropped.append(k)   # metadata (steps, config dicts, ...)
+    if dropped and not out:
+        raise ValueError(
+            f"{path}: no tensor values found (first non-tensor keys: "
+            f"{dropped[:5]}) — not a weights checkpoint?")
     return out
 
 
@@ -120,9 +129,10 @@ def load_model_checkpoint(module, checkpoint, mesh, dtype=None, policy=None,
                 "loading a raw HF state dict needs the architecture config: "
                 "pass hf_config=, or a checkpoint dir with config.json "
                 "(or construct via replace_transformer_layer)")
-    from .replace_module import _resolve_policy, shard_params_for_inference
+    from .replace_module import (_resolve_policy, serving_config,
+                                 shard_params_for_inference)
     pol = _resolve_policy(hf_config, policy)
-    cfg = pol.build_config(hf_config, dtype)
+    cfg = serving_config(pol, hf_config, dtype)
     params = pol.convert(sd, cfg)
     return shard_params_for_inference(module, params, mesh, cfg)
 

@@ -24,11 +24,8 @@ import jax
 import jax.numpy as jnp
 
 
-_QKEYS = ("q", "scale")
-
-
-def _is_qleaf(x) -> bool:
-    return isinstance(x, dict) and set(x.keys()) == set(_QKEYS)
+from ..models.layers import _is_qleaf  # single source of the {"q","scale"}
+                                       # layout predicate (QDense consumes it)
 
 
 def _quantize_array(w, axis):

@@ -49,6 +49,11 @@ def _act(hf, *fields, default="gelu_new"):
     for f in fields:
         v = getattr(hf, f, None)
         if v:
+            if v not in _ACT_MAP:
+                from ..utils.logging import warn_once
+                warn_once(f"unknown HF activation {v!r}: serving with the "
+                          "tanh-approx GELU — verify against the reference "
+                          "model if logits diverge")
             return _ACT_MAP.get(v, "gelu")
     return _ACT_MAP[default]
 
@@ -572,7 +577,11 @@ class BLOOMLayerPolicy(InjectionPolicy):
             ln_epsilon=hf.layer_norm_epsilon, tie_embeddings=True,
             learned_pos=False, alibi=True, embed_ln=True,
             scan_layers=True,
-            activation=_act(hf, "hidden_act", default="gelu"))
+            # HF BloomConfig carries no hidden_act and BloomGelu is the
+            # TANH approximation — the generic "gelu"(=erf) default would
+            # silently diverge every MLP activation
+            activation=_act(hf, "hidden_act",
+                            default="gelu_pytorch_tanh"))
 
     @classmethod
     def convert(cls, sd, cfg):
@@ -695,6 +704,18 @@ class HFBertLayerPolicy(InjectionPolicy):
         if pfx + "pooler.dense.weight" in sd:
             out["pooler"] = _dense(_t(sd[pfx + "pooler.dense.weight"]),
                                    sd[pfx + "pooler.dense.bias"])
+        else:
+            # BertEncoder always creates the pooler param; a pooler-less
+            # checkpoint (BertForMaskedLM, add_pooling_layer=False) must
+            # still produce a structure-complete tree — zero weights, and
+            # the pooled output is simply meaningless (as it is in HF)
+            from ..utils.logging import warn_once
+            warn_once("BERT checkpoint has no pooler weights; "
+                      "initializing a zero pooler (pooled output unusable, "
+                      "sequence outputs unaffected)")
+            d = cfg.d_model
+            out["pooler"] = _dense(np.zeros((d, d), np.float32),
+                                   np.zeros((d,), np.float32))
         return out
 
 

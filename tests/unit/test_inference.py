@@ -741,3 +741,114 @@ class TestZeroInference:
         with pytest.raises(ValueError, match="parameter-streaming"):
             ds.init_inference(NotStreamable(), params={},
                               offload_params=True)
+
+
+class TestServingStackHardening:
+    """r5 high-effort review of inference/ + module_inject: regression
+    tests for the surviving findings."""
+
+    def test_injected_params_follow_serving_dtype(self):
+        """A bf16-requested injection must PLACE bf16 weights — the fp32
+        param_dtype training default would double serving HBM."""
+        from transformers import GPT2Config, GPT2LMHeadModel
+        from deepspeed_tpu.module_inject import replace_transformer_layer
+        from deepspeed_tpu.comm import build_mesh, MeshSpec
+        hf = GPT2LMHeadModel(GPT2Config(
+            vocab_size=90, n_positions=64, n_embd=32, n_layer=2, n_head=4))
+        mesh = build_mesh(MeshSpec(model=1))
+        try:
+            mod, params = replace_transformer_layer(
+                hf, dtype=jnp.bfloat16, mesh=mesh)
+            import jax.tree_util as jtu
+            bad = [jtu.keystr(path) for path, a in
+                   jtu.tree_flatten_with_path(params)[0]
+                   if jnp.issubdtype(a.dtype, jnp.floating)
+                   and a.dtype != jnp.bfloat16
+                   # LayerNorm params are deliberately fp32 (fp32-
+                   # accumulation design; KB-scale, no memory cost)
+                   and "ln" not in jtu.keystr(path)]
+            assert not bad, bad
+            # the big matmul weights — the HBM cost — really are bf16
+            attn_kernels = [a for a in jax.tree.leaves(params["h"]["attn"])
+                            if getattr(a, "ndim", 0) >= 2]
+            assert attn_kernels
+            assert all(a.dtype == jnp.bfloat16 for a in attn_kernels)
+        finally:
+            from deepspeed_tpu.comm.mesh import set_global_mesh
+            set_global_mesh(None)
+
+    def test_sampling_sweep_reuses_one_executable(self):
+        """Temperature/top-k/top-p are traced VALUES: a serving sweep
+        must not recompile the decode loop per setting (only the feature
+        STRUCTURE is compile-time)."""
+        from deepspeed_tpu.inference.generation import (_decode_jit,
+                                                        _decode_loop,
+                                                        init_cache, _prefill)
+        from deepspeed_tpu.models import GPT, GPTConfig
+        import flax.core.meta as flax_meta
+        cfg = GPTConfig(vocab_size=64, max_seq_len=64, d_model=32,
+                        n_layers=1, n_heads=4, dtype=jnp.float32,
+                        scan_layers=True)
+        model = GPT(cfg)
+        ids = jnp.ones((1, 8), jnp.int32)
+        params = flax_meta.unbox(model.init(jax.random.PRNGKey(0),
+                                            ids))["params"]
+        cache = init_cache(model, params, 1, 128)
+        _, cache = _prefill(model, params, cache, ids, jnp.arange(8), None)
+        before = _decode_jit._cache_size()
+        for temp, k, p in ((0.7, 5, 0.9), (0.9, 5, 0.9), (1.3, 9, 0.8),
+                           (0.5, 2, 0.95)):
+            toks, _ = _decode_loop(model, params, cache, ids[:, -1],
+                                   jnp.int32(8), 4, temp, k, p,
+                                   jax.random.PRNGKey(1), None)
+            assert toks.shape == (1, 4)
+        # one executable for the whole sweep (same structure flags)
+        assert _decode_jit._cache_size() == before + 1, \
+            _decode_jit._cache_size() - before
+
+    def test_inference_engine_preserves_act_quant_rules(self):
+        """Constructing/serving an InferenceEngine (distillation teacher)
+        must not clear the process-global activation-quantization rules a
+        compression-training engine depends on."""
+        from deepspeed_tpu.models.layers import (set_activation_quantization,
+                                                 _maybe_quantize_activation)
+        import deepspeed_tpu.models.layers as L
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.models import GPT, GPTConfig
+        import flax.core.meta as flax_meta
+        rules = [{"modules": ["*"], "bits": 8, "symmetric": True}]
+        set_activation_quantization(rules)
+        try:
+            cfg = GPTConfig(vocab_size=64, max_seq_len=32, d_model=32,
+                            n_layers=1, n_heads=4, dtype=jnp.float32,
+                            scan_layers=True)
+            model = GPT(cfg)
+            params = flax_meta.unbox(model.init(jax.random.PRNGKey(0),
+                                                jnp.ones((1, 8), jnp.int32))
+                                     )["params"]
+            eng = InferenceEngine(model, params=params, dtype=jnp.float32)
+            _ = eng.generate(np.ones((1, 4), np.int32), max_new_tokens=2)
+            assert L._ACT_QUANT_RULES == rules      # rules survived serving
+        finally:
+            from deepspeed_tpu.comm.mesh import set_global_mesh
+            set_activation_quantization(None)
+            set_global_mesh(None)
+
+    def test_bert_checkpoint_without_pooler_converts(self):
+        """Pooler-less BERT checkpoints (BertForMaskedLM-style) must
+        produce a structure-complete tree (zero pooler), not a pytree
+        mismatch crash."""
+        from transformers import BertConfig as HFBertConfig, BertModel
+        from deepspeed_tpu.module_inject.replace_policy import \
+            HFBertLayerPolicy
+        hf = BertModel(HFBertConfig(
+            vocab_size=90, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64), add_pooling_layer=False)
+        sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        assert not any("pooler" in k for k in sd)
+        cfg = HFBertLayerPolicy.build_config(hf.config, jnp.float32)
+        params = HFBertLayerPolicy.convert(sd, cfg)
+        assert "pooler" in params
+        assert params["pooler"]["kernel"].shape == (32, 32)
+        np.testing.assert_array_equal(params["pooler"]["kernel"], 0.0)
