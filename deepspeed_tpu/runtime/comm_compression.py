@@ -43,14 +43,24 @@ def compress_1bit(x, error):
 
 
 def compressed_allreduce(x, error, axis_name: str):
-    """1-bit mean-allreduce inside shard_map/pmap: each participant sends
-    signs + its scale; result = mean_i(scale_i * sign_i) via two psums
-    (one bf16 sign tensor + one scalar). Returns (reduced, new_error)."""
+    """1-bit mean-allreduce inside shard_map/pmap: TWO psums actually on
+    the wire — the bf16 sign tensor (half the bytes of fp32; exact: ±1
+    and partial sums up to the ring size are bf16-representable) and one
+    fp32 scalar. Result = mean_scale * mean_sign — the mean-scale
+    approximation of mean_i(scale_i*sign_i) (exact when scales agree,
+    e.g. axis size 1 or homogeneous shards); the per-worker residual vs
+    its own scale*sign stays in the error feedback, the same compensation
+    contract as the reference's worker error (nccl.py compressed_allreduce).
+    Returns (reduced, new_error).
+
+    NOTE: upcasting signs to fp32 before the psum would silently ship
+    full fp32 traffic — the whole point of the compression (r5 review)."""
     n = lax.psum(1, axis_name)
     signs, scale, new_error = compress_1bit(x, error)
-    summed = lax.psum(signs.astype(jnp.bfloat16).astype(jnp.float32) * scale,
-                      axis_name)
-    return summed / n, new_error
+    summed_signs = lax.psum(signs.astype(jnp.bfloat16),
+                            axis_name).astype(jnp.float32)
+    mean_scale = lax.psum(scale, axis_name) / n
+    return mean_scale * summed_signs / n, new_error
 
 
 def int8_compressed_allreduce(x, error, axis_name: str, chunk: int = 256):
@@ -99,6 +109,23 @@ def int8_compressed_allreduce(x, error, axis_name: str, chunk: int = 256):
     return out.reshape(x.shape), new_error
 
 
+def _map_compressed(warm, compress, mu, error):
+    """Per-leaf (used_momentum, new_error) under a traced warm/frozen
+    switch. The pair rides as a {"m","e"} DICT, not a tuple — a tuple
+    marker would misfire on params pytrees whose containers are
+    themselves tuples (optax allows them), grabbing a subtree as a
+    'pair'."""
+    pairs = jax.tree.map(
+        lambda m, e: jax.lax.cond(
+            warm, lambda me: {"m": me["m"], "e": me["e"]},
+            lambda me: dict(zip(("m", "e"), compress(me["m"], me["e"]))),
+            {"m": m, "e": e}),
+        mu, error)
+    is_pair = lambda x: isinstance(x, dict) and set(x) == {"m", "e"}
+    return (jax.tree.map(lambda p: p["m"], pairs, is_leaf=is_pair),
+            jax.tree.map(lambda p: p["e"], pairs, is_leaf=is_pair))
+
+
 class OneBitAdamState(NamedTuple):
     count: jnp.ndarray
     mu: optax.Updates        # momentum (the compressed quantity)
@@ -132,20 +159,17 @@ def onebit_adam(learning_rate, b1: float = 0.9, b2: float = 0.999,
             signs, scale, new_e = compress_1bit(m, e)
             return scale * signs, new_e
 
-        pairs = jax.tree.map(
-            lambda m, e: jax.lax.cond(
-                warm, lambda me: (me[0], me[1]),
-                lambda me: compress(me[0], me[1]), (m, e)),
-            mu, state.error,
-            is_leaf=lambda x: False)
-        mu_used = jax.tree.map(lambda p: p[0], pairs,
-                               is_leaf=lambda x: isinstance(x, tuple))
-        error = jax.tree.map(lambda p: p[1], pairs,
-                             is_leaf=lambda x: isinstance(x, tuple))
+        mu_used, error = _map_compressed(warm, compress, mu, state.error)
 
         bc1 = 1 - b1 ** count.astype(jnp.float32)
         bc2 = 1 - b2 ** jnp.minimum(count, freeze_step).astype(jnp.float32)
         lr = learning_rate(count) if callable(learning_rate) else learning_rate
+
+        if weight_decay and params is None:
+            raise ValueError(
+                "onebit_adam with weight_decay > 0 needs params (call "
+                "update(grads, state, params) — decaying anything else "
+                "would be silently wrong)")
 
         def upd(m, v, p):
             step = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
@@ -165,21 +189,40 @@ def zero_one_adam(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
                   var_update_scaler: int = 16,
                   local_step_scaler: int = 1000):
     """0/1 Adam (reference: ZeroOneAdam, onebit/zoadam.py:10): like 1-bit
-    Adam but the variance keeps updating at a decayed cadence after the
-    freeze point. Cadence policy reduced to: update variance every
-    ``var_update_scaler`` steps post-freeze."""
+    Adam but the variance keeps refreshing at a DECAYED cadence after the
+    freeze point — intervals start at ``var_update_scaler`` and double
+    each refresh (the paper's k_{j+1} = 2 k_j policy), capped at
+    ``local_step_scaler`` (fixed cadence from there on). The schedule is
+    static, so the traced predicate is a small OR over precomputed
+    refresh steps."""
 
     base = onebit_adam(learning_rate, b1, b2, eps, weight_decay,
                        freeze_step=var_freeze_step)
+
+    # refresh offsets past the freeze point: S, S+2S, S+2S+4S, ... with
+    # the interval capped at local_step_scaler
+    thresholds = []
+    t, interval = 0, var_update_scaler
+    while interval < local_step_scaler:
+        t += interval
+        thresholds.append(t)
+        interval *= 2
+    cap_anchor = thresholds[-1] if thresholds else 0
 
     def init_fn(params):
         return base.init(params)
 
     def update_fn(grads, state, params=None):
         count = state.count + 1
-        refresh = jnp.logical_and(
-            count > var_freeze_step,
-            (count - var_freeze_step) % var_update_scaler == 0)
+        t_post = count - var_freeze_step
+        refresh = jnp.asarray(False)
+        for th in thresholds:
+            refresh = jnp.logical_or(refresh, t_post == th)
+        refresh = jnp.logical_or(
+            refresh,
+            jnp.logical_and(t_post > cap_anchor,
+                            (t_post - cap_anchor) % local_step_scaler == 0))
+        refresh = jnp.logical_and(t_post > 0, refresh)
         # borrow the 1-bit step, then optionally refresh the variance
         updates, new_state = base.update(grads, state, params)
         nu = jax.tree.map(
@@ -228,15 +271,7 @@ def onebit_lamb(learning_rate, b1: float = 0.9, b2: float = 0.999,
             signs, scale, new_e = compress_1bit(m, e)
             return scale * signs, new_e
 
-        pairs = jax.tree.map(
-            lambda m, e: jax.lax.cond(
-                warm, lambda me: (me[0], me[1]),
-                lambda me: compress(me[0], me[1]), (m, e)),
-            mu, state.error, is_leaf=lambda x: False)
-        mu_used = jax.tree.map(lambda p: p[0], pairs,
-                               is_leaf=lambda x: isinstance(x, tuple))
-        error = jax.tree.map(lambda p: p[1], pairs,
-                             is_leaf=lambda x: isinstance(x, tuple))
+        mu_used, error = _map_compressed(warm, compress, mu, state.error)
 
         bc1 = 1 - b1 ** count.astype(jnp.float32)
         bc2 = 1 - b2 ** jnp.minimum(count, freeze_step).astype(jnp.float32)
@@ -253,14 +288,13 @@ def onebit_lamb(learning_rate, b1: float = 0.9, b2: float = 0.999,
             # the applied ratio IS the carried state: captured live while
             # warm, frozen (reused) afterwards
             ratio = jnp.where(warm, live_ratio, fr)
-            return -lr * ratio * u, ratio
+            return {"u": -lr * ratio * u, "r": ratio}
 
         outs = jax.tree.map(leaf_update, mu_used, nu, params,
                             state.frozen_ratio)
-        updates = jax.tree.map(lambda o: o[0], outs,
-                               is_leaf=lambda x: isinstance(x, tuple))
-        frozen = jax.tree.map(lambda o: o[1], outs,
-                              is_leaf=lambda x: isinstance(x, tuple))
+        is_out = lambda x: isinstance(x, dict) and set(x) == {"u", "r"}
+        updates = jax.tree.map(lambda o: o["u"], outs, is_leaf=is_out)
+        frozen = jax.tree.map(lambda o: o["r"], outs, is_leaf=is_out)
         return updates, OneBitLambState(count, mu, nu, error, frozen)
 
     return optax.GradientTransformation(init_fn, update_fn)

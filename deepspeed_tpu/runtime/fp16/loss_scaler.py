@@ -45,11 +45,15 @@ def update_scale(state: LossScaleState, finite: jnp.ndarray, *,
                  hysteresis: int = 2, consecutive_hysteresis: bool = False,
                  min_scale: float = 1.0,
                  scale_factor: float = 2.0) -> LossScaleState:
-    """Dynamic policy (reference DynamicLossScaler.update_scale): an
+    """Dynamic policy, reference-faithful
+    (DynamicLossScaler.update_scale, fp16/loss_scaler.py:151): an
     overflow consumes one unit of hysteresis; the scale halves only when
-    hysteresis is exhausted. ``scale_window`` clean steps double it. With
-    ``consecutive_hysteresis=False`` (reference default) a clean step
-    refills the hysteresis budget."""
+    hysteresis is exhausted — and stays exhausted (every further overflow
+    shrinks) until a REFILL event. The refill event is: every clean step
+    when ``consecutive_hysteresis=True``; the scale-GROWTH step (after
+    ``scale_window`` clean steps) when False (the reference default) —
+    NOT every clean step, or non-consecutive overflows could never
+    shrink the scale."""
     if not dynamic:
         return state._replace(overflows=state.overflows + jnp.where(finite, 0, 1))
 
@@ -61,14 +65,18 @@ def update_scale(state: LossScaleState, finite: jnp.ndarray, *,
                             s.scale),
             growth_tracker=jnp.zeros((), jnp.int32),
             overflows=s.overflows + 1,
-            hysteresis_left=jnp.where(exhausted, jnp.int32(hysteresis),
+            # no refill on shrink (reference keeps cur_hysteresis at 1)
+            hysteresis_left=jnp.where(exhausted, s.hysteresis_left,
                                       s.hysteresis_left - 1))
 
     def on_clean(s):
         tracker = s.growth_tracker + 1
         grow = tracker >= scale_window
-        hyst = (s.hysteresis_left if consecutive_hysteresis
-                else jnp.asarray(hysteresis, jnp.int32))
+        full = jnp.asarray(hysteresis, jnp.int32)
+        if consecutive_hysteresis:
+            hyst = full
+        else:
+            hyst = jnp.where(grow, full, s.hysteresis_left)
         return LossScaleState(
             scale=jnp.where(grow, s.scale * scale_factor, s.scale),
             growth_tracker=jnp.where(grow, 0, tracker),

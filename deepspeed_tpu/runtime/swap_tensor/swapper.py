@@ -39,6 +39,14 @@ class AsyncTensorSwapper:
 
     def swap_out(self, name: str, array: np.ndarray):
         """Async write; the array must not be mutated until flush()."""
+        # same-name hazards: an in-flight write to the same file would
+        # interleave (torn file) and its popped-unwaited ticket would leak
+        # the pinned buffer; an in-flight read would race the write
+        if name in self._write_tickets:
+            self.handle.wait(self._write_tickets.pop(name))
+        if name in self._read_tickets:
+            ticket, _buf = self._read_tickets.pop(name)
+            self.handle.wait(ticket)
         array = np.ascontiguousarray(array)
         self._meta[name] = (array.shape, array.dtype)
         self._write_tickets[name] = self.handle.pwrite(self._path(name), array)

@@ -144,7 +144,9 @@ class CPUAdamOffloadOptimizer:
                 else:
                     updated = flat_master.reshape(master.shape).astype(out_dtype)
                 for d in devices:
-                    bufs.append(jax.device_put(jnp.asarray(updated), d))
+                    # device_put straight from numpy: asarray first would
+                    # commit to the default device and pay a second copy
+                    bufs.append(jax.device_put(updated, d))
                 if self.swapper is not None:
                     self.swapper.swap_out(self._swap_name(li, key, "m"), m)
                     self.swapper.swap_out(self._swap_name(li, key, "v"), v)

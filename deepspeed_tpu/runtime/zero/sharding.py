@@ -32,14 +32,12 @@ Tensor parallelism (the reference delegates to Megatron's mpu) is the
 boundaries inserted by XLA.
 """
 
-from typing import Optional
 
 import numpy as np
 import jax
 from jax.sharding import PartitionSpec as P, NamedSharding
 
 from ...comm.mesh import DENSE_DP_AXES
-from ...utils.logging import logger
 
 # Logical-name -> mesh-axis rule tables. None = replicate that dim.
 TP_RULES = {
@@ -125,7 +123,12 @@ def make_opt_state_rules(stage: int, mesh):
     stage 0: follow the param. stage >= 1: additionally shard over the
     data(+expert) axes on the largest free dim — the ZeRO-1 partition.
     """
-    base_axes = tuple(a for a in ("data", "expert") if mesh.shape.get(a, 1) > 1)
+    # the FULL dense-DP group (data, expert, fsdp): the batch is sharded
+    # over all of it (engine._place_batch uses DENSE_DP_AXES), so the
+    # ZeRO-1/2 partition must cover it too — omitting fsdp would leave
+    # opt state / grad-accum buffers fsdp-replicated, fsdp-times the
+    # promised shard per device
+    base_axes = tuple(a for a in DENSE_DP_AXES if mesh.shape.get(a, 1) > 1)
 
     def rules(param_spec: P, shape, names=None):
         if stage < 1 or not base_axes or not shape:

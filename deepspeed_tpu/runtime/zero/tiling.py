@@ -12,14 +12,13 @@ divisible chunks. The flax module keeps the reference's splits/API; XLA
 fuses the per-tile matmuls back into efficient MXU work.
 """
 
-from typing import Any, Callable, Optional
+from typing import Any
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from ...models.layers import dense_init
 
 
 def split_dim(total: int, splits: int):

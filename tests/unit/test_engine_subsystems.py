@@ -555,9 +555,11 @@ class TestNoInvoluntaryRemat:
         from deepspeed_tpu.runtime.zero.sharding import make_opt_state_rules
         mesh = build_mesh(MeshSpec(data=2, fsdp=2, model=2))
         orule = make_opt_state_rules(2, mesh)
-        # layers=5 not divisible by data=2; qkv dim 384 % (model*data)=0
+        # layers=5 not divisible by the DP degree; qkv dim 384 divides
+        # model*data*fsdp — the partition stacks the FULL dense-DP group
+        # (data AND fsdp; omitting fsdp was the r5 core-review finding)
         spec = orule(P(None, "model"), (5, 384), ("layers", "qkv"))
-        assert spec == P(None, ("model", "data")), spec
+        assert spec == P(None, ("model", "data", "fsdp")), spec
         # and when even stacking can't divide, the param spec is kept
         # unchanged rather than producing an invalid partition
         spec = orule(P(None, "model"), (5, 6), ("layers", "qkv"))

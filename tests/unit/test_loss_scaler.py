@@ -36,11 +36,34 @@ def test_overflow_immediate_with_hysteresis_1():
     assert float(s.scale) == 128.0
 
 
-def test_clean_step_refills_hysteresis():
+def test_hysteresis_refill_semantics_match_reference():
+    """Reference DynamicLossScaler (fp16/loss_scaler.py:151): with
+    consecutive_hysteresis=False (the default) a plain clean step does
+    NOT refill the budget — only the scale-GROWTH step does. Otherwise
+    non-consecutive overflows could never shrink the scale (the r5 core
+    review's top finding: the budget refilled every clean step, so a
+    skip-every-other-step loop kept a stale huge scale forever)."""
     s = init_loss_scale(0.0, initial_scale_power=8, hysteresis=2)
     s = update_scale(s, jnp.asarray(False), hysteresis=2)   # consume one
     assert int(s.hysteresis_left) == 1
-    s = update_scale(s, jnp.asarray(True), hysteresis=2)    # refill
+    s = update_scale(s, jnp.asarray(True), hysteresis=2)    # NO refill
+    assert int(s.hysteresis_left) == 1
+    s = update_scale(s, jnp.asarray(False), hysteresis=2)   # 2nd overflow
+    assert float(s.scale) == 128.0                          # shrinks now
+    # the growth step refills (reference: refill inside the window branch)
+    s = init_loss_scale(0.0, initial_scale_power=8, hysteresis=2)
+    s = update_scale(s, jnp.asarray(False), hysteresis=2)
+    s = update_scale(s, jnp.asarray(True), hysteresis=2, scale_window=1)
+    assert int(s.hysteresis_left) == 2
+
+
+def test_consecutive_hysteresis_refills_every_clean_step():
+    s = init_loss_scale(0.0, initial_scale_power=8, hysteresis=2)
+    s = update_scale(s, jnp.asarray(False), hysteresis=2,
+                     consecutive_hysteresis=True)
+    assert int(s.hysteresis_left) == 1
+    s = update_scale(s, jnp.asarray(True), hysteresis=2,
+                     consecutive_hysteresis=True)
     assert int(s.hysteresis_left) == 2
 
 
