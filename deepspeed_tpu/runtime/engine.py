@@ -443,8 +443,13 @@ class DeepSpeedEngine:
         self.streamed_offload = None
         off = cfg.zero_optimization.offload_optimizer
         opt_type = (cfg.optimizer.type if cfg.optimizer else "Adam")
-        if (off is not None and getattr(off, "native", False)
-                and off.device in ("cpu", "nvme")):
+        if off is not None and getattr(off, "native", False):
+            if off.device not in ("cpu", "nvme"):
+                raise DeepSpeedConfigError(
+                    "offload_optimizer.native=true needs device 'cpu' or "
+                    f"'nvme' (got {off.device!r}) — without it the native "
+                    "path would be silently skipped and optimizer state "
+                    "would stay in HBM")
             if client_optimizer is not None:
                 raise DeepSpeedConfigError(
                     "offload_optimizer.native is incompatible with a client "
@@ -601,8 +606,10 @@ class DeepSpeedEngine:
         lead = (None, DENSE_DP_AXES) if with_gas_dim else (DENSE_DP_AXES,)
 
         def shard_one(x):
-            extra = (None,) * max(0, x.ndim - len(lead))
-            return NamedSharding(self.mesh, P(*lead, *extra))
+            # scalar leaves (a temperature, a flag) replicate: a spec
+            # longer than the rank would be a placement error
+            spec = (lead + (None,) * (x.ndim - len(lead)))[:x.ndim]
+            return NamedSharding(self.mesh, P(*spec))
         return jax.tree.map(shard_one, tree)
 
     def _place_batch(self, batch, with_gas_dim):
@@ -1009,10 +1016,18 @@ class DeepSpeedEngine:
         self._sync_activation_quantization()
         if "fwd_grads" not in self._compiled:
             model, loss_fn = self.module, self._loss_fn
+            fp16 = self.fp16_enabled
 
-            def fwd(params, batch, rng, extra):
-                return jax.value_and_grad(
-                    lambda p: loss_fn(model, p, batch, rng, True, **extra))(params)
+            def fwd(params, batch, rng, scale, extra):
+                # fp16: differentiate the SCALED loss (underflow
+                # protection — the whole point of loss scaling; grads come
+                # back scaled and step() unscales), return the raw loss
+                def lf(p):
+                    l = loss_fn(model, p, batch, rng, True, **extra)
+                    return l * scale if fp16 else l
+
+                scaled_loss, grads = jax.value_and_grad(lf)(params)
+                return (scaled_loss / scale if fp16 else scaled_loss), grads
             # ZeRO stage >= 2: grads leave the step already in the ZeRO
             # partition, so the host-persistent accumulation buffer
             # (self._accum_grads, carried across backward() calls) is
@@ -1048,8 +1063,10 @@ class DeepSpeedEngine:
         self._remember_extra(extra, loss_kwargs)
         batch = self._place_batch(batch, with_gas_dim=False)
         rng = jax.random.fold_in(self.rng, self.micro_steps + 1)
+        scale = (self.loss_scale_state or init_loss_scale(1.0)).scale
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        loss, grads = self._compiled["fwd_grads"](self.params, batch, rng, extra)
+        loss, grads = self._compiled["fwd_grads"](self.params, batch, rng,
+                                                  scale, extra)
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._pending_grads = grads
         self._last_loss = loss
@@ -1064,7 +1081,12 @@ class DeepSpeedEngine:
             raise RuntimeError("backward() called without a preceding forward()")
         gas = self.config.gradient_accumulation_steps
         self.timers(BACKWARD_GLOBAL_TIMER).start()
-        scaled = jax.tree.map(lambda g: g / gas, self._pending_grads)
+        # accumulate in grad_accum_dtype (fp32 default) like the fused
+        # path's buffer — summing many /gas-scaled microbatch grads in
+        # bf16 rounds the small contributions away
+        accum_dtype = jnp.dtype(self.config.data_types.resolve())
+        scaled = jax.tree.map(lambda g: (g / gas).astype(accum_dtype),
+                              self._pending_grads)
         if self._accum_grads is None:
             self._accum_grads = scaled
         else:
@@ -1079,16 +1101,49 @@ class DeepSpeedEngine:
 
     def step(self):
         """Apply the optimizer at the gas boundary (reference: engine.step
-        -> _take_model_step)."""
+        -> _take_model_step): unscale the fp16-scaled accumulated grads,
+        skip-on-overflow, step, and do the same bookkeeping (samples,
+        monitor events, NVMe evict) as the fused train_batch."""
         if not self.is_gradient_accumulation_boundary():
             return
+        self.timers(STEP_GLOBAL_TIMER).start()
+        scaler = self.loss_scale_state or init_loss_scale(1.0)
+        if self.native_offload is not None:
+            gnorm, new_scaler, skipped = self._native_offload_step(scaler)
+        else:
+            gnorm, new_scaler, skipped = self._device_step(scaler)
+        if self.fp16_enabled:
+            self.loss_scale_state = new_scaler
+            self.skipped_steps += int(skipped)
+        self._accum_grads = None
+        self._accum_count = 0
+        self.global_steps += 1
+        self.global_samples += self.config.train_batch_size
+        self._last_grad_norm = gnorm
+        self._apply_weight_projections()
+        self._evict_params_to_nvme()
+        self.timers(STEP_GLOBAL_TIMER).stop()
+        metrics = {"loss": self._last_loss, "grad_norm": gnorm,
+                   "skipped": skipped,
+                   "loss_scale": scaler.scale if self.fp16_enabled
+                   else jnp.float32(1.0)}
+        if self.global_steps % self.config.steps_per_print == 0:
+            log_dist(f"step={self.global_steps} lr={self.get_lr():.3e} "
+                     f"grad_norm={float(gnorm):.3f}", ranks=[0])
+        self._write_monitor(metrics)
+
+    def _device_step(self, scaler):
         if "apply_grads" not in self._compiled:
             optimizer, cfg, fp16 = self.optimizer, self.config, self.fp16_enabled
             streamed, lr_schedule = self.streamed_offload, self.lr_schedule
 
             def apply_step(params, opt_state, scaler, grads):
-                gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
-                                     for g in jax.tree.leaves(grads)))
+                if fp16:
+                    inv = 1.0 / scaler.scale
+                    grads = jax.tree.map(lambda g: g * inv, grads)
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree.leaves(grads)))
 
                 def do(op):
                     import optax
@@ -1121,23 +1176,51 @@ class DeepSpeedEngine:
                 out_shardings=(self.param_shardings, self.opt_shardings,
                                None, None, None))
 
-        self.timers(STEP_GLOBAL_TIMER).start()
-        scaler = self.loss_scale_state or init_loss_scale(1.0)
         self.params, self.optimizer_state, new_scaler, gnorm, skipped = \
             self._compiled["apply_grads"](self.params, self.optimizer_state,
                                           scaler, self._accum_grads)
-        if self.fp16_enabled:
-            self.loss_scale_state = new_scaler
-            self.skipped_steps += int(skipped)
-        self._accum_grads = None
-        self._accum_count = 0
-        self.global_steps += 1
-        self._last_grad_norm = gnorm
-        self._apply_weight_projections()
-        self.timers(STEP_GLOBAL_TIMER).stop()
-        if self.global_steps % self.config.steps_per_print == 0:
-            log_dist(f"step={self.global_steps} lr={self.get_lr():.3e} "
-                     f"grad_norm={float(gnorm):.3f}", ranks=[0])
+        return gnorm, new_scaler, skipped
+
+    def _native_offload_step(self, scaler):
+        """Parity-API leg of native ZeRO-Offload: unscale/clip/check the
+        accumulated grads on device (mirroring _make_grad_step's
+        post-accumulate stage), then run the host cpu_adam step."""
+        if "prep_native" not in self._compiled:
+            cfg, fp16 = self.config, self.fp16_enabled
+
+            def prep(grads, scaler):
+                from ..utils.tree import clip_grads_by_global_norm
+                if fp16:
+                    inv = 1.0 / scaler.scale
+                    grads = jax.tree.map(lambda g: g * inv, grads)
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree.leaves(grads)))
+                grads = clip_grads_by_global_norm(grads, gnorm,
+                                                  cfg.gradient_clipping)
+                if fp16:
+                    finite = grads_finite(grads)
+                    new_scaler = update_scale(
+                        scaler, finite, dynamic=cfg.fp16.dynamic_loss_scale,
+                        scale_window=cfg.fp16.loss_scale_window,
+                        hysteresis=cfg.fp16.hysteresis,
+                        min_scale=cfg.fp16.min_loss_scale)
+                else:
+                    finite, new_scaler = jnp.bool_(True), scaler
+                return grads, gnorm, finite, new_scaler
+
+            self._compiled["prep_native"] = jax.jit(
+                prep, out_shardings=(self.grad_shardings, None, None, None))
+
+        grads, gnorm, finite, new_scaler = self._compiled["prep_native"](
+            self._accum_grads, scaler)
+        lr = (float(self.lr_schedule(self.global_steps))
+              if callable(self.lr_schedule) else float(self.lr_schedule))
+        new_params = self.native_offload.step(grads, lr=lr,
+                                              finite=bool(finite))
+        if new_params is not None:
+            self.params = new_params
+        return gnorm, new_scaler, jnp.int32(0 if bool(finite) else 1)
 
     def eval_batch(self, batch: Dict[str, Any], **loss_kwargs):
         self._ensure_params_resident()
@@ -1244,9 +1327,17 @@ class DeepSpeedEngine:
         logical value, the all-gather the reference hand-codes)."""
         self._ensure_params_resident()
         import numpy as np
+        multihost = jax.process_count() > 1
 
         def one(x):
-            arr = jax.device_get(x)
+            if multihost and hasattr(x, "sharding"):
+                # device_get raises on arrays whose shards live on other
+                # hosts; allgather materializes the full value per process
+                from jax.experimental import multihost_utils
+                arr = np.asarray(
+                    multihost_utils.process_allgather(x, tiled=True))
+            else:
+                arr = jax.device_get(x)
             if np.issubdtype(arr.dtype, np.floating):
                 arr = np.asarray(arr, jnp.dtype(dtype))
             return arr
@@ -1261,10 +1352,13 @@ class DeepSpeedEngine:
         import os
         from flax import serialization
         sd = self._zero3_consolidated_16bit_state_dict(dtype=dtype)
-        os.makedirs(save_dir, exist_ok=True)
         path = os.path.join(save_dir, save_filename)
-        with open(path, "wb") as f:
-            f.write(serialization.to_bytes(sd))
+        # every process gathers (collective), process 0 alone writes —
+        # concurrent writers on a shared filesystem would tear the file
+        if jax.process_index() == 0:
+            os.makedirs(save_dir, exist_ok=True)
+            with open(path, "wb") as f:
+                f.write(serialization.to_bytes(sd))
         log_dist(f"16-bit model saved to {path}", ranks=[0])
         return path
 
