@@ -96,17 +96,13 @@ def top1gating(logits, capacity_factor: float, min_capacity: int = 4,
     ce = jnp.mean(mask1, axis=0)
     l_aux = jnp.sum(me * ce) * E
 
-    if use_rts and rng is not None:
-        # random token selection: prioritize by uniform noise so truncation
-        # under capacity is unbiased (reference :221)
-        rts = jax.random.uniform(jax.random.fold_in(rng, 1), (T, E))
-        priority = mask1 * rts
-    else:
-        priority = mask1 * (T - jnp.arange(T, dtype=jnp.float32))[:, None]
-    # rank tokens per expert by priority; position = rank in expert queue
-    # cumsum of mask ordered by arrival is the reference's default
+    # position in the expert queue: cumsum of mask in arrival order is the
+    # reference's default; random-token-selection re-ranks by uniform
+    # noise so truncation under capacity is unbiased (reference :221)
     locations1 = jnp.cumsum(mask1, axis=0) - mask1            # [T, E]
     if use_rts and rng is not None:
+        rts = jax.random.uniform(jax.random.fold_in(rng, 1), (T, E))
+        priority = mask1 * rts
         order = jnp.argsort(-priority, axis=0)                # [T, E]
         ranks = jnp.argsort(order, axis=0).astype(jnp.float32)
         locations1 = jnp.where(mask1 > 0, ranks, locations1)
@@ -177,13 +173,20 @@ class TopKGate(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic=True):
+        rng = None
+        if not deterministic and (self.use_rts or self.noisy_gate_policy):
+            rng = self.make_rng("gating")
+        if self.noisy_gate_policy == "Jitter" and rng is not None:
+            # reference TopKGate: multiplicative input jitter
+            # (multiplicative_jitter, sharded_moe.py — uniform in
+            # [1-eps, 1+eps], eps=1e-2) for routing exploration
+            eps = 1e-2
+            x = x * jax.random.uniform(jax.random.fold_in(rng, 2), x.shape,
+                                       x.dtype, 1.0 - eps, 1.0 + eps)
         # gate weights kept fp32 (reference keeps wg in fp32)
         logits = QDense(
             features=self.num_experts, use_bias=False, dtype=jnp.float32,
             param_dtype=jnp.float32, name="wg")(x.astype(jnp.float32))
-        rng = None
-        if not deterministic and (self.use_rts or self.noisy_gate_policy):
-            rng = self.make_rng("gating")
         factor = (self.capacity_factor if not deterministic
                   else self.eval_capacity_factor)
         if self.k == 1:

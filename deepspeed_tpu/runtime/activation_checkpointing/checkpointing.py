@@ -71,27 +71,37 @@ def _partition_constraint(x):
         return x
 
 
-def checkpoint(function, *args):
+def checkpoint(function, *args, **kwargs):
     """Checkpoint a model region (reference: checkpointing.py:743).
 
-    Returns ``function(*args)``; in backward the region is recomputed
-    instead of storing its internals. Saved inputs honor
-    ``partition_activations`` / ``checkpoint_in_cpu``.
-    """
-    fn = jax.checkpoint(function, policy=_policy())
-    args = tuple(_partition_constraint(a) if hasattr(a, "ndim") else a
-                 for a in args)
+    Returns ``function(*args, **kwargs)``; in backward the region is
+    recomputed instead of storing its internals. Saved inputs honor
+    ``partition_activations`` / ``checkpoint_in_cpu``. Like the
+    reference (where non-tensor args pass through untraced), only
+    array-like positional args are traced: bools/ints/strings/None and
+    all kwargs are closed over statically, so ported layers that branch
+    on a flag (``if causal:``) don't hit TracerBoolConversionError."""
+    is_arr = [hasattr(a, "ndim") for a in args]
+    arr_args = tuple(_partition_constraint(a)
+                     for a, t in zip(args, is_arr) if t)
+
+    def on_arrays(*arrs):
+        it = iter(arrs)
+        full = [next(it) if t else a for a, t in zip(args, is_arr)]
+        return function(*full, **kwargs)
+
+    fn = jax.checkpoint(on_arrays, policy=_policy())
     if PROFILE_TIME:
         with jax.named_scope("act_checkpoint"):
-            return fn(*args)
-    return fn(*args)
+            return fn(*arr_args)
+    return fn(*arr_args)
 
 
 def checkpoint_wrapper(function):
     """Decorator form: ``layer = checkpoint_wrapper(layer_fn)``."""
     @functools.wraps(function)
-    def wrapped(*args):
-        return checkpoint(function, *args)
+    def wrapped(*args, **kwargs):
+        return checkpoint(function, *args, **kwargs)
     return wrapped
 
 
@@ -130,6 +140,7 @@ def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
                 "synchronize_checkpoint_boundary":
                     acfg.synchronize_checkpoint_boundary,
                 "profile": acfg.profile,
+                "number_checkpoints": acfg.number_checkpoints,
             }
         else:
             block = block.get("activation_checkpointing", block)
@@ -207,7 +218,24 @@ class RNGStatesTracker:
     def fork(self, name="model-parallel-rng"):
         if name not in self._states:
             raise ValueError(f"rng state {name} was never added")
-        self._states[name], sub = jax.random.split(self._states[name])
+        key = self._states[name]
+        try:
+            from jax._src.core import trace_state_clean
+            tracing = not trace_state_clean()
+        except Exception:
+            tracing = False
+        if isinstance(key, jax.core.Tracer) or tracing:
+            # fork() mutates HOST state; inside a traced region the
+            # mutation would bake one frozen key into the compiled step
+            # (identical dropout every execution) or leak a tracer into
+            # the registry. Ports must split OUTSIDE jit and pass keys in
+            # (rngs={...}) — fail loudly instead of silently derailing.
+            raise RuntimeError(
+                "RNGStatesTracker.fork() called inside a traced (jit/"
+                "checkpoint) region: the split would not replay across "
+                "steps. Fork outside the jitted step and pass the key in "
+                "(e.g. flax rngs={'dropout': key}).")
+        self._states[name], sub = jax.random.split(key)
         return sub
 
 

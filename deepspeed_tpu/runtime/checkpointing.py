@@ -141,9 +141,20 @@ def load_module_params(load_dir, mesh=None, tag=None):
         with open(latest) as f:
             tag = f.read().strip()
     path = os.path.join(os.path.abspath(load_dir), str(tag), "state")
-    restored = _checkpointer().restore(path)
-    if "params" not in restored:
+    import orbax.checkpoint as ocp
+    ckptr = _checkpointer()
+    disk = ckptr.metadata(path).item_metadata
+    if "params" not in disk.keys():
         raise ValueError(f"checkpoint at {path} has no 'params' subtree")
+    # restore ONLY the params subtree: an Adam engine checkpoint is ~3x
+    # the param bytes in optimizer moments that serving would immediately
+    # discard (template from on-disk metadata; partial_restore skips the
+    # rest on disk)
+    template = {"params": jax.tree.map(
+        lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), dict(disk["params"]))}
+    restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+    restored = ckptr.restore(path, args=ocp.args.PyTreeRestore(
+        item=template, restore_args=restore_args, partial_restore=True))
     return restored["params"]
 
 
@@ -187,9 +198,20 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
 
     ckptr = _checkpointer()
     item_path = os.path.join(path, "state")
+    # orbax refuses structure mismatches in either direction, so: drop
+    # template keys absent on disk (fp16<->fp32 / native<->optax
+    # cross-loads — the guards below handle their absence), and use
+    # partial_restore for disk keys the template omits (load_module_only,
+    # load_optimizer_states=False)
+    on_disk = set(ckptr.metadata(item_path).item_metadata.keys())
+    missing = sorted(set(template) - on_disk)
+    if missing:
+        logger.warning(f"checkpoint at {item_path} lacks {missing}; those "
+                       "engine states keep their current values")
+        template = {k: v for k, v in template.items() if k in on_disk}
     restore_args = ocp.checkpoint_utils.construct_restore_args(template)
-    restored = ckptr.restore(item_path, item=template,
-                             restore_args=restore_args)
+    restored = ckptr.restore(item_path, args=ocp.args.PyTreeRestore(
+        item=template, restore_args=restore_args, partial_restore=True))
 
     engine.params = restored["params"]
     if load_optimizer_states and not load_module_only and "optimizer_state" in restored:

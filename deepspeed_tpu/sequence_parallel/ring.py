@@ -76,9 +76,10 @@ def _ring_local(q, k, v, bias=None, mask=None, dropout_rng=None, *,
         logits = jnp.einsum("bqhd,bkhd->bhqk", q_c,
                             k_blk.astype(jnp.float32))
         if bias is not None:
+            # sk dim: global (step-sliced), already-local, or broadcast 1
             bias_blk = lax.dynamic_slice_in_dim(
-                bias, src * s_l, s_l, axis=-1) if bias.shape[-1] != s_l \
-                else bias
+                bias, src * s_l, s_l, axis=-1) \
+                if bias.shape[-1] not in (s_l, 1) else bias
             if bias_blk.shape[-2] != 1:
                 bias_blk = lax.dynamic_slice_in_dim(bias_blk, qo, cq, axis=-2)
             logits = logits + bias_blk
@@ -88,17 +89,19 @@ def _ring_local(q, k, v, bias=None, mask=None, dropout_rng=None, *,
             logits = jnp.where((gk <= gq)[None, None], logits, _NEG_INF)
         if mask is not None:
             mask_blk = lax.dynamic_slice_in_dim(
-                mask, src * s_l, s_l, axis=-1) if mask.shape[-1] != s_l \
-                else mask
+                mask, src * s_l, s_l, axis=-1) \
+                if mask.shape[-1] not in (s_l, 1) else mask
             if mask_blk.shape[-2] != 1:
                 mask_blk = lax.dynamic_slice_in_dim(mask_blk, qo, cq, axis=-2)
             logits = jnp.where(mask_blk, logits, _NEG_INF)
         m_new = jnp.maximum(m_c, logits.max(axis=-1))
-        # rows with no valid key yet keep m == -inf; guard the exp args
-        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        # rows with no valid key yet keep m ~ _NEG_INF, which is the
+        # FINITE finfo.min — threshold guards (like the flash kernel's
+        # NEG_INF/2 tests), not isfinite, are what actually fire here
+        safe_m = jnp.where(m_new > _NEG_INF / 2, m_new, 0.0)
         p = jnp.exp(logits - safe_m[..., None])
-        p = jnp.where(jnp.isfinite(logits), p, 0.0)
-        corr = jnp.where(jnp.isfinite(m_c), jnp.exp(m_c - safe_m), 0.0)
+        p = jnp.where(logits > _NEG_INF / 2, p, 0.0)
+        corr = jnp.where(m_c > _NEG_INF / 2, jnp.exp(m_c - safe_m), 0.0)
         p_use = p
         if dropout_on:
             # dropout zeroes softmax PROBS: the denominator accumulates

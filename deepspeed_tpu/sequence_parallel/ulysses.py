@@ -94,6 +94,9 @@ def ulysses_attention(q, k, v, *, bias=None, mask=None, causal=False,
         from ..ops.transformer.attention import attention
         attn_fn = partial(attention, seq_parallel="none")
     dropout_on = dropout_rate > 0.0 and not deterministic
+    if dropout_on and dropout_rng is None:
+        raise ValueError("ulysses_attention: dropout_rate > 0 with "
+                         "deterministic=False requires dropout_rng")
     if sp == 1:
         # keep the documented (q, k, v, causal=, softmax_scale=) attn_fn
         # contract when no operands ride along; only operand-carrying
