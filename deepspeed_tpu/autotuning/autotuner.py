@@ -143,8 +143,7 @@ class Autotuner:
             # keeps every internal activation (~8x a block's residual).
             # The engine enables remat whenever the activation_checkpointing
             # block is PRESENT (runtime/engine.py) — key off presence.
-            act_factor = 2 if (config.get("activation_checkpointing")
-                               or {}) else 8
+            act_factor = 2 if "activation_checkpointing" in config else 8
             total += micro * seq * hidden * (layers + 2) * 4 * act_factor
         return total
 

@@ -161,6 +161,11 @@ def reshape_checkpoint(src_dir: str, dst_dir: str, target_mesh_spec=None,
     shards them per the engine's rules. With ``target_mesh_spec`` given,
     sharded-dim divisibility is checked up front (the reference's degree-
     compatibility checks in reshape_3d_utils.py).
+
+    PARAMS-ONLY, like the reference's universal export: optimizer
+    moments/loss scale are not carried (a warning is logged when the
+    source has them) — resuming from a reshaped checkpoint restarts the
+    optimizer state; use same-topology checkpoints to resume exactly.
     """
     import jax
     import orbax.checkpoint as ocp
@@ -168,6 +173,18 @@ def reshape_checkpoint(src_dir: str, dst_dir: str, target_mesh_spec=None,
 
     src = DeepSpeedCheckpoint(src_dir, tag)
     params = src.load_params()
+    try:
+        disk = ocp.PyTreeCheckpointer().metadata(
+            os.path.join(src.path, "state")).item_metadata
+        extras = sorted(set(disk.keys()) - {"params"})
+    except Exception:
+        extras = []
+    if extras:
+        from ..utils.logging import logger
+        logger.warning(
+            f"reshape is params-only: source subtrees {extras} are NOT "
+            "carried — resuming from the reshaped checkpoint restarts "
+            "the optimizer state")
 
     if target_mesh_spec is not None:
         _validate_target_topology(src, params, target_mesh_spec)

@@ -102,10 +102,16 @@ class MultiNodeRunner:
         return list(map(shlex.quote, self.args.user_args))
 
     def _launch_cmd(self, proc_id_expr: str) -> str:
-        """The per-host command: run the per-node launcher module."""
+        """The per-host command: run the per-node launcher module. Starts
+        with a cd into the launch directory (reference runner prepends the
+        same) — remote shells begin in the login dir, where relative
+        user_script/config paths would break while the single-host path
+        silently worked."""
+        import os
         exports = " ".join(
             f"{k}={shlex.quote(v)}" for k, v in self.exports.items())
-        return (f"{exports} {sys.executable} -m deepspeed_tpu.launcher.launch "
+        return (f"cd {shlex.quote(os.path.abspath(os.curdir))}; "
+                f"{exports} {sys.executable} -m deepspeed_tpu.launcher.launch "
                 f"--world_info={self.world_info_base64} "
                 f"--node_rank={proc_id_expr} "
                 f"--master_addr={self.args.master_addr} "
@@ -210,6 +216,11 @@ def main(argv=None):
         cmd = [sys.executable, args.user_script] + args.user_args
         logger.info(f"launching single-host: {' '.join(cmd)}")
         return subprocess.call(cmd)
+    if not resource_pool:
+        # --force_multi without a hostfile: the multi-node path on
+        # localhost (otherwise the inclusion filter below raises a
+        # misleading 'no hosts remain')
+        resource_pool = {"localhost": 1}
 
     active = parse_inclusion_exclusion(resource_pool, args.include,
                                        args.exclude)
@@ -224,7 +235,8 @@ def main(argv=None):
     env = dict(os.environ)
     cmd = runner.get_cmd(env, active)
     logger.info(f"cmd = {' '.join(cmd)}")
-    return subprocess.call(cmd)
+    # env= matters: get_cmd mutates the copy (PDSH_RCMD_TYPE=ssh)
+    return subprocess.call(cmd, env=env)
 
 
 if __name__ == "__main__":

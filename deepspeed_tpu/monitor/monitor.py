@@ -61,7 +61,6 @@ class csv_monitor(Monitor):
         super().__init__(cfg)
         self.log_dir = os.path.join(cfg.output_path or "./csv_logs", cfg.job_name)
         os.makedirs(self.log_dir, exist_ok=True)
-        self._files = {}
 
     def write_events(self, event_list):
         import csv
