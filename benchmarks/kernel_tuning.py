@@ -1,0 +1,176 @@
+"""Flash-attention block-size sweep -> shape-keyed tuning artifact.
+
+`bin/ds_tpu_bench kernels` entry point. Times candidate (block_q,
+block_k) tilings of the Pallas flash-attention kernels at the model's
+ACTUAL training shapes and writes a tuning artifact
+(``ops.pallas.tuning`` format) whose winners the kernel dispatch
+consults at trace time. Point ``$DS_TPU_KERNEL_TUNING_CACHE`` at the
+artifact — or fold the winners into the committed default table
+(``deepspeed_tpu/ops/pallas/flash_tuning_defaults.json``).
+
+Method: a probe fwd+bwd at the requested shape tells us which kernel
+STRUCTURES that shape dispatches to (resident/streamed/monolithic — read
+back via ``tuning.last_dispatch``, so the sweep can never tune a
+structure the shape doesn't use). Then per structure, each candidate is
+injected as a runtime tuning-table entry and the whole fwd (or fwd+bwd)
+is re-traced and timed. Forward structures are timed on the forward
+alone; backward structures on fwd+bwd with the forward winner pinned.
+
+Everything but the timing numbers is CPU-runnable (interpret-mode
+kernels): ``--trials 1`` with tiny shapes exercises the full plumbing in
+CI; real numbers need hardware (run on the next tunnel-up window).
+"""
+
+import argparse
+import functools
+import time
+
+
+def _divisor_candidates(dim, cap=1024):
+    """128-aligned divisors of ``dim`` up to ``cap`` (the tilings
+    ``pick_block`` can actually honor), largest-first; whole-dim for
+    small/ragged sizes."""
+    cands = [b for b in (1024, 512, 256, 128)
+             if b <= min(dim, cap) and dim % b == 0]
+    return cands or [dim]
+
+
+def candidate_grid(structure, sq, sk):
+    """(block_q, block_k) candidates for one kernel structure.
+    block_k is None for the monolithic backward (whole-K structure)."""
+    bqs = _divisor_candidates(sq)
+    if structure == "bwd_monolithic":
+        return [(bq, None) for bq in bqs]
+    return [(bq, bk) for bq in bqs for bk in _divisor_candidates(sk)]
+
+
+def _time_it(fn, args, trials, warmup):
+    import jax
+    for _ in range(max(warmup, 1)):
+        jax.block_until_ready(fn(*args))
+    best = float("inf")
+    for _ in range(max(trials, 1)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def sweep_flash_attention(batch, heads, sq, sk, head_dim, dtype="bfloat16",
+                          causal=True, trials=3, warmup=1,
+                          max_candidates=None, log=print):
+    """Returns {key: entry} tuning entries for every structure the shape
+    dispatches to, each entry carrying the winning blocks + measured ms."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.pallas import flash_attention, tuning
+
+    dt = jnp.dtype(dtype)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (batch, sq, heads, head_dim), dt)
+    k = jax.random.normal(ks[1], (batch, sk, heads, head_dim), dt)
+    v = jax.random.normal(ks[2], (batch, sk, heads, head_dim), dt)
+
+    fwd = jax.jit(functools.partial(flash_attention, causal=causal))
+    grad = jax.jit(jax.grad(
+        lambda q, k, v: flash_attention(q, k, v, causal=causal)
+        .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+
+    # probe: which structures does this shape dispatch to?
+    tuning.clear_last_dispatch()
+    jax.block_until_ready(fwd(q, k, v))
+    jax.block_until_ready(grad(q, k, v))
+    dispatched = tuning.last_dispatch()
+    fwd_structs = sorted(s for s in dispatched if s.startswith("fwd"))
+    bwd_structs = sorted(s for s in dispatched if s.startswith("bwd"))
+    log(f"shape b{batch} h{heads} sq{sq} sk{sk} d{head_dim} {dt.name} "
+        f"{'causal' if causal else 'full'}: structures "
+        f"{fwd_structs + bwd_structs}")
+
+    entries = {}
+
+    def run(structure, timed_fn, pinned):
+        key = dispatched[structure]["key"]
+        cands = candidate_grid(structure, sq, sk)
+        if max_candidates:
+            cands = cands[:max_candidates]
+        best = None
+        for bq, bk in cands:
+            entry = {"block_q": bq}
+            if bk is not None:
+                entry["block_k"] = bk
+            with tuning.tuning_table({**pinned, key: entry}):
+                jax.clear_caches()   # force a re-trace with the candidate
+                try:
+                    ms = _time_it(timed_fn, (q, k, v), trials, warmup)
+                except Exception as e:  # infeasible tiling = skip, not fail
+                    log(f"  {structure} bq={bq} bk={bk}: infeasible ({e})")
+                    continue
+            log(f"  {structure} bq={bq} bk={bk}: {ms:.3f} ms")
+            if best is None or ms < best[1]["ms"]:
+                best = (key, {**entry, "ms": round(ms, 4)})
+        if best is None:
+            raise RuntimeError(f"no feasible candidate for {structure}")
+        entries[best[0]] = best[1]
+        return {best[0]: {k: v for k, v in best[1].items() if k != "ms"}}
+
+    pinned = {}
+    for s in fwd_structs:
+        pinned.update(run(s, fwd, pinned))
+    for s in bwd_structs:
+        # time fwd+bwd with the forward winner pinned so the measurement
+        # isolates the backward tiling
+        pinned.update(run(s, grad, pinned))
+    jax.clear_caches()
+    return entries
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="ds_tpu_bench kernels",
+        description="flash-attention block-size sweep -> tuning artifact")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--seq", type=int, default=1024)
+    p.add_argument("--kv-seq", type=int, default=None,
+                   help="key length (default: --seq)")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--no-causal", action="store_true")
+    p.add_argument("--trials", type=int, default=3)
+    p.add_argument("--warmup", type=int, default=1)
+    p.add_argument("--max-candidates", type=int, default=None,
+                   help="cap the per-structure candidate grid (CI smoke)")
+    p.add_argument("--out", default="benchmarks/results/flash_tuning.json")
+    args = p.parse_args(argv)
+
+    import jax
+    from deepspeed_tpu.ops.pallas import tuning
+    from deepspeed_tpu.ops.pallas._common import on_tpu
+
+    entries = sweep_flash_attention(
+        args.batch, args.heads, args.seq, args.kv_seq or args.seq,
+        args.head_dim, dtype=args.dtype, causal=not args.no_causal,
+        trials=args.trials, warmup=args.warmup,
+        max_candidates=args.max_candidates)
+    device = jax.devices()[0].device_kind if on_tpu() else "cpu-interpret"
+    tuning.save_artifact(
+        args.out, entries, device=device,
+        kind="flash_attention_block_sweep",
+        shape={"batch": args.batch, "heads": args.heads, "seq": args.seq,
+               "kv_seq": args.kv_seq or args.seq,
+               "head_dim": args.head_dim, "dtype": args.dtype,
+               "causal": not args.no_causal},
+        trials=args.trials,
+        note=("interpret-mode timings are NOT representative — regenerate "
+              "on hardware" if device == "cpu-interpret" else
+              "point $DS_TPU_KERNEL_TUNING_CACHE at this file or fold the "
+              "winners into flash_tuning_defaults.json"))
+    print(f"wrote {len(entries)} tuning entr"
+          f"{'y' if len(entries) == 1 else 'ies'} -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

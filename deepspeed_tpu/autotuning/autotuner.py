@@ -73,17 +73,26 @@ class Autotuner:
     @staticmethod
     def build_space(base_config: Dict[str, Any], zero_stages: List[int],
                     micro_batches: List[int], dp_world_size: int = 1,
-                    gas_values: Optional[List[int]] = None
+                    gas_values: Optional[List[int]] = None,
+                    remat_policies: Optional[List[Optional[str]]] = None
                     ) -> List[Dict[str, Any]]:
         """gas_values extends the space over gradient_accumulation_steps —
         the amortization axis for once-per-step costs (host-offload moment
         streaming most of all: measured 61.5 -> 95 TFLOPS on 1.3B ZeRO-2
-        offload going gas 8 -> 32). None keeps the base config's gas."""
+        offload going gas 8 -> 32). None keeps the base config's gas.
+
+        remat_policies extends the space over
+        ``activation_checkpointing.remat_policy`` (models.gpt
+        REMAT_POLICIES keys) — the real TPU recompute/memory trade knob:
+        cheaper policies free HBM for bigger micro batches but recompute
+        less, so it must be costed JOINTLY with micro_batch. Entries may
+        include None (keep the base config's policy)."""
         space = []
         gases = gas_values or [base_config.get(
             "gradient_accumulation_steps", 1)]
-        for stage, mb, gas in itertools.product(zero_stages, micro_batches,
-                                                gases):
+        remats = remat_policies if remat_policies else [None]
+        for stage, mb, gas, rp in itertools.product(
+                zero_stages, micro_batches, gases, remats):
             cfg = {k: (dict(v) if isinstance(v, dict) else v)
                    for k, v in base_config.items()}
             cfg.setdefault("zero_optimization", {})
@@ -92,6 +101,10 @@ class Autotuner:
             cfg["gradient_accumulation_steps"] = gas
             cfg["train_micro_batch_size_per_gpu"] = mb
             cfg["train_batch_size"] = mb * gas * dp_world_size
+            if rp is not None:
+                cfg["activation_checkpointing"] = dict(
+                    cfg.get("activation_checkpointing") or {},
+                    remat_policy=rp)
             space.append(cfg)
         return space
 
@@ -142,8 +155,19 @@ class Autotuner:
             # full remat keeps ~1 residual per layer boundary; no remat
             # keeps every internal activation (~8x a block's residual).
             # The engine enables remat whenever the activation_checkpointing
-            # block is PRESENT (runtime/engine.py) — key off presence.
-            act_factor = 2 if "activation_checkpointing" in config else 8
+            # block is PRESENT (runtime/engine.py) — key off presence, then
+            # refine by the selected remat_policy: "dots" saves every
+            # matmul output (~half of no-remat), "attn_out" one extra
+            # tensor per layer, "offload" stages saveables host-side
+            # (device residual ~= full remat).
+            if "activation_checkpointing" in config:
+                policy = (config.get("activation_checkpointing")
+                          or {}).get("remat_policy") or "full"
+                act_factor = {"none": 8, "full": 2, "offload": 2,
+                              "dots": 4, "dots_no_batch": 4,
+                              "attn_out": 3}.get(policy, 2)
+            else:
+                act_factor = 8
             total += micro * seq * hidden * (layers + 2) * 4 * act_factor
         return total
 
@@ -192,6 +216,7 @@ class Autotuner:
              dp_world_size: int = 1, tuner_type: str = "model_based",
              early_stop: Optional[int] = None,
              gas_values: Optional[List[int]] = None,
+             remat_policies: Optional[List[Optional[str]]] = None,
              model=None, sample_batch=None,
              model_info: Optional[Dict[str, Any]] = None,
              memory_budget_bytes: Optional[float] = None) -> TuneResult:
@@ -205,7 +230,9 @@ class Autotuner:
         space = self.build_space(base_config, list(zero_stages),
                                  list(micro_batches), dp_world_size,
                                  gas_values=(list(gas_values)
-                                             if gas_values else None))
+                                             if gas_values else None),
+                                 remat_policies=(list(remat_policies)
+                                                 if remat_policies else None))
         if model is not None and model_info is None:
             model_info = self.profile_model_info(model, sample_batch or {})
         if model_info is not None and memory_budget_bytes is not None:

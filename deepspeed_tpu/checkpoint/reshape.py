@@ -174,8 +174,9 @@ def reshape_checkpoint(src_dir: str, dst_dir: str, target_mesh_spec=None,
     src = DeepSpeedCheckpoint(src_dir, tag)
     params = src.load_params()
     try:
-        disk = ocp.PyTreeCheckpointer().metadata(
-            os.path.join(src.path, "state")).item_metadata
+        from ..runtime.checkpointing import _item_metadata
+        disk = _item_metadata(ocp.PyTreeCheckpointer(),
+                              os.path.join(src.path, "state"))
         extras = sorted(set(disk.keys()) - {"params"})
     except Exception:
         extras = []

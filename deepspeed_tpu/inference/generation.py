@@ -78,7 +78,16 @@ def _sample(logits, rng, temperature, top_k, top_p):
 def _sample_impl(logits, rng, t, k, p, greedy, has_k, has_p):
     if greedy:
         return jnp.argmax(logits, axis=-1)
-    logits = logits.astype(jnp.float32) / t
+    raw = logits.astype(jnp.float32)
+    # a TRACED temperature can still be 0.0 at runtime (the static
+    # ``greedy`` flag only fires on concrete python numbers — the whole
+    # point of keeping values traced is sweeping them over one
+    # executable): dividing by it would make every logit inf and the
+    # categorical sample NaN-garbage. Divide by a clamped value and
+    # select argmax at the end instead — a runtime-zero temperature
+    # degrades to greedy decoding, matching the static path.
+    zero_t = t <= 0.0
+    logits = raw / jnp.where(zero_t, jnp.float32(1.0), t)
     if has_k:
         # k-th largest via a traced slice into the ascending sort
         asc = jnp.sort(logits, axis=-1)
@@ -95,7 +104,8 @@ def _sample_impl(logits, rng, t, k, p, greedy, has_k, has_p):
         cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
                          keepdims=True)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return jax.random.categorical(rng, logits, axis=-1)
+    sampled = jax.random.categorical(rng, logits, axis=-1)
+    return jnp.where(zero_t, jnp.argmax(raw, axis=-1), sampled)
 
 
 def _decode_loop_impl(module, params, cache, last_token, start_pos,

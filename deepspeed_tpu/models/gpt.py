@@ -34,6 +34,12 @@ REMAT_POLICIES = {
     "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
     "offload": jax.checkpoint_policies.offload_dot_with_no_batch_dims(
         "device", "pinned_host"),
+    # save ONLY the per-layer attention outputs (named via checkpoint_name
+    # in layers.SelfAttention): backward re-runs the MLP matmuls but never
+    # the flash-attention kernel — the middle ground between "full"
+    # (recompute everything, attention twice) and "dots" (save every
+    # matmul output). The knob the perf sweep walks against block sizes.
+    "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out"),
 }
 
 

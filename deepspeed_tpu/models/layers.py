@@ -19,6 +19,7 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
+from jax.ad_checkpoint import checkpoint_name
 
 from ..ops.transformer.attention import attention
 
@@ -473,6 +474,10 @@ class SelfAttention(nn.Module):
                             deterministic=deterministic,
                             backend=self.attn_backend,
                             seq_parallel=self.seq_parallel)
+        # named for the "attn_out" remat policy (save_only_these_names):
+        # under that policy the backward keeps THIS tensor and recomputes
+        # everything else, so the flash kernel never runs twice
+        out = checkpoint_name(out, "attn_out")
         out = out.reshape(b, s, self.d_model)
         out = activation_constraint(out, ("batch", "seq", "embed"))
         return QDense(

@@ -169,7 +169,10 @@ def _decode_dma(q_bhd, k, v, lengths, slopes, *, scale, block_k, hb, alibi):
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, heads, d), q_bhd.dtype),
-        compiler_params=pltpu.CompilerParams(
+        # jax renamed TPUCompilerParams -> CompilerParams around 0.5;
+        # support both so the kernel runs on the pinned CI jax too
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
             dimension_semantics=("parallel", "parallel")),
         interpret=_interpret(),
     )(lengths, slopes, q_bhd, kr, vr)

@@ -59,10 +59,16 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Hand-picked FALLBACK tilings (swept once on v5e at s1024: the resident
+# fori prefers block_k 512, the streamed grid 1024). The dispatch consults
+# the shape-keyed tuning cache (tuning.py — runtime table, then the
+# $DS_TPU_KERNEL_TUNING_CACHE artifact, then the committed default table)
+# FIRST; these constants only apply on a full cache miss.
 DEFAULT_BLOCK_Q = 512
-RESIDENT_BLOCK_K = 512   # swept on v5e: resident fori prefers 512,
-STREAMED_BLOCK_K = 1024  # the streamed grid prefers 1024
+RESIDENT_BLOCK_K = 512
+STREAMED_BLOCK_K = 1024
 
+from . import tuning as _tuning
 from ._common import NEG_INF
 from ._common import interpret_mode as _interpret
 
@@ -562,6 +568,37 @@ def _dkv_kernel_streamed(q_ref, k_ref, v_ref, do_ref, delta_ref, lse_ref,
 # dispatch
 # ---------------------------------------------------------------------------
 
+def _resolve_blocks(structure, sq, sk, d, dtype, causal, block_q,
+                    fallback_bq, fallback_bk=None, record=True):
+    """Trace-time block-size resolution for one kernel structure: an
+    explicit caller ``block_q`` wins, else the shape-keyed tuning cache,
+    else the hand-picked fallback constants. Every size passes through
+    ``_block`` (divisor + 128-lane alignment), so a stale or foreign
+    cache entry can never produce an illegal tiling. Returns
+    (block_q, block_k-or-None) and — unless this is a provisional
+    resolution (``record=False``: the caller may still demote the
+    structure on a bias VMEM-budget check) — records the dispatch for
+    the ``tuning.last_dispatch`` probe, which must only ever name
+    structures that actually run (the sweep harness tunes exactly what
+    the probe reports)."""
+    entry, key, source = _tuning.lookup(
+        "flash_attention", structure, sq=sq, sk=sk, d=d, dtype=dtype,
+        causal=causal)
+    want_q = (block_q if block_q is not None
+              else int(entry.get("block_q", fallback_bq)))
+    bq = _block(sq, min(want_q, sq))
+    rec = dict(block_q=bq)
+    bk = None
+    if fallback_bk is not None:
+        bk = _block(sk, min(int(entry.get("block_k", fallback_bk)), sk))
+        rec["block_k"] = bk
+    if record:
+        _tuning.record_dispatch(
+            "flash_attention", structure, key,
+            "caller" if block_q is not None else source, **rec)
+    return bq, bk
+
+
 def _bias_meta(bias):
     """(batched, headed, q_full) broadcast flags of a [b', h', sq', sk]
     bias operand."""
@@ -626,22 +663,34 @@ def _flash_fwd(q, k, v, bias, seeds, scale, causal, dropout_rate,
                total_heads, block_q):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    block_q = _block(sq, min(block_q, sq))
     has_bias = bias is not None
     drop = dropout_rate if seeds is not None else 0.0
     common = dict(scale=scale, causal=causal, has_bias=has_bias,
                   dropout_rate=drop, total_heads=total_heads)
     out_shape = (jax.ShapeDtypeStruct(q.shape, q.dtype),
                  jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32))
+    caller_bq = block_q   # keep the caller's request distinct from the
+    resident = _kv_fits_vmem(sk, d, q.dtype.itemsize)   # resolved values
+    if resident:
+        block_q, block_k = _resolve_blocks(
+            "fwd_resident", sq, sk, d, q.dtype, causal, caller_bq,
+            DEFAULT_BLOCK_Q, RESIDENT_BLOCK_K, record=False)
+        if has_bias and bias.shape[2] > 1 and (
+                # a full-extent bias tile [Bq, sk] shares VMEM with
+                # resident K/V
+                block_q * sk * bias.dtype.itemsize > _BIAS_TILE_BUDGET):
+            resident = False
+    if resident:
+        _resolve_blocks("fwd_resident", sq, sk, d, q.dtype, causal,
+                        caller_bq, DEFAULT_BLOCK_Q, RESIDENT_BLOCK_K)
+    else:
+        block_q, block_k = _resolve_blocks(
+            "fwd_streamed", sq, sk, d, q.dtype, causal, caller_bq,
+            DEFAULT_BLOCK_Q, STREAMED_BLOCK_K)
     q_blk3 = pl.BlockSpec((1, 1, block_q, d),
                           lambda bi, hi, qi: (bi, hi, qi, 0))
     lse_blk3 = pl.BlockSpec((1, 1, block_q, 1),
                             lambda bi, hi, qi: (bi, hi, qi, 0))
-    resident = _kv_fits_vmem(sk, d, q.dtype.itemsize)
-    if has_bias and bias.shape[2] > 1:
-        # a full-extent bias tile [Bq, sk] shares VMEM with resident K/V
-        resident = resident and (
-            block_q * sk * bias.dtype.itemsize <= _BIAS_TILE_BUDGET)
     if resident:
         extra, extra_specs = _extra_ops(
             bias, seeds, _bias_spec3(bias, block_q) if has_bias else None)
@@ -649,7 +698,7 @@ def _flash_fwd(q, k, v, bias, seeds, scale, causal, dropout_rate,
                                lambda bi, hi, qi: (bi, hi, 0, 0))
         o, lse = pl.pallas_call(
             functools.partial(_fwd_kernel_resident, block_q=block_q,
-                              block_k=_block(sk, RESIDENT_BLOCK_K),
+                              block_k=block_k,
                               causal_shift=sk - sq, **common),
             grid=(b, h, sq // block_q),
             in_specs=[q_blk3, kv_full, kv_full, *extra_specs],
@@ -658,7 +707,6 @@ def _flash_fwd(q, k, v, bias, seeds, scale, causal, dropout_rate,
             interpret=_interpret(),
         )(q, k, v, *extra)
         return o, lse
-    block_k = _block(sk, STREAMED_BLOCK_K)
     nkb = sk // block_k
     extra, extra_specs = _extra_ops(
         bias, seeds,
@@ -754,10 +802,20 @@ def _flash_bwd(scale, causal, dropout_rate, block_q, total_heads,
     # this structure (its [sq, sk] tile outgrows VMEM) — two-pass then.
     if (sk <= MONOLITHIC_BWD_MAX_SEQ and sq <= MONOLITHIC_BWD_MAX_SEQ
             and not bias_q_full):
+        entry, key, source = _tuning.lookup(
+            "flash_attention", "bwd_monolithic", sq=sq, sk=sk, d=d,
+            dtype=q.dtype, causal=causal)
+        want = (block_q if block_q is not None
+                else int(entry.get("block_q", DEFAULT_BLOCK_Q)))
+        # VMEM cap on the [Bq, S] fp32 score tiles stays authoritative
+        # over any cache entry
         cap = max(128, (2 ** 19 // max(sk, 1)) // 128 * 128)
-        bq = math.gcd(sq, min(block_q, sq, cap))
+        bq = math.gcd(sq, min(want, sq, cap))
         if bq % 8 != 0:
             bq = sq
+        _tuning.record_dispatch(
+            "flash_attention", "bwd_monolithic", key,
+            "caller" if block_q is not None else source, block_q=bq)
         extra, extra_specs = _extra_ops(
             bias, seeds, _bias_spec2(bias) if has_bias else None)
         full_q = pl.BlockSpec((1, 1, sq, d), lambda bi, hi: (bi, hi, 0, 0))
@@ -775,17 +833,26 @@ def _flash_bwd(scale, causal, dropout_rate, block_q, total_heads,
         )(q, k, v, o, g, *extra)
         return (dq, dk, dv, dbias, dseeds)
 
-    block_q = _block(sq, min(block_q, sq))
+    caller_bq = block_q
     resident = (_kv_fits_vmem(sk, d, q.dtype.itemsize)
                 and _kv_fits_vmem(sq, d, q.dtype.itemsize))
-    if bias_q_full:
-        # both passes load full-extent bias tiles: [Bq, sk] in dq and
-        # [sq, Bk] in dkv — budget the larger one
-        rbk = _block(sk, RESIDENT_BLOCK_K)
-        resident = resident and (
-            max(block_q * sk, sq * rbk) * bias.dtype.itemsize
-            <= _BIAS_TILE_BUDGET)
-    block_k = _block(sk, RESIDENT_BLOCK_K if resident else STREAMED_BLOCK_K)
+    if resident:
+        block_q, block_k = _resolve_blocks(
+            "bwd_resident", sq, sk, d, q.dtype, causal, caller_bq,
+            DEFAULT_BLOCK_Q, RESIDENT_BLOCK_K, record=False)
+        if bias_q_full and (
+                # both passes load full-extent bias tiles: [Bq, sk] in dq
+                # and [sq, Bk] in dkv — budget the larger one
+                max(block_q * sk, sq * block_k) * bias.dtype.itemsize
+                > _BIAS_TILE_BUDGET):
+            resident = False
+    if resident:
+        _resolve_blocks("bwd_resident", sq, sk, d, q.dtype, causal,
+                        caller_bq, DEFAULT_BLOCK_Q, RESIDENT_BLOCK_K)
+    else:
+        block_q, block_k = _resolve_blocks(
+            "bwd_streamed", sq, sk, d, q.dtype, causal, caller_bq,
+            DEFAULT_BLOCK_Q, STREAMED_BLOCK_K)
     nqb, nkb = sq // block_q, sk // block_k
     # delta = rowsum(do * o): cheap elementwise outside the kernels
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
@@ -907,8 +974,14 @@ _flash_attention_bhsd.defvjp(_fwd_rule, _flash_bwd)
 
 def flash_attention(q, k, v, *, bias=None, causal=True, softmax_scale=None,
                     dropout_rate=0.0, dropout_rng=None, dropout_offsets=None,
-                    bias_grad=True, block_q=DEFAULT_BLOCK_Q):
+                    bias_grad=True, block_q=None):
     """q,k,v: [batch, seq, heads, head_dim] (BSHD). Returns like q.
+
+    block_q: None (default) = table-driven — each kernel structure reads
+    its block sizes from the shape-keyed tuning cache (ops.pallas.tuning:
+    runtime table > $DS_TPU_KERNEL_TUNING_CACHE artifact > committed
+    default table > hand-picked constants). An explicit int forces that
+    q-block for every structure (block_k stays table-driven).
 
     bias: optional additive [b|1, h|1, sq|1, sk] operand (fold boolean
     masks to 0/-1e30 before calling — ``ops.transformer.attention`` does).
@@ -924,10 +997,12 @@ def flash_attention(q, k, v, *, bias=None, causal=True, softmax_scale=None,
     d = q.shape[-1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
     sq = q.shape[1]
-    bq = min(block_q, sq)
-    if sq % bq != 0:
-        raise ValueError(f"flash_attention: seq {sq} must be divisible by "
-                         f"block_q {bq}")
+    bq = None
+    if block_q is not None:
+        bq = min(int(block_q), sq)
+        if sq % bq != 0:
+            raise ValueError(f"flash_attention: seq {sq} must be divisible "
+                             f"by block_q {bq}")
     bias4 = None
     if bias is not None:
         full = (q.shape[0], q.shape[2], sq)

@@ -35,12 +35,36 @@ CPU_CHECKPOINT = False
 CONTIGUOUS_CHECKPOINTING = False
 SYNCHRONIZE = False
 PROFILE_TIME = False
+REMAT_POLICY = None      # models.gpt.REMAT_POLICIES key; None = default
 _NUM_LAYERS = None
 _MPU = None
 
 
+def set_remat_policy(name):
+    """Select WHAT a checkpointed region saves (NEW TPU knob; the
+    reference always recomputes everything). ``name``: a
+    ``models.gpt.REMAT_POLICIES`` key ("full", "dots", "attn_out",
+    "offload", ...) or None to restore the default."""
+    global REMAT_POLICY
+    if name is not None:
+        from ...models.gpt import REMAT_POLICIES
+        if name not in REMAT_POLICIES:
+            raise ValueError(f"unknown remat policy {name!r} "
+                             f"(known: {sorted(REMAT_POLICIES)})")
+    REMAT_POLICY = name
+
+
 def _policy():
     """jax.checkpoint policy for the current knob settings."""
+    if REMAT_POLICY is not None:
+        if REMAT_POLICY == "none":
+            # inside an explicit checkpoint() region "no remat" means
+            # save-everything — REMAT_POLICIES maps "none" to the policy
+            # value None, which jax.checkpoint would read as its
+            # recompute-everything DEFAULT (the opposite)
+            return jax.checkpoint_policies.everything_saveable
+        from ...models.gpt import REMAT_POLICIES
+        return REMAT_POLICIES[REMAT_POLICY]
     if CPU_CHECKPOINT:
         return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
             "device", "pinned_host")
@@ -118,10 +142,12 @@ def set_num_layers(nlayers):
 
 def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
               contiguous_checkpointing=None, num_checkpoints=None,
-              checkpoint_in_cpu=None, synchronize=None, profile=None):
-    """Reference: checkpointing.py:825 — same signature; knobs without a
-    TPU analog (contiguous buffers, explicit synchronize) are accepted and
-    recorded but do not change compilation."""
+              checkpoint_in_cpu=None, synchronize=None, profile=None,
+              remat_policy=None):
+    """Reference: checkpointing.py:825 — same signature plus the TPU-only
+    ``remat_policy`` selector; knobs without a TPU analog (contiguous
+    buffers, explicit synchronize) are accepted and recorded but do not
+    change compilation."""
     global _CONFIGURED, _MPU, PARTITION_ACTIVATIONS, CPU_CHECKPOINT
     global CONTIGUOUS_CHECKPOINTING, SYNCHRONIZE, PROFILE_TIME, _NUM_LAYERS
 
@@ -141,11 +167,14 @@ def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
                     acfg.synchronize_checkpoint_boundary,
                 "profile": acfg.profile,
                 "number_checkpoints": acfg.number_checkpoints,
+                "remat_policy": acfg.remat_policy,
             }
         else:
             block = block.get("activation_checkpointing", block)
         PARTITION_ACTIVATIONS = bool(block.get("partition_activations", False))
         CPU_CHECKPOINT = bool(block.get("cpu_checkpointing", False))
+        if block.get("remat_policy") is not None:
+            set_remat_policy(block["remat_policy"])
         CONTIGUOUS_CHECKPOINTING = bool(
             block.get("contiguous_memory_optimization", False))
         SYNCHRONIZE = bool(block.get("synchronize_checkpoint_boundary", False))
@@ -165,6 +194,8 @@ def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
         SYNCHRONIZE = bool(synchronize)
     if profile is not None:
         PROFILE_TIME = bool(profile)
+    if remat_policy is not None:
+        set_remat_policy(remat_policy)
     if CPU_CHECKPOINT and jax.default_backend() == "cpu":
         from ...utils.logging import logger
         logger.warning("checkpoint_in_cpu: pinned_host offload unsupported "
@@ -182,10 +213,12 @@ def reset():
     """Reference: checkpointing.py:768 — clear configured state."""
     global _CONFIGURED, _MPU, PARTITION_ACTIVATIONS, CPU_CHECKPOINT
     global CONTIGUOUS_CHECKPOINTING, SYNCHRONIZE, PROFILE_TIME, _NUM_LAYERS
+    global REMAT_POLICY
     _CONFIGURED = False
     _MPU = None
     PARTITION_ACTIVATIONS = CPU_CHECKPOINT = False
     CONTIGUOUS_CHECKPOINTING = SYNCHRONIZE = PROFILE_TIME = False
+    REMAT_POLICY = None
     _NUM_LAYERS = None
 
 

@@ -26,6 +26,32 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _item_metadata(ckptr, path):
+    """Checkpoint structure metadata across orbax API drift: newer orbax
+    wraps the tree in an object carrying ``item_metadata``, older returns
+    the tree directly."""
+    meta = ckptr.metadata(path)
+    return getattr(meta, "item_metadata", meta)
+
+
+def _partial_restore(ckptr, path, template):
+    """PyTreeRestore of ``template``, tolerating extra on-disk keys.
+    Newer orbax spells that ``partial_restore=True``; older versions
+    (<=0.7) get the same semantics from the transforms API — an empty
+    transforms dict with default-to-original makes ``item`` the output
+    structure and silently drops disk keys it omits."""
+    import inspect
+    import orbax.checkpoint as ocp
+    restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+    if "partial_restore" in inspect.signature(
+            ocp.args.PyTreeRestore.__init__).parameters:
+        kw = {"partial_restore": True}
+    else:
+        kw = {"transforms": {}}
+    return ckptr.restore(path, args=ocp.args.PyTreeRestore(
+        item=template, restore_args=restore_args, **kw))
+
+
 def _async_checkpointer(engine):
     """One AsyncCheckpointer per engine (it owns a worker thread): the
     initial device->host snapshot is synchronous, the file writes run in
@@ -141,9 +167,8 @@ def load_module_params(load_dir, mesh=None, tag=None):
         with open(latest) as f:
             tag = f.read().strip()
     path = os.path.join(os.path.abspath(load_dir), str(tag), "state")
-    import orbax.checkpoint as ocp
     ckptr = _checkpointer()
-    disk = ckptr.metadata(path).item_metadata
+    disk = _item_metadata(ckptr, path)
     if "params" not in disk.keys():
         raise ValueError(f"checkpoint at {path} has no 'params' subtree")
     # restore ONLY the params subtree: an Adam engine checkpoint is ~3x
@@ -152,9 +177,7 @@ def load_module_params(load_dir, mesh=None, tag=None):
     # rest on disk)
     template = {"params": jax.tree.map(
         lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), dict(disk["params"]))}
-    restore_args = ocp.checkpoint_utils.construct_restore_args(template)
-    restored = ckptr.restore(path, args=ocp.args.PyTreeRestore(
-        item=template, restore_args=restore_args, partial_restore=True))
+    restored = _partial_restore(ckptr, path, template)
     return restored["params"]
 
 
@@ -203,15 +226,13 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
     # cross-loads — the guards below handle their absence), and use
     # partial_restore for disk keys the template omits (load_module_only,
     # load_optimizer_states=False)
-    on_disk = set(ckptr.metadata(item_path).item_metadata.keys())
+    on_disk = set(_item_metadata(ckptr, item_path).keys())
     missing = sorted(set(template) - on_disk)
     if missing:
         logger.warning(f"checkpoint at {item_path} lacks {missing}; those "
                        "engine states keep their current values")
         template = {k: v for k, v in template.items() if k in on_disk}
-    restore_args = ocp.checkpoint_utils.construct_restore_args(template)
-    restored = ckptr.restore(item_path, args=ocp.args.PyTreeRestore(
-        item=template, restore_args=restore_args, partial_restore=True))
+    restored = _partial_restore(ckptr, item_path, template)
 
     engine.params = restored["params"]
     if load_optimizer_states and not load_module_only and "optimizer_state" in restored:

@@ -42,24 +42,47 @@ def compress_1bit(x, error):
     return signs, scale, new_error
 
 
+def _sign_wire_dtype(n):
+    """Wire dtype for the sign psum. bf16 (half the fp32 bytes) carries
+    partial sums of ±1 EXACTLY only while they fit 8 significand bits —
+    integers through 256; at 257 participants a ring partial sum can land
+    on a non-representable odd integer and silently round. Past that the
+    signs ship fp32 (correctness over compression; chunking the axis
+    would preserve the ratio but no current mesh is that deep). ``n`` is
+    static (lax.psum of a python int) under shard_map/pmap; a traced size
+    conservatively gets fp32."""
+    if isinstance(n, int) and n <= 256:
+        return jnp.bfloat16
+    return jnp.float32
+
+
 def compressed_allreduce(x, error, axis_name: str):
     """1-bit mean-allreduce inside shard_map/pmap: TWO psums actually on
-    the wire — the bf16 sign tensor (half the bytes of fp32; exact: ±1
-    and partial sums up to the ring size are bf16-representable) and one
+    the wire — the sign tensor (bf16 while the axis size keeps the ±1
+    partial sums exactly representable, see ``_sign_wire_dtype``) and one
     fp32 scalar. Result = mean_scale * mean_sign — the mean-scale
     approximation of mean_i(scale_i*sign_i) (exact when scales agree,
-    e.g. axis size 1 or homogeneous shards); the per-worker residual vs
-    its own scale*sign stays in the error feedback, the same compensation
-    contract as the reference's worker error (nccl.py compressed_allreduce).
-    Returns (reduced, new_error).
+    e.g. axis size 1 or homogeneous shards). Error feedback compensates
+    against the value the aggregate ACTUALLY used on this worker's
+    behalf, mean_scale*sign_i — i.e. the per-worker aggregation residual
+    (scale_i - mean_scale)*sign_i is folded into the carried error
+    alongside the local quantization residual, so the mean-scale
+    approximation error is re-injected (and corrected) on later steps
+    instead of silently accumulating. Returns (reduced, new_error).
 
-    NOTE: upcasting signs to fp32 before the psum would silently ship
-    full fp32 traffic — the whole point of the compression (r5 review)."""
+    NOTE: upcasting signs to fp32 before the psum (when bf16 is exact)
+    would silently ship full fp32 traffic — the whole point of the
+    compression (r5 review)."""
     n = lax.psum(1, axis_name)
-    signs, scale, new_error = compress_1bit(x, error)
-    summed_signs = lax.psum(signs.astype(jnp.bfloat16),
+    corrected = x + error
+    scale = jnp.mean(jnp.abs(corrected))
+    signs = jnp.sign(corrected)
+    signs = jnp.where(signs == 0, 1.0, signs)  # sign(0) -> +1, like packbits
+    summed_signs = lax.psum(signs.astype(_sign_wire_dtype(n)),
                             axis_name).astype(jnp.float32)
     mean_scale = lax.psum(scale, axis_name) / n
+    # EF identity per worker: mean_scale*sign_i + new_error_i == x_i + e_i
+    new_error = corrected - mean_scale * signs
     return mean_scale * summed_signs / n, new_error
 
 

@@ -169,6 +169,14 @@ class DataTypesConfig:
             f"{self.grad_accum_dtype!r}")
 
 
+# Mirrors models.gpt.REMAT_POLICIES (kept in sync by a unit test; defined
+# here so config validation never imports the model zoo). NEW (TPU): the
+# reference always recomputes the whole region; XLA remat lets the policy
+# choose WHAT to save — a real perf knob the autotuner can walk.
+REMAT_POLICY_NAMES = ("none", "full", "dots", "dots_no_batch", "offload",
+                      "attn_out")
+
+
 @dataclass
 class ActivationCheckpointingConfig:
     partition_activations: bool = False
@@ -177,6 +185,19 @@ class ActivationCheckpointingConfig:
     number_checkpoints: Optional[int] = None
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False
+    # NEW (TPU): which activations the checkpointed region SAVES
+    # (models.gpt.REMAT_POLICIES key). None = "full" (recompute
+    # everything, the reference semantics); "dots" = save matmul outputs;
+    # "attn_out" = save only attention outputs (never recompute the flash
+    # kernel); "offload" = saveable dots staged to pinned host memory.
+    remat_policy: Optional[str] = None
+
+    def __post_init__(self):
+        if (self.remat_policy is not None
+                and self.remat_policy not in REMAT_POLICY_NAMES):
+            raise DeepSpeedConfigError(
+                f"activation_checkpointing.remat_policy must be one of "
+                f"{REMAT_POLICY_NAMES}, got {self.remat_policy!r}")
 
 
 # ---------------------------------------------------------------------------

@@ -255,12 +255,30 @@ class DeepSpeedEngine:
                 "own model code to honor it")
             return
         remat = mcfg.remat if mcfg.remat != "none" else "full"
+        if acfg.remat_policy is not None:
+            # explicit policy selection (NEW TPU knob): which activations
+            # the checkpointed region saves — walked by the autotuner and
+            # the kernel-tuning sweep. Validated by the config dataclass;
+            # re-checked against the live table in case they drift.
+            from ..models.gpt import REMAT_POLICIES
+            if acfg.remat_policy not in REMAT_POLICIES:
+                raise DeepSpeedConfigError(
+                    f"activation_checkpointing.remat_policy "
+                    f"{acfg.remat_policy!r} is not a model remat policy "
+                    f"(known: {sorted(REMAT_POLICIES)})")
+            remat = acfg.remat_policy
         if acfg.cpu_checkpointing:
             if jax.default_backend() == "cpu":
                 logger.warning(
                     "activation_checkpointing.cpu_checkpointing: pinned_host "
                     "offload unsupported on the CPU backend — falling back "
                     "to full recompute")
+            elif acfg.remat_policy not in (None, "offload"):
+                logger.warning(
+                    "activation_checkpointing: both cpu_checkpointing and "
+                    f"remat_policy={acfg.remat_policy!r} set — the explicit "
+                    "policy wins (use remat_policy='offload' for host-"
+                    "staged residuals)")
             else:
                 remat = "offload"
         if remat != mcfg.remat:

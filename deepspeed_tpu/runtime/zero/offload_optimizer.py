@@ -234,9 +234,13 @@ class StreamedHostAdam:
     (stage_1_and_2.py cpu_offload, runtime/swap_tensor/
     pipelined_optimizer_swapper.py), expressed as memory-kind transfers
     that XLA's latency-hiding scheduler overlaps with the neighboring
-    leaves' compute. Unlike the native path, traffic rides the
-    accelerator host's PCIe — nothing crosses the client process, so it
-    works at full speed on remote/tunneled backends.
+    leaves' compute. The per-leaf walk is DOUBLE-BUFFERED (leaf N+1's
+    moment h2d issued before leaf N's update math — see
+    ``utils.streaming.double_buffered``), so the transfer and compute
+    chains stay exactly one leaf apart for the scheduler to overlap.
+    Unlike the native path, traffic rides the accelerator host's PCIe —
+    nothing crosses the client process, so it works at full speed on
+    remote/tunneled backends.
 
     Update math matches ``build_optimizer``'s Adam/AdamW exactly
     (bias-corrected moments; adamw=True -> decoupled weight decay,
@@ -245,7 +249,7 @@ class StreamedHostAdam:
 
     def __init__(self, opt_params: Dict[str, Any], adamw: bool,
                  param_specs, param_shapes, mesh, zero_stage: int,
-                 param_names=None):
+                 param_names=None, prefetch: bool = True):
         from jax.sharding import PartitionSpec as P
         from .sharding import make_opt_state_rules
 
@@ -277,6 +281,15 @@ class StreamedHostAdam:
             lambda spec: NamedSharding(mesh, spec), param_specs,
             is_leaf=lambda x: isinstance(x, P))
         self._rep = NamedSharding(mesh, jax.sharding.PartitionSpec())
+        # double-buffer the per-leaf host->device moment fetches: leaf
+        # N+1's h2d is issued before leaf N's update math (the reference's
+        # PipelinedOptimizerSwapper read-ahead). Math is IDENTICAL either
+        # way — prefetch only reorders trace emission (parity-tested).
+        self.prefetch = bool(prefetch)
+        # trace-time event log of the most recent apply(): ("fetch", i) /
+        # ("compute", i) in emission order — the overlap-ordering probe
+        # the double-buffering test asserts on
+        self._trace_events = []
 
     def state_shardings(self):
         return {"mu": self.host_shardings, "nu": self.host_shardings,
@@ -300,35 +313,40 @@ class StreamedHostAdam:
         return self.apply(params, grads, state, lr, grad_scale=factor)
 
     def apply(self, params, grads, state, lr, grad_scale=None):
-        """Traced: one bias-corrected Adam step, streamed per leaf."""
+        """Traced: one bias-corrected Adam step, streamed per leaf with
+        the NEXT leaf's host moments prefetched while the current leaf
+        computes (``utils.streaming.double_buffered``)."""
         count = state["count"] + 1
         c = count.astype(jnp.float32)
         bc1 = 1.0 - self.b1 ** c
         bc2 = 1.0 - self.b2 ** c
 
         p_flat, treedef = jax.tree.flatten(params)
-        g_flat = jax.tree.leaves(grads)
-        mu_flat = jax.tree.leaves(state["mu"])
-        nu_flat = jax.tree.leaves(state["nu"])
-        dev_sh = jax.tree.leaves(self.dev_shardings)
-        host_sh = jax.tree.leaves(self.host_shardings)
-        pdev_sh = jax.tree.leaves(self.param_dev_shardings)
+        leaves = list(zip(p_flat, jax.tree.leaves(grads),
+                          jax.tree.leaves(state["mu"]),
+                          jax.tree.leaves(state["nu"]),
+                          jax.tree.leaves(self.dev_shardings),
+                          jax.tree.leaves(self.host_shardings),
+                          jax.tree.leaves(self.param_dev_shardings)))
+        self._trace_events = events = []
 
-        new_p, new_mu, new_nu = [], [], []
-        for p, g, mu, nu, dsh, hsh, psh in zip(p_flat, g_flat, mu_flat,
-                                               nu_flat, dev_sh, host_sh,
-                                               pdev_sh):
-            mu_d = jax.device_put(mu, dsh)
-            nu_d = jax.device_put(nu, dsh)
-            # with offload_param, p and g arrive host-space: fetch for the
-            # update math (no-op for device leaves); the train step's
+        def fetch(i):
+            p, g, mu, nu, dsh, _, psh = leaves[i]
+            events.append(("fetch", i))
+            # with offload_param, p and g arrive host-space too: fetch for
+            # the update math (no-op for device leaves); the train step's
             # out_shardings place new_p back in its home space
-            g = jax.device_put(g, dsh)
-            p = jax.device_put(p, psh)
+            return (jax.device_put(mu, dsh), jax.device_put(nu, dsh),
+                    jax.device_put(g, dsh), jax.device_put(p, psh))
+
+        def compute(i, fetched):
+            p, *_rest, hsh, _psh = leaves[i]
+            mu_d, nu_d, g, p_d = fetched
+            events.append(("compute", i))
             g32 = g.astype(jnp.float32)
             if grad_scale is not None:
                 g32 = g32 * grad_scale
-            p32 = p.astype(jnp.float32)
+            p32 = p_d.astype(jnp.float32)
             if not self.adamw and self.wd > 0.0:
                 g32 = g32 + self.wd * p32           # classic L2
             mu_n = self.b1 * mu_d + (1.0 - self.b1) * g32
@@ -336,9 +354,20 @@ class StreamedHostAdam:
             upd = (mu_n / bc1) / (jnp.sqrt(nu_n / bc2) + self.eps)
             if self.adamw and self.wd > 0.0:
                 upd = upd + self.wd * p32           # decoupled decay
-            new_p.append((p32 - lr * upd).astype(p.dtype))
-            new_mu.append(jax.device_put(mu_n, hsh))
-            new_nu.append(jax.device_put(nu_n, hsh))
+            return ((p32 - lr * upd).astype(p.dtype),
+                    jax.device_put(mu_n, hsh), jax.device_put(nu_n, hsh))
+
+        new_p, new_mu, new_nu = [], [], []
+        if self.prefetch:
+            from ...utils.streaming import double_buffered
+            stream = double_buffered(range(len(leaves)), fetch)
+        else:
+            stream = ((i, fetch(i)) for i in range(len(leaves)))
+        for i, fetched in stream:
+            p_n, mu_n, nu_n = compute(i, fetched)
+            new_p.append(p_n)
+            new_mu.append(mu_n)
+            new_nu.append(nu_n)
 
         return (jax.tree.unflatten(treedef, new_p),
                 {"mu": jax.tree.unflatten(treedef, new_mu),

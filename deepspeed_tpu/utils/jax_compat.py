@@ -1,13 +1,24 @@
 """Small compatibility layer over jax API drift.
 
 Keeps the rest of the framework on one spelling of shard_map regardless of
-jax version (0.8 experimental check_rep vs 0.9 jax.shard_map check_vma).
+jax version (0.8 experimental check_rep vs 0.9 jax.shard_map check_vma),
+and installs the ``jax.tree.*_with_path`` aliases on versions that only
+ship them under ``jax.tree_util`` (pre-0.5).
 """
 
 import inspect
 import functools
 
 import jax
+
+# jax.tree.{flatten,leaves,map}_with_path landed after the pinned CI jax;
+# alias the identical tree_util functions so the whole framework (and
+# future jax) use ONE spelling. No-op on jax versions that have them.
+if not hasattr(jax.tree, "flatten_with_path"):  # pragma: no branch
+    import jax.tree_util as _tree_util
+    jax.tree.flatten_with_path = _tree_util.tree_flatten_with_path
+    jax.tree.leaves_with_path = _tree_util.tree_leaves_with_path
+    jax.tree.map_with_path = _tree_util.tree_map_with_path
 
 
 @functools.lru_cache(None)

@@ -47,6 +47,31 @@ def stream_in_tree(tree):
     return jax.tree.map(stream_in, tree)
 
 
+def double_buffered(items, fetch):
+    """Iterate ``(item, fetch(item))`` with item i+1's fetch ISSUED before
+    item i is yielded — the classic double buffer, expressed at trace
+    time.
+
+    Why issue order matters even though XLA schedules by dataflow: the
+    h2d copies this wraps (``jax.device_put`` of pinned-host leaves) are
+    what the latency-hiding scheduler overlaps with compute, and it can
+    only hoist a copy ahead of the *previous* item's compute if nothing
+    artificially sequences them. Emitting fetch N+1 before compute N
+    keeps the two dependency chains (transfers, math) interleaved in the
+    trace exactly one item ahead — the reference's
+    PipelinedOptimizerSwapper read-ahead, with the compiler as the
+    executor. Callers that want the prefetch observable (tests) can
+    record events inside ``fetch``."""
+    items = list(items)
+    if not items:
+        return
+    ahead = fetch(items[0])
+    for i, item in enumerate(items):
+        current = ahead
+        ahead = fetch(items[i + 1]) if i + 1 < len(items) else None
+        yield item, current
+
+
 def to_host_tree(tree):
     """Place a pytree in host memory space (init-time placement)."""
     return jax.tree.map(

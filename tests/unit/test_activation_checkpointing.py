@@ -132,3 +132,128 @@ def test_checkpoint_wrapper_decorator():
     wrapped = ckpt_api.checkpoint_wrapper(_mlp)
     np.testing.assert_allclose(float(wrapped(w1, w2, x)),
                                float(_mlp(w1, w2, x)), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# remat policy selection (the TPU recompute/memory knob)
+# ---------------------------------------------------------------------------
+
+from deepspeed_tpu.models.gpt import REMAT_POLICIES  # noqa: E402
+from deepspeed_tpu.runtime.config import (  # noqa: E402
+    DeepSpeedConfigError, REMAT_POLICY_NAMES, ActivationCheckpointingConfig)
+
+
+def test_remat_policy_names_match_model_table():
+    """config.REMAT_POLICY_NAMES mirrors models.gpt.REMAT_POLICIES (the
+    config module must not import the model zoo, so the sync is a test)."""
+    assert set(REMAT_POLICY_NAMES) == set(REMAT_POLICIES)
+
+
+def test_config_rejects_unknown_remat_policy():
+    with pytest.raises(DeepSpeedConfigError):
+        ActivationCheckpointingConfig(remat_policy="save_everything_twice")
+
+
+_REMAT_GPT_KW = dict(vocab_size=32, max_seq_len=8, d_model=16, n_layers=2,
+                     n_heads=2, scan_layers=True)
+
+
+@pytest.fixture(scope="module")
+def _remat_baseline():
+    """(ids, params, base grads) computed ONCE for the no-remat model —
+    every policy test compares against it (remat changes WHAT is saved,
+    never the math), without re-paying the baseline trace per policy."""
+    from deepspeed_tpu.models import GPT, GPTConfig, gpt_loss_fn
+    from flax.core import meta
+    cfg = GPTConfig(dtype=jnp.float32, remat="none", **_REMAT_GPT_KW)
+    model = GPT(cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, 32)
+    params = meta.unbox(model.init(jax.random.PRNGKey(1), ids))
+    val, g0 = jax.value_and_grad(
+        lambda p: gpt_loss_fn(model.apply(p, ids)[:, :-1], ids[:, 1:])
+    )(params)
+    return ids, params, float(val), jax.tree.leaves(g0)
+
+
+@pytest.mark.parametrize("policy", sorted(REMAT_POLICIES))
+def test_gpt_trains_under_every_remat_policy(policy, _remat_baseline):
+    """Each REMAT_POLICIES key must produce a working model: finite loss
+    and grads matching the no-remat baseline."""
+    from deepspeed_tpu.models import GPT, GPTConfig, gpt_loss_fn
+    if policy == "offload":
+        pytest.skip("pinned_host memory kind unsupported on CPU backend")
+    ids, params, val0, g0 = _remat_baseline
+    model = GPT(GPTConfig(dtype=jnp.float32, remat=policy, **_REMAT_GPT_KW))
+
+    def loss(p):
+        logits = model.apply(p, ids)
+        return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
+
+    val, grads = jax.value_and_grad(loss)(params)
+    np.testing.assert_allclose(float(val), val0, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(grads), g0):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_configure_remat_policy_drives_checkpoint_policy():
+    ckpt_api.configure(deepspeed_config={
+        "activation_checkpointing": {"remat_policy": "dots"}})
+    assert C.REMAT_POLICY == "dots"
+    assert C._policy() is REMAT_POLICIES["dots"]
+    # kwarg form wins too, and reset clears
+    ckpt_api.configure(remat_policy="attn_out")
+    assert C._policy() is REMAT_POLICIES["attn_out"]
+    # "none" inside an explicit checkpoint() region = save everything
+    # (REMAT_POLICIES maps it to the policy value None, which
+    # jax.checkpoint would misread as its recompute-everything default)
+    C.set_remat_policy("none")
+    assert C._policy() is jax.checkpoint_policies.everything_saveable
+    with pytest.raises(ValueError):
+        C.set_remat_policy("bogus")
+    C.reset()
+    assert C.REMAT_POLICY is None
+
+
+def test_engine_applies_remat_policy_to_model():
+    """The activation_checkpointing.remat_policy knob must rebuild the
+    model with that remat policy (the compiled program changes)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT, GPTConfig, gpt_loss_fn
+
+    cfg = GPTConfig(vocab_size=32, max_seq_len=8, d_model=16, n_layers=2,
+                    n_heads=2, dtype=jnp.float32, scan_layers=True)
+    ids = np.zeros((8, 8), dtype=np.int32)
+
+    def loss_fn(model, params, batch, rng, train):
+        logits = model.apply(params, batch["input_ids"],
+                             deterministic=not train)
+        return gpt_loss_fn(logits[:, :-1], batch["input_ids"][:, 1:])
+
+    engine, _, _, _ = ds.initialize(
+        model=GPT(cfg), config={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "activation_checkpointing": {"remat_policy": "attn_out"},
+            "steps_per_print": 1000,
+        }, loss_fn=loss_fn, sample_batch={"input_ids": ids[:1]},
+        rng=jax.random.PRNGKey(0))
+    assert engine.module.config.remat == "attn_out"
+    assert np.isfinite(float(engine.train_batch({"input_ids": ids})))
+
+
+def test_engine_rejects_unknown_remat_policy():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT, GPTConfig, gpt_loss_fn
+    cfg = GPTConfig(vocab_size=64, max_seq_len=16, d_model=32, n_layers=1,
+                    n_heads=2, dtype=jnp.float32)
+    with pytest.raises(DeepSpeedConfigError):
+        ds.initialize(
+            model=GPT(cfg), config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "activation_checkpointing": {"remat_policy": "bogus"},
+            }, loss_fn=lambda *a, **k: 0.0,
+            sample_batch={"input_ids": np.zeros((1, 16), np.int32)},
+            rng=jax.random.PRNGKey(0))

@@ -205,10 +205,63 @@ class TestOneBitAdam:
         np.testing.assert_allclose(red[0],
                                    scales.mean() * signs.mean(axis=0),
                                    rtol=1e-2, atol=1e-3)
-        # error feedback = each participant's LOCAL quantization residual
-        np.testing.assert_allclose(np.asarray(new_err), xs - scales * signs,
+        # error feedback compensates against what the aggregate ACTUALLY
+        # used on this worker's behalf (mean_scale*sign_i): the local
+        # quantization residual PLUS the aggregation residual
+        # (scale_i - mean_scale)*sign_i
+        np.testing.assert_allclose(np.asarray(new_err),
+                                   xs - scales.mean() * signs,
                                    rtol=1e-2, atol=1e-3)
         set_global_mesh(None)
+
+    def test_compressed_allreduce_error_feedback_identity(self):
+        """EF identity per worker: mean_scale*sign_i + new_error_i ==
+        x_i + error_i EXACTLY — nothing of the input is silently lost to
+        the mean-scale aggregation approximation (it all lands in the
+        carried error, re-injected next step)."""
+        from deepspeed_tpu.comm import MeshSpec, build_mesh
+        from deepspeed_tpu.runtime.comm_compression import compressed_allreduce
+        from deepspeed_tpu.utils.jax_compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = build_mesh(MeshSpec(data=4), devices=jax.devices()[:4])
+        rng = np.random.default_rng(11)
+        # heterogeneous magnitudes so per-worker scales genuinely differ
+        x = jnp.asarray(rng.standard_normal((4, 64))
+                        * np.array([0.1, 1.0, 5.0, 20.0])[:, None],
+                        jnp.float32)
+        err = jnp.asarray(rng.standard_normal((4, 64)) * 0.01, jnp.float32)
+
+        red, new_err = shard_map(
+            lambda x, e: compressed_allreduce(x, e, "data"), mesh,
+            in_specs=(P("data", None), P("data", None)),
+            out_specs=(P("data", None), P("data", None)))(x, err)
+        xs, es, ne = np.asarray(x), np.asarray(err), np.asarray(new_err)
+        corrected = xs + es
+        scales = np.abs(corrected).mean(axis=1)
+        mean_scale = scales.mean()
+        signs = np.where(np.sign(corrected) == 0, 1.0, np.sign(corrected))
+        np.testing.assert_allclose(mean_scale * signs + ne, corrected,
+                                   rtol=1e-5, atol=1e-5)
+        # with the residual folded in, sum over workers of (used + error)
+        # equals the exact sum — aggregation error is fully compensated
+        np.testing.assert_allclose(
+            (mean_scale * signs + ne).sum(axis=0), corrected.sum(axis=0),
+            rtol=1e-5, atol=1e-5)
+
+    def test_sign_wire_dtype_guard(self):
+        """bf16 integers are exact only through 256 (8 significand bits):
+        the sign psum must upcast to fp32 past that axis size (and on a
+        non-static size)."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.runtime.comm_compression import _sign_wire_dtype
+        assert _sign_wire_dtype(2) == jnp.bfloat16
+        assert _sign_wire_dtype(256) == jnp.bfloat16
+        assert _sign_wire_dtype(257) == jnp.float32
+        assert _sign_wire_dtype(jnp.int32(8)) == jnp.float32  # traced-ish
+        # the boundary itself: 257 is NOT bf16-representable, 256 is
+        assert float(jnp.bfloat16(256)) == 256.0
+        assert float(jnp.bfloat16(257)) != 257.0
 
 
 class TestAutotuner:

