@@ -1,0 +1,52 @@
+"""Static analysis for trace-safety and sharding consistency.
+
+``ds_tpu_lint`` (bin/ds_tpu_lint -> analysis/cli.py) is the repo's first
+correctness tool that needs no TPU hardware: a pure-``ast`` pass over the
+package (and user training scripts) that catches the bug classes which on
+TPU only surface as opaque OOMs or flatlined step times at scale —
+
+- **trace-safety** (trace_safety.py): recompile/sync hazards inside
+  jit-reachable code — Python branching on traced values, ``.item()`` /
+  ``float()`` / ``np.asarray()`` host syncs in step functions, non-hashable
+  static args, Python loops over traced values, module-level ``jnp``
+  constant capture, plus a broad-except hygiene rule;
+- **sharding-consistency** (sharding_rules.py): every collective axis name
+  and every ``PartitionSpec`` dim must name a declared mesh axis
+  (cross-checked against comm/mesh.py's ``MESH_AXES`` vocabulary).
+
+``validate.py`` is the runtime half: structural validation of param /
+optimizer-state spec trees against the live mesh, run at engine init when
+the config sets ``"validate_sharding": true``.
+
+Suppression: append ``# ds-tpu: lint-ok[RULE]`` to the offending line (or
+the comment line directly above it), decorate a function with
+``@lint_ok("RULE")``, or triage existing violations into a committed
+baseline file (see analysis/baseline.py and docs/analysis.md).
+"""
+
+from .core import (Finding, analyze_source, analyze_file, analyze_paths,
+                   all_rules, declared_mesh_axes)
+from .baseline import load_baseline, save_baseline, split_by_baseline
+from .validate import (validate_spec, validate_spec_tree,
+                       validate_param_opt_consistency,
+                       validate_engine_sharding)
+
+
+def lint_ok(*rules):
+    """Decorator marking a function as triaged for the given rule IDs
+    (all rules when called bare: ``@lint_ok``). Runtime no-op; the
+    analyzer recognizes it syntactically and suppresses findings inside
+    the decorated function's body."""
+    if len(rules) == 1 and callable(rules[0]):  # bare @lint_ok
+        return rules[0]
+
+    def wrap(fn):
+        return fn
+    return wrap
+
+
+__all__ = ["Finding", "analyze_source", "analyze_file", "analyze_paths",
+           "all_rules", "declared_mesh_axes", "load_baseline",
+           "save_baseline", "split_by_baseline", "lint_ok",
+           "validate_spec", "validate_spec_tree",
+           "validate_param_opt_consistency", "validate_engine_sharding"]

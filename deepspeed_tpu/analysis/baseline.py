@@ -1,0 +1,68 @@
+"""Baseline workflow: triage existing violations without ignoring them.
+
+The committed baseline (``.ds_tpu_lint_baseline.json``) records the
+fingerprint of every known finding. ``ds_tpu_lint --baseline FILE`` then
+fails only on findings NOT in the baseline — new code is held to the
+rules immediately while the backlog is burned down deliberately.
+Fingerprints hash (rule, path, source-line text, occurrence index), not
+line numbers, so unrelated edits don't churn the file.
+
+``--update-baseline`` rewrites the file from the current findings;
+entries whose violation disappeared are reported as stale and dropped on
+the next update.
+"""
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+DEFAULT_BASELINE = ".ds_tpu_lint_baseline.json"
+
+
+def load_baseline(path: str) -> Dict[str, dict]:
+    """fingerprint -> record. Missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')!r}, "
+            f"expected {BASELINE_VERSION} — regenerate with --update-baseline")
+    return {rec["fingerprint"]: rec for rec in data.get("findings", [])}
+
+
+def save_baseline(path: str, findings: List[Finding]):
+    records = [{
+        "fingerprint": f.fingerprint,
+        "rule": f.rule,
+        "path": f.path.replace(os.sep, "/"),
+        "line": f.line,
+        "message": f.message,
+    } for f in findings]
+    records.sort(key=lambda r: (r["path"], r["line"], r["rule"], r["fingerprint"]))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": BASELINE_VERSION, "findings": records}, f,
+                  indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def split_by_baseline(findings: List[Finding],
+                      baseline: Dict[str, dict]
+                      ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """(new, baselined, stale-records). Marks baselined findings in place."""
+    seen = set()
+    new, old = [], []
+    for f in findings:
+        fp = f.fingerprint
+        if fp in baseline:
+            f.baselined = True
+            seen.add(fp)
+            old.append(f)
+        else:
+            new.append(f)
+    stale = [rec for fp, rec in sorted(baseline.items()) if fp not in seen]
+    return new, old, stale

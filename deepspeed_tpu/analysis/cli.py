@@ -1,0 +1,135 @@
+"""``ds_tpu_lint`` command line (bin/ds_tpu_lint).
+
+Exit codes: 0 = clean (all findings suppressed or baselined),
+1 = new findings, 2 = usage error. Stdlib-only — runs without jax.
+"""
+
+import argparse
+import json
+import sys
+
+from .core import all_rules, analyze_paths, declared_mesh_axes
+from .baseline import (DEFAULT_BASELINE, load_baseline, save_baseline,
+                       split_by_baseline)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ds_tpu_lint",
+        description="Trace-safety & sharding-consistency static analyzer "
+                    "for deepspeed_tpu and user training scripts.")
+    p.add_argument("paths", nargs="*", default=[],
+                   help="files or directories to analyze")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help=f"baseline file of triaged findings "
+                        f"(e.g. {DEFAULT_BASELINE}); only NEW findings fail")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline file from current findings")
+    p.add_argument("--rules", metavar="IDS", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--mesh-axes", metavar="NAMES", default=None,
+                   help="extra mesh axis names beyond comm/mesh.py's "
+                        "MESH_AXES (comma-separated), for user scripts with "
+                        "custom meshes")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print rule ids and descriptions, then exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress baselined/stale chatter; print new "
+                        "findings and the summary only")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    out = sys.stdout
+
+    if args.list_rules:
+        for rule_id, desc in sorted(all_rules().items()):
+            print(f"{rule_id}  {desc}", file=out)
+        return 0
+
+    if not args.paths:
+        print("error: no paths given (try: ds_tpu_lint deepspeed_tpu)",
+              file=sys.stderr)
+        return 2
+
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(all_rules())
+        if unknown:
+            print(f"error: unknown rule ids {sorted(unknown)} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+
+    extra_axes = ()
+    if args.mesh_axes:
+        extra_axes = tuple(a.strip() for a in args.mesh_axes.split(",")
+                           if a.strip())
+    mesh_axes = declared_mesh_axes(extra=extra_axes)
+
+    findings = analyze_paths(args.paths, mesh_axes=mesh_axes, rules=rules)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    if args.update_baseline:
+        if args.rules:
+            # a filtered run sees only a subset of findings; writing it
+            # out would silently drop every other rule's triaged entries
+            print("error: --update-baseline cannot be combined with "
+                  "--rules (the baseline must cover all rules)",
+                  file=sys.stderr)
+            return 2
+        path = args.baseline or DEFAULT_BASELINE
+        save_baseline(path, findings)
+        print(f"baseline written: {path} ({len(findings)} finding(s))",
+              file=out)
+        return 0
+
+    baseline = {}
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (ValueError, OSError) as e:  # bad JSON / version / unreadable
+            print(f"error: cannot load baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+    if rules is not None:
+        # a filtered run never produces other rules' findings — drop them
+        # from the baseline too, or they'd all misreport as stale/fixed
+        baseline = {fp: rec for fp, rec in baseline.items()
+                    if rec.get("rule") in rules}
+    new, baselined, stale = split_by_baseline(findings, baseline)
+
+    if args.format == "json":
+        json.dump({
+            "new": [_as_dict(f) for f in new],
+            "baselined": [_as_dict(f) for f in baselined],
+            "stale_baseline_entries": stale,
+        }, out, indent=1)
+        out.write("\n")
+    else:
+        for f in new:
+            print(f.render(), file=out)
+        if not args.quiet:
+            for f in baselined:
+                print(f"{f.render()}  [baselined]", file=out)
+            for rec in stale:
+                print(f"stale baseline entry (violation fixed — run "
+                      f"--update-baseline): {rec['path']}: {rec['rule']} "
+                      f"{rec['message']}", file=out)
+        print(f"ds_tpu_lint: {len(new)} new, {len(baselined)} baselined, "
+              f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}", file=out)
+
+    return 1 if new else 0
+
+
+def _as_dict(f):
+    return {"rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+            "message": f.message, "fingerprint": f.fingerprint,
+            "baselined": f.baselined}
+
+
+if __name__ == "__main__":
+    sys.exit(main())

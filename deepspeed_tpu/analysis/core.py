@@ -1,0 +1,281 @@
+"""Analyzer driver: findings, suppression pragmas, file walking.
+
+Everything here is stdlib-only (``ast`` + ``tokenize``-free line scans) so
+``bin/ds_tpu_lint`` runs on a bare Python without jax installed — the CI
+lint job and pre-commit hooks never need the accelerator stack.
+"""
+
+import ast
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# Fallback mesh-axis vocabulary; the real source of truth is parsed out of
+# comm/mesh.py (declared_mesh_axes below) so the linter never goes stale
+# against the package without importing it.
+_DEFAULT_MESH_AXES = ("stage", "data", "expert", "fsdp", "seq", "model")
+
+_PRAGMA_RE = re.compile(
+    r"#\s*ds-tpu:\s*lint-ok(?:\[\s*([A-Za-z0-9_,\-\s]*)\s*\])?")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    source_line: str = ""
+    baselined: bool = False
+
+    @property
+    def fingerprint(self) -> str:
+        # Line-number independent so unrelated edits don't churn the
+        # baseline: rule + normalized path + stripped source text.
+        # Collisions between identical lines in one file are disambiguated
+        # by the caller via _occurrence (set in finalize_fingerprints).
+        body = f"{self.rule}|{_norm_path(self.path)}|{self.source_line.strip()}|{self._occurrence}"
+        return hashlib.sha1(body.encode()).hexdigest()[:16]
+
+    _occurrence: int = field(default=0, repr=False)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+def _norm_path(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def finalize_fingerprints(findings: List[Finding]) -> List[Finding]:
+    """Assign occurrence indices so identical (rule, path, line-text)
+    findings fingerprint distinctly and stably (ordered by line)."""
+    seen: Dict[Tuple[str, str, str], int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        key = (f.rule, _norm_path(f.path), f.source_line.strip())
+        f._occurrence = seen.get(key, 0)
+        seen[key] = f._occurrence + 1
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Suppression: line pragmas + @lint_ok decorator regions
+# ---------------------------------------------------------------------------
+
+class Suppressions:
+    """Per-file map of suppressed rules: line pragmas and decorator regions.
+
+    - ``# ds-tpu: lint-ok[TS002]`` on a line suppresses TS002 there;
+      ``# ds-tpu: lint-ok[TS002, SC001]`` takes a list; bare
+      ``# ds-tpu: lint-ok`` suppresses every rule on that line.
+    - A pragma on a comment-only line applies to the next source line
+      (covers lines too long to carry a trailing comment).
+    - ``@lint_ok("TS002")`` / bare ``@lint_ok`` on a function suppresses
+      inside the function's whole body.
+    """
+
+    def __init__(self, source: str, tree: Optional[ast.AST] = None):
+        self.line_rules: Dict[int, Set[str]] = {}
+        self.regions: List[Tuple[int, int, Set[str]]] = []
+        lines = source.splitlines()
+        for i, text in enumerate(lines, start=1):
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            rules = self._parse_rules(m.group(1))
+            target = i
+            if text.lstrip().startswith("#"):
+                # comment-only pragma: applies to the next source line
+                # (skipping the rest of its comment block + blank lines)
+                j = i + 1
+                while j <= len(lines) and (
+                        not lines[j - 1].strip()
+                        or lines[j - 1].lstrip().startswith("#")):
+                    j += 1
+                target = j
+            self.line_rules.setdefault(target, set()).update(rules)
+        if tree is not None:
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    rules = self._decorator_rules(node)
+                    if rules is not None:
+                        end = getattr(node, "end_lineno", node.lineno)
+                        self.regions.append((node.lineno, end, rules))
+
+    @staticmethod
+    def _parse_rules(group: Optional[str]) -> Set[str]:
+        if group is None or not group.strip():
+            return {"*"}
+        return {r.strip() for r in group.split(",") if r.strip()}
+
+    @staticmethod
+    def _decorator_rules(node) -> Optional[Set[str]]:
+        for dec in node.decorator_list:
+            name = dotted_name(dec.func if isinstance(dec, ast.Call) else dec)
+            if name is None or name.split(".")[-1] != "lint_ok":
+                continue
+            if isinstance(dec, ast.Call):
+                rules = {a.value for a in dec.args
+                         if isinstance(a, ast.Constant) and isinstance(a.value, str)}
+                return rules or {"*"}
+            return {"*"}
+        return None
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        rules = self.line_rules.get(line, set())
+        if "*" in rules or rule in rules:
+            return True
+        for start, end, region_rules in self.regions:
+            if start <= line <= end and ("*" in region_rules or rule in region_rules):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------------
+
+def dotted_name(node) -> Optional[str]:
+    """'jax.lax.psum' for Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class LintContext:
+    """Per-file state handed to the rule families."""
+
+    def __init__(self, path: str, source: str, tree: ast.AST,
+                 mesh_axes: Sequence[str], enabled_rules: Optional[Set[str]] = None):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.mesh_axes = tuple(mesh_axes)
+        self.enabled_rules = enabled_rules
+        self.suppressions = Suppressions(source, tree)
+        self.findings: List[Finding] = []
+
+    def report(self, rule: str, node: ast.AST, message: str):
+        if self.enabled_rules is not None and rule not in self.enabled_rules:
+            return
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        if self.suppressions.is_suppressed(rule, line):
+            return
+        text = self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+        self.findings.append(Finding(rule=rule, path=self.path, line=line,
+                                     col=col, message=message,
+                                     source_line=text))
+
+
+# ---------------------------------------------------------------------------
+# Rule registry + entry points
+# ---------------------------------------------------------------------------
+
+def all_rules() -> Dict[str, str]:
+    """rule id -> one-line description, across both families."""
+    from . import trace_safety, sharding_rules
+    rules = dict(trace_safety.RULES)
+    rules.update(sharding_rules.RULES)
+    return rules
+
+
+def declared_mesh_axes(extra: Sequence[str] = ()) -> Tuple[str, ...]:
+    """Mesh-axis vocabulary, parsed statically out of comm/mesh.py's
+    ``MESH_AXES`` assignment (no import of the package, no jax)."""
+    axes = None
+    mesh_py = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "comm", "mesh.py")
+    try:
+        with open(mesh_py, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        for node in tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "MESH_AXES"
+                            for t in node.targets)
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                vals = [e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+                if vals:
+                    axes = tuple(vals)
+                break
+    except (OSError, SyntaxError):
+        pass
+    if axes is None:
+        axes = _DEFAULT_MESH_AXES
+    return tuple(axes) + tuple(a for a in extra if a not in axes)
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   mesh_axes: Optional[Sequence[str]] = None,
+                   rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Run every rule over one source string. Returns findings sorted by
+    position (suppressed ones already dropped)."""
+    from . import trace_safety, sharding_rules
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        f = Finding(rule="E999", path=path, line=e.lineno or 1,
+                    col=e.offset or 0, message=f"syntax error: {e.msg}",
+                    source_line="")
+        return [f]
+    ctx = LintContext(path, source, tree,
+                      mesh_axes or declared_mesh_axes(), enabled_rules=rules)
+    trace_safety.analyze(ctx)
+    sharding_rules.analyze(ctx)
+    ctx.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return finalize_fingerprints(ctx.findings)
+
+
+def analyze_file(path: str, mesh_axes: Optional[Sequence[str]] = None,
+                 rules: Optional[Set[str]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return analyze_source(source, path=path, mesh_axes=mesh_axes, rules=rules)
+
+
+_EXCLUDED_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build",
+                  "dist", ".eggs"}
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in _EXCLUDED_DIRS and not d.startswith("."))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return out
+
+
+def analyze_paths(paths: Sequence[str],
+                  mesh_axes: Optional[Sequence[str]] = None,
+                  rules: Optional[Set[str]] = None) -> List[Finding]:
+    """Findings for files under each root, reported with paths relative to
+    the root's parent ("deepspeed_tpu/runtime/engine.py" whether the root
+    was given absolute or relative) so baseline fingerprints don't depend
+    on where the linter was invoked from."""
+    findings: List[Finding] = []
+    for root in paths:
+        base = os.path.dirname(os.path.abspath(root))
+        for path in iter_python_files([root]):
+            rel = os.path.relpath(os.path.abspath(path), base)
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            findings.extend(analyze_source(source, path=rel,
+                                           mesh_axes=mesh_axes, rules=rules))
+    return findings

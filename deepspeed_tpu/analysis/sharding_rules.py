@@ -1,0 +1,166 @@
+"""Sharding-consistency rules: axis names must resolve to declared axes.
+
+A typo'd axis in a ``psum`` or a ``PartitionSpec`` naming a ghost axis
+doesn't fail at the call site — it fails deep inside lax/GSPMD at trace
+time on hardware, or worse, silently replicates what should be sharded.
+These rules cross-check every *literal* axis name in the code against the
+mesh-axis vocabulary declared in ``comm/mesh.py`` (``MESH_AXES``,
+extensible per-run with ``--mesh-axes``):
+
+- SC001 undefined-collective-axis  lax collectives (psum/pmean/all_gather/
+        psum_scatter/all_to_all/ppermute/axis_index...) and the
+        ``deepspeed_tpu.comm`` facade (``group=`` argument)
+- SC002 unknown-partitionspec-axis ``PartitionSpec(...)`` literals
+
+Non-literal axis arguments (variables, f-strings) are skipped — the
+runtime half of this family is ``analysis/validate.py``, enabled at engine
+init with ``"validate_sharding": true``.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import LintContext, dotted_name
+
+RULES: Dict[str, str] = {
+    "SC001": "undefined-collective-axis: collective called with an axis/"
+             "group name that is not a declared mesh axis",
+    "SC002": "unknown-partitionspec-axis: PartitionSpec names an axis that "
+             "is not a declared mesh axis",
+}
+
+# lax collectives -> position of the axis_name argument (after the operand).
+_LAX_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    "pswapaxes": 1, "axis_index": 0, "axis_size": 0,
+}
+_LAX_AXIS_KWARG = "axis_name"
+
+# deepspeed_tpu.comm facade -> positional index of the group argument
+# (checked alongside the ``group=`` keyword).
+_COMM_FACADE = {
+    "all_reduce": 2, "inference_all_reduce": 2, "all_gather": 1,
+    "reduce_scatter": 2, "all_to_all_single": 1, "broadcast": 2,
+    "ppermute": 2, "send_recv_next": 1, "send_recv_prev": 1,
+    "axis_index": 0, "all_reduce_host": 2, "all_gather_host": 1,
+    "reduce_scatter_host": 1, "all_to_all_host": 1,
+}
+
+
+def _literal_axis_names(node) -> Optional[List[Tuple[ast.AST, str]]]:
+    """Extract (node, axis-name) pairs from a literal axis argument:
+    ``"data"``, ``("data", "fsdp")``, ``["data"]``. Returns None when the
+    argument is not a literal (variable/call) — skip, can't prove."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, str):
+            return [(node, node.value)]
+        if node.value is None:
+            return []
+        return None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            sub = _literal_axis_names(e)
+            if sub is None:
+                return None  # mixed literal/variable: skip the whole arg
+            out.extend(sub)
+        return out
+    return None
+
+
+def _partition_spec_aliases(tree) -> Set[str]:
+    """Local names bound to jax.sharding.PartitionSpec via imports."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module in ("jax.sharding", "jax.interpreters.pxla",
+                               "jax.experimental.pjit"):
+                for alias in node.names:
+                    if alias.name == "PartitionSpec":
+                        aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+def _comm_facade_aliases(tree) -> Set[str]:
+    """Module aliases for the comm facade: ``import deepspeed_tpu.comm as
+    dist`` / ``from deepspeed_tpu import comm``. Bare-name imports of the
+    facade functions are matched by terminal name instead."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith(".comm") or alias.name == "deepspeed_tpu.comm":
+                    aliases.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module in ("deepspeed_tpu", ) and any(
+                    a.name == "comm" for a in node.names):
+                for a in node.names:
+                    if a.name == "comm":
+                        aliases.add(a.asname or "comm")
+    return aliases
+
+
+def analyze(ctx: LintContext):
+    tree = ctx.tree
+    axes = set(ctx.mesh_axes)
+    spec_aliases = _partition_spec_aliases(tree)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        if fname is None:
+            continue
+        leaf = fname.split(".")[-1]
+
+        # --- SC002: PartitionSpec literals --------------------------------
+        if leaf == "PartitionSpec" or fname in spec_aliases:
+            for arg in node.args:
+                if isinstance(arg, ast.Starred):
+                    continue  # P(*axes): computed, runtime checker's job
+                names = _literal_axis_names(arg)
+                for name_node, name in names or []:
+                    if name not in axes:
+                        ctx.report(
+                            "SC002", name_node,
+                            f"PartitionSpec axis {name!r} is not a declared "
+                            f"mesh axis {tuple(sorted(axes))} — params "
+                            "constrained by it silently stay replicated")
+            continue
+
+        # --- SC001: lax collectives ---------------------------------------
+        if leaf in _LAX_COLLECTIVES and ("lax" in fname.split(".")
+                                         or fname == leaf):
+            pos = _LAX_COLLECTIVES[leaf]
+            arg = node.args[pos] if len(node.args) > pos else None
+            if arg is None:
+                for kw in node.keywords:
+                    if kw.arg == _LAX_AXIS_KWARG:
+                        arg = kw.value
+            _check_axis_arg(ctx, arg, axes, f"jax.lax.{leaf}")
+            continue
+
+        # --- SC001: comm facade (group=...) -------------------------------
+        if leaf in _COMM_FACADE:
+            pos = _COMM_FACADE[leaf]
+            arg = None
+            for kw in node.keywords:
+                if kw.arg == "group":
+                    arg = kw.value
+            if arg is None and len(node.args) > pos:
+                arg = node.args[pos]
+            _check_axis_arg(ctx, arg, axes, f"comm.{leaf}")
+
+
+def _check_axis_arg(ctx: LintContext, arg, axes: Set[str], what: str):
+    names = _literal_axis_names(arg)
+    for name_node, name in names or []:
+        if name not in axes:
+            ctx.report(
+                "SC001", name_node,
+                f"{what} called with axis/group {name!r} which is not a "
+                f"declared mesh axis {tuple(sorted(axes))} — this fails "
+                "deep inside lax at trace time (or binds the wrong ring)")

@@ -1,0 +1,564 @@
+"""Trace-safety rules: recompile/sync hazards in jit-reachable code.
+
+On TPU the correctness surface moves from kernels to trace-time
+invariants: one Python branch on a traced value is a ConcretizationError
+(or a silent per-step retrace), one ``.item()`` in the step path is a
+device->host round trip that stalls the whole ICI ring. These rules walk
+the AST and flag the hazards where they are provable from local evidence:
+
+- TS001 traced-branch        ``if``/``while``/ternary on a traced value
+- TS002 host-sync            ``.item()``/``.tolist()``/``float()``/``int()``/
+                             ``bool()``/``np.asarray()``/``jax.device_get``
+                             on a traced value in jit or step-path code
+- TS003 nonhashable-static-arg  static_argnames/nums naming a param whose
+                             default is an unhashable literal (retrace or
+                             TypeError at every call)
+- TS004 traced-loop          Python ``for`` iterating a traced value
+                             (unrolls or fails; use lax.scan/fori_loop)
+- TS005 jnp-constant-capture module/class-level ``jnp.*`` array creation
+                             (device work at import time, captured into
+                             every trace)
+- PY001 broad-except         ``except Exception``/bare except without
+                             re-raise (swallows trace errors; narrow it)
+
+Scopes:
+
+- **jit scope** — functions decorated with / passed into jit-family
+  transforms (jit, pjit, shard_map, pmap, vmap, grad, value_and_grad,
+  remat, checkpoint, scan, cond, while_loop, fori_loop), flax
+  ``@nn.compact`` methods and ``nn.Module.__call__``, plus everything
+  nested inside them. TS001/TS002/TS004 use taint from the function's
+  (non-static) array params.
+- **step-path scope** (TS002 only) — functions whose name contains
+  "step" or "batch": the per-step host path where an eager ``float()``
+  is a hidden sync even though nothing is being traced. Taint starts
+  from the function's own params (minus ``self``/``cls``).
+"""
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import LintContext, dotted_name
+
+RULES: Dict[str, str] = {
+    "TS001": "traced-branch: Python `if`/`while`/ternary on a traced value "
+             "(use jnp.where / lax.cond)",
+    "TS002": "host-sync: .item()/.tolist()/float()/int()/bool()/np.asarray()/"
+             "jax.device_get on a traced or per-step device value",
+    "TS003": "nonhashable-static-arg: static_argnames/static_argnums names a "
+             "param with an unhashable (list/dict/set) default",
+    "TS004": "traced-loop: Python `for` over a traced value "
+             "(use lax.scan / lax.fori_loop)",
+    "TS005": "jnp-constant-capture: module/class-level jnp array creation — "
+             "runs device work at import time and is captured into traces "
+             "(build it inside the jitted function, or use numpy)",
+    "PY001": "broad-except: bare `except Exception` without re-raise — "
+             "narrow to the expected exception types",
+}
+
+# Transform entry points: a function decorated with, or passed into, one of
+# these runs under trace.
+_JIT_WRAPPERS = {"jit", "pjit", "shard_map", "pmap", "xmap", "vmap", "grad",
+                 "value_and_grad", "remat", "checkpoint", "custom_vjp",
+                 "custom_jvp", "scan", "cond", "while_loop", "fori_loop",
+                 "associated_scan", "compact"}
+
+# Attribute accesses that stay static under trace (shape metadata).
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding",
+                 "device", "aval", "weak_type", "name", "names"}
+# Calls whose results are static regardless of the argument (builtins plus
+# jnp.shape/ndim/result_type-style metadata readers, matched by leaf name).
+_STATIC_FUNCS = {"len", "isinstance", "type", "hasattr", "id", "repr", "str",
+                 "shape", "ndim", "result_type", "eval_shape", "callable"}
+
+_NP_ALIASES_DEFAULT = {"numpy"}
+_JNP_CREATORS = {"array", "asarray", "zeros", "ones", "full", "arange",
+                 "eye", "linspace", "empty", "identity", "tri"}
+
+
+# ---------------------------------------------------------------------------
+# taint: does an expression reference a traced name?
+# ---------------------------------------------------------------------------
+
+def _references_traced(node, tainted: Set[str]) -> bool:
+    """True if ``node`` mentions a tainted name outside static subtrees
+    (``x.shape[...]``, ``len(x)``, ``x is None`` comparisons...)."""
+    if node is None:
+        return False
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return False
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname is not None and fname.split(".")[-1] in _STATIC_FUNCS:
+            return False
+    if isinstance(node, ast.Compare):
+        # `x is None` / `x is not None`: an identity check never reads the
+        # buffer — standard optional-arg plumbing, not a sync.
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    return any(_references_traced(child, tainted)
+               for child in ast.iter_child_nodes(node))
+
+
+def _assign_targets(node) -> List[str]:
+    names = []
+
+    def collect(t):
+        if isinstance(t, ast.Name):
+            names.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect(e)
+        elif isinstance(t, ast.Starred):
+            collect(t.value)
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            collect(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        collect(node.target)
+    return names
+
+
+def _propagate_taint(fn_node, tainted: Set[str]):
+    """Any name assigned from a tainted expression is tainted; iterated to
+    a fixpoint so chains (y = f(x); z = g(y)) propagate regardless of AST
+    traversal order. Nested functions are excluded (they get their own
+    scan + taint set)."""
+    changed = True
+    while changed:
+        changed = False
+        before = len(tainted)
+        for node in _walk_outside_inner(fn_node):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                value = node.value
+                if value is not None and _references_traced(value, tainted):
+                    tainted.update(_assign_targets(node))
+            elif isinstance(node, ast.NamedExpr):
+                if (_references_traced(node.value, tainted)
+                        and isinstance(node.target, ast.Name)):
+                    tainted.add(node.target.id)
+        changed = len(tainted) > before
+
+
+# ---------------------------------------------------------------------------
+# scope discovery
+# ---------------------------------------------------------------------------
+
+def _decorator_names(fn_node) -> List[str]:
+    names = []
+    for dec in fn_node.decorator_list:
+        target = dec
+        if isinstance(dec, ast.Call):
+            # @partial(jax.jit, ...) — the wrapper is the first argument
+            head = dotted_name(dec.func)
+            if head is not None and head.split(".")[-1] == "partial" and dec.args:
+                target = dec.args[0]
+            else:
+                target = dec.func
+        name = dotted_name(target)
+        if name is not None:
+            names.append(name)
+    return names
+
+
+def _is_jit_decorated(fn_node) -> bool:
+    return any(n.split(".")[-1] in _JIT_WRAPPERS for n in _decorator_names(fn_node))
+
+
+def _static_param_names(fn_node) -> Set[str]:
+    """Params declared static via static_argnames/static_argnums in a jit
+    decorator (literal strings / ints only)."""
+    static: Set[str] = set()
+    params = [a.arg for a in fn_node.args.posonlyargs + fn_node.args.args]
+    for dec in fn_node.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for v in _iter_const_strings(kw.value):
+                    static.add(v)
+            elif kw.arg == "static_argnums":
+                for i in _iter_const_ints(kw.value):
+                    if 0 <= i < len(params):
+                        static.add(params[i])
+    return static
+
+
+def _iter_const_strings(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for e in node.elts:
+            yield from _iter_const_strings(e)
+
+
+def _iter_const_ints(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for e in node.elts:
+            yield from _iter_const_ints(e)
+
+
+def _flax_module_classes(tree) -> Set[str]:
+    """Names of classes whose bases look like flax Modules."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for base in node.bases:
+                bname = dotted_name(base)
+                if bname is not None and bname.split(".")[-1] == "Module":
+                    out.add(node.name)
+    return out
+
+
+def _functions_passed_to_jit(tree) -> Set[str]:
+    """Names of functions referenced as arguments of jit-family calls:
+    ``jax.jit(train_step)``, ``shard_map(f, mesh, ...)``,
+    ``jax.lax.scan(body, ...)``."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        if fname is None or fname.split(".")[-1] not in _JIT_WRAPPERS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name):
+                out.add(arg.id)
+    return out
+
+
+def _is_step_path_name(name: str) -> bool:
+    low = name.lower()
+    return "step" in low or "batch" in low
+
+
+# ---------------------------------------------------------------------------
+# per-function hazard scan
+# ---------------------------------------------------------------------------
+
+class _FunctionScanner:
+    def __init__(self, ctx: LintContext, np_aliases: Set[str],
+                 jnp_aliases: Set[str]):
+        self.ctx = ctx
+        self.np_aliases = np_aliases
+        self.jnp_aliases = jnp_aliases
+
+    def _check_branch(self, node, tainted):
+        if isinstance(node, (ast.If, ast.IfExp)):
+            if _references_traced(node.test, tainted):
+                self.ctx.report("TS001", node,
+                                "Python branch on a traced value — the trace "
+                                "only sees one side; use jnp.where or lax.cond")
+        elif isinstance(node, ast.While):
+            if _references_traced(node.test, tainted):
+                self.ctx.report("TS001", node,
+                                "Python `while` on a traced value — use "
+                                "lax.while_loop")
+        elif isinstance(node, ast.Assert):
+            if _references_traced(node.test, tainted):
+                self.ctx.report("TS001", node,
+                                "assert on a traced value concretizes it at "
+                                "trace time — use checkify or debug.check")
+
+    def _check_loop(self, node, tainted):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if _references_traced(node.iter, tainted):
+                self.ctx.report("TS004", node,
+                                "Python `for` over a traced value unrolls or "
+                                "fails at trace time — use lax.scan or "
+                                "lax.fori_loop")
+
+    def _check_host_sync(self, node, tainted):
+        if not isinstance(node, ast.Call):
+            return
+        func = node.func
+        # x.item() / x.tolist() / x.block_until_ready()
+        if isinstance(func, ast.Attribute) and func.attr in (
+                "item", "tolist", "block_until_ready"):
+            if _references_traced(func.value, tainted):
+                self.ctx.report("TS002", node,
+                                f".{func.attr}() forces a device->host sync "
+                                "on a traced/per-step value")
+            return
+        fname = dotted_name(func)
+        if fname is None:
+            return
+        head, leaf = fname.split(".")[0], fname.split(".")[-1]
+        arg = node.args[0] if node.args else None
+        if fname in ("float", "int", "bool") and _references_traced(arg, tainted):
+            self.ctx.report("TS002", node,
+                            f"{fname}() materializes a traced/per-step device "
+                            "value on the host (hidden sync) — keep it on "
+                            "device, or gate it to the logging cadence")
+        elif head in self.np_aliases and leaf in ("asarray", "array") \
+                and _references_traced(arg, tainted):
+            self.ctx.report("TS002", node,
+                            f"{fname}() copies a traced/per-step device value "
+                            "to host memory — use jnp, or stage the transfer "
+                            "off the step path")
+        elif leaf == "device_get" and _references_traced(arg, tainted):
+            self.ctx.report("TS002", node,
+                            "jax.device_get on the step path blocks on the "
+                            "device — batch transfers at the logging cadence")
+
+
+def _walk_outside_inner(fn_node):
+    """Yield nodes of fn_node's body that are not inside a nested
+    function/lambda (those get their own scan)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# module-level rules
+# ---------------------------------------------------------------------------
+
+def _import_aliases(tree):
+    np_aliases, jnp_aliases = set(_NP_ALIASES_DEFAULT), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name
+                if alias.name == "numpy":
+                    np_aliases.add(name)
+                elif alias.name in ("jax.numpy", "jnp"):
+                    jnp_aliases.add(name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "numpy":
+                        jnp_aliases.add(alias.asname or "numpy")
+            elif node.module == "numpy":
+                pass  # from numpy import asarray — rare; skip
+    return np_aliases, jnp_aliases
+
+
+def _check_constant_capture(ctx: LintContext, tree, jnp_aliases: Set[str]):
+    """TS005: jnp creators called at module/class scope or in defaults."""
+    if not jnp_aliases:
+        return
+
+    def is_jnp_creator(call) -> bool:
+        fname = dotted_name(call.func)
+        if fname is None:
+            return False
+        parts = fname.split(".")
+        return parts[0] in jnp_aliases and parts[-1] in _JNP_CREATORS
+
+    def scan_expr(expr, where):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and is_jnp_creator(node):
+                ctx.report("TS005", node,
+                           f"jnp array created at {where} — allocates on "
+                           "device at import/def time and is captured as a "
+                           "trace constant; build it inside the function or "
+                           "use numpy")
+
+    def scan_body(body, where):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for default in (stmt.args.defaults + stmt.args.kw_defaults):
+                    if default is not None:
+                        scan_expr(default, f"default of {stmt.name}()")
+            elif isinstance(stmt, ast.ClassDef):
+                scan_body(stmt.body, f"class {stmt.name} scope")
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                   ast.Expr)):
+                value = getattr(stmt, "value", None)
+                if value is not None:
+                    scan_expr(value, where)
+
+    scan_body(tree.body, "module scope")
+
+
+def _check_static_args(ctx: LintContext, tree):
+    """TS003: static_argnames/nums pointing at unhashable defaults."""
+    fn_defs = {n.name: n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def param_default(fn_node, pname):
+        args = fn_node.args
+        pos = args.posonlyargs + args.args
+        n_def = len(args.defaults)
+        for i, a in enumerate(pos):
+            if a.arg == pname:
+                j = i - (len(pos) - n_def)
+                return args.defaults[j] if j >= 0 else None
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if a.arg == pname:
+                return d
+        return None
+
+    def check(fn_node, static_names, site):
+        for pname in static_names:
+            default = param_default(fn_node, pname)
+            if default is None:
+                continue
+            if isinstance(default, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                    ast.DictComp, ast.SetComp)) or (
+                    isinstance(default, ast.Call)
+                    and dotted_name(default.func) in ("list", "dict", "set",
+                                                      "bytearray")):
+                ctx.report("TS003", site,
+                           f"static arg '{pname}' of {fn_node.name}() has an "
+                           "unhashable default — jit static args must be "
+                           "hashable (tuple/frozenset/None), else every call "
+                           "raises or retraces")
+
+    for fn_node in fn_defs.values():
+        static = _static_param_names(fn_node)
+        if static and _is_jit_decorated(fn_node):
+            check(fn_node, static, fn_node)
+    # call form: jax.jit(f, static_argnames=...)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        if fname is None or fname.split(".")[-1] not in ("jit", "pjit"):
+            continue
+        target = node.args[0] if node.args and isinstance(node.args[0], ast.Name) else None
+        if target is None or target.id not in fn_defs:
+            continue
+        static: Set[str] = set()
+        params = [a.arg for a in fn_defs[target.id].args.posonlyargs
+                  + fn_defs[target.id].args.args]
+        for kw in node.keywords:
+            if kw.arg == "static_argnames":
+                static.update(_iter_const_strings(kw.value))
+            elif kw.arg == "static_argnums":
+                static.update(params[i] for i in _iter_const_ints(kw.value)
+                              if 0 <= i < len(params))
+        if static:
+            check(fn_defs[target.id], static, node)
+
+
+def _check_broad_except(ctx: LintContext, tree):
+    """PY001: `except Exception` / bare except that swallows (no re-raise)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = False
+        if node.type is None:
+            broad = True
+        else:
+            types = node.type.elts if isinstance(node.type, ast.Tuple) else [node.type]
+            for t in types:
+                tname = dotted_name(t)
+                if tname is not None and tname.split(".")[-1] in (
+                        "Exception", "BaseException"):
+                    broad = True
+        if not broad:
+            continue
+        reraises = any(isinstance(n, ast.Raise) and n.exc is None
+                       for n in ast.walk(node))
+        if reraises:
+            continue
+        ctx.report("PY001", node,
+                   "broad `except Exception` swallows unexpected errors "
+                   "(including trace/sharding bugs) — narrow to the expected "
+                   "types and log or re-raise the rest")
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def analyze(ctx: LintContext):
+    tree = ctx.tree
+    np_aliases, jnp_aliases = _import_aliases(tree)
+    scanner = _FunctionScanner(ctx, np_aliases, jnp_aliases)
+
+    passed_to_jit = _functions_passed_to_jit(tree)
+    flax_classes = _flax_module_classes(tree)
+
+    def is_jit_entry(fn_node, in_flax_class: bool) -> bool:
+        return (_is_jit_decorated(fn_node)
+                or fn_node.name in passed_to_jit
+                or (in_flax_class and fn_node.name == "__call__"))
+
+    def visit_scope(fn_node, jit_scope: bool, in_flax_class: bool = False):
+        """Scan one function, then recurse into nested ones. A nested def
+        inherits the enclosing jit scope, or opens one of its own when
+        decorated with / passed into a jit-family transform."""
+        jit = jit_scope or is_jit_entry(fn_node, in_flax_class)
+        params = [a.arg for a in fn_node.args.posonlyargs + fn_node.args.args
+                  + fn_node.args.kwonlyargs]
+        if jit:
+            static = _static_param_names(fn_node)
+            tainted = {p for p in params
+                       if p not in static and p not in ("self", "cls")}
+            # Params with literal defaults (bools/None/str/int) are static
+            # config switches (``deterministic=True``), not traced arrays.
+            tainted -= _config_like_params(fn_node)
+            _scan_function(fn_node, tainted, True, scanner)
+        elif _is_step_path_name(getattr(fn_node, "name", "")):
+            tainted = {p for p in params if p not in ("self", "cls")}
+            _scan_function(fn_node, tainted, False, scanner)
+        for inner in _nested_functions(fn_node):
+            visit_scope(inner, jit)
+
+    for node in tree.body:
+        _visit_top(node, visit_scope, flax_classes, in_flax_class=False)
+
+    _check_constant_capture(ctx, tree, jnp_aliases)
+    _check_static_args(ctx, tree)
+    _check_broad_except(ctx, tree)
+
+
+def _config_like_params(fn_node) -> Set[str]:
+    """Params whose default is a literal bool/str/None/int: static config
+    switches (``deterministic=True``), not traced arrays."""
+    out = set()
+    args = fn_node.args
+    pos = args.posonlyargs + args.args
+    n_def = len(args.defaults)
+    for i, a in enumerate(pos):
+        j = i - (len(pos) - n_def)
+        if j >= 0 and isinstance(args.defaults[j], ast.Constant):
+            out.add(a.arg)
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None and isinstance(d, ast.Constant):
+            out.add(a.arg)
+    return out
+
+
+def _nested_functions(fn_node):
+    out = []
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(node)
+            continue
+        if isinstance(node, ast.Lambda):
+            continue  # lambda params shadow the scope; skipped, not scanned
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _scan_function(fn_node, tainted, jit_scope, scanner):
+    _propagate_taint(fn_node, tainted)
+    for node in _walk_outside_inner(fn_node):
+        if jit_scope:
+            scanner._check_branch(node, tainted)
+            scanner._check_loop(node, tainted)
+        scanner._check_host_sync(node, tainted)
+
+
+def _visit_top(node, visit_scope, flax_classes, in_flax_class):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        visit_scope(node, False, in_flax_class=in_flax_class)
+    elif isinstance(node, ast.ClassDef):
+        is_flax = node.name in flax_classes
+        for child in node.body:
+            _visit_top(child, visit_scope, flax_classes, in_flax_class=is_flax)

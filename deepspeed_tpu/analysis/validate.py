@@ -1,0 +1,260 @@
+"""Runtime sharding validation — the dynamic half of the SC rule family.
+
+The AST rules (sharding_rules.py) can only check *literal* axis names.
+Spec trees built programmatically (runtime/zero/sharding.py rule tables)
+need the live mesh: this module validates them at engine init, enabled
+with ``"validate_sharding": true`` in the config. It generalizes the
+MoE×ZeRO opt-state spec tests into a checker:
+
+- every PartitionSpec axis must be a declared mesh axis        (hard error)
+- no mesh axis may shard two dims of one tensor                (hard error)
+- sharded dim sizes must divide by the axis-product            (hard error)
+- optimizer-state specs must structurally EXTEND their param's
+  spec (param axes preserved per dim, ZeRO axes stacked on top) (hard error)
+- under ZeRO stage >= 1, large opt-state leaves that carry no
+  DP partition axis are reported as warnings (the rule tables
+  legitimately skip indivisible shapes)
+
+jax is imported lazily so importing the analysis package (e.g. from
+bin/ds_tpu_lint) works without the accelerator stack.
+"""
+
+from typing import Any, Dict, List, Optional, Sequence
+
+# Leaves above this size with no ZeRO partition axis under stage>=1 draw a
+# warning: small biases/scales are fine to replicate, a hidden-dim matrix
+# is not.
+_ZERO_COVERAGE_WARN_NUMEL = 65536
+
+
+def _axes_of(spec) -> List[tuple]:
+    """[(dim_idx, axis_name), ...] with tuple entries flattened."""
+    out = []
+    for i, entry in enumerate(spec):
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, (tuple, list)) else (entry,)):
+            if a is not None:
+                out.append((i, a))
+    return out
+
+
+def _axis_product(mesh_shape: Dict[str, int], entry) -> int:
+    if entry is None:
+        return 1
+    names = entry if isinstance(entry, (tuple, list)) else (entry,)
+    prod = 1
+    for a in names:
+        if a is not None:
+            prod *= mesh_shape.get(a, 1)
+    return prod
+
+
+def validate_spec(spec, mesh_shape: Dict[str, int],
+                  shape: Optional[Sequence[int]] = None,
+                  where: str = "") -> List[str]:
+    """Problems for one PartitionSpec against a {axis: size} mesh shape."""
+    problems = []
+    declared = tuple(mesh_shape.keys())
+    pairs = _axes_of(spec)
+    for _, axis in pairs:
+        if axis not in mesh_shape:
+            problems.append(
+                f"{where}: spec {spec} names undefined mesh axis {axis!r} "
+                f"(declared axes: {declared})")
+    counts: Dict[str, int] = {}
+    for _, axis in pairs:
+        counts[axis] = counts.get(axis, 0) + 1
+    for axis, n in counts.items():
+        if n > 1:
+            problems.append(
+                f"{where}: spec {spec} uses mesh axis {axis!r} {n} times — "
+                "an axis can shard at most one dim")
+    if shape is not None:
+        if len(spec) > len(shape):
+            problems.append(
+                f"{where}: spec {spec} has {len(spec)} entries for a "
+                f"rank-{len(shape)} tensor of shape {tuple(shape)}")
+        else:
+            for i, entry in enumerate(spec):
+                n = _axis_product(mesh_shape, entry)
+                if n > 1 and shape[i] % n != 0:
+                    problems.append(
+                        f"{where}: dim {i} of shape {tuple(shape)} is not "
+                        f"divisible by axis product {n} for spec entry "
+                        f"{entry!r}")
+    return problems
+
+
+def _is_spec(x) -> bool:
+    from jax.sharding import PartitionSpec
+    return isinstance(x, PartitionSpec)
+
+
+def _leaf_spec(x):
+    """PartitionSpec from a spec or NamedSharding leaf, else None."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    if isinstance(x, PartitionSpec):
+        return x
+    if isinstance(x, NamedSharding):
+        return x.spec
+    return None
+
+
+def validate_spec_tree(specs, mesh, shapes=None, where: str = "specs") -> List[str]:
+    """Validate every PartitionSpec/NamedSharding leaf of a tree. When
+    ``shapes`` (a matching tree of shaped leaves) is given, divisibility
+    is checked too."""
+    import jax
+
+    mesh_shape = dict(mesh.shape)
+    problems: List[str] = []
+    leaves = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: _leaf_spec(x) is not None)[0]
+    shape_leaves = None
+    if shapes is not None:
+        shape_leaves = jax.tree.leaves(
+            shapes, is_leaf=lambda x: hasattr(x, "shape"))
+        if len(shape_leaves) != len(leaves):
+            shape_leaves = None  # structure mismatch: skip divisibility
+    for i, (path, leaf) in enumerate(leaves):
+        spec = _leaf_spec(leaf)
+        if spec is None:
+            continue
+        shape = None
+        if shape_leaves is not None:
+            shape = getattr(shape_leaves[i], "shape", None)
+        label = where + jax.tree_util.keystr(path)
+        problems.extend(validate_spec(spec, mesh_shape, shape, label))
+    return problems
+
+
+def _spec_extends(param_spec, opt_spec) -> bool:
+    """True when opt_spec keeps every param axis on the same dim (the ZeRO
+    rule stacks partition axes on top, never moves or drops them)."""
+    p = list(param_spec) + [None] * max(0, len(opt_spec) - len(param_spec))
+    o = list(opt_spec) + [None] * max(0, len(param_spec) - len(opt_spec))
+    for p_entry, o_entry in zip(p, o):
+        p_axes = [a for a in (p_entry if isinstance(p_entry, (tuple, list))
+                              else (p_entry,)) if a is not None]
+        o_axes = [a for a in (o_entry if isinstance(o_entry, (tuple, list))
+                              else (o_entry,)) if a is not None]
+        if any(a not in o_axes for a in p_axes):
+            return False
+    return True
+
+
+def validate_param_opt_consistency(param_specs, opt_specs, mesh,
+                                   param_shapes=None, zero_stage: int = 0,
+                                   where: str = "opt_state") -> List[str]:
+    """Check optimizer-state spec subtrees against the param spec tree.
+
+    ``opt_specs`` may be the full optimizer-state spec/sharding tree (e.g.
+    optax's (ScaleByAdamState(count, mu, nu), ...)): every subtree whose
+    structure matches the param tree (mu, nu, fp32 master...) is paired
+    leaf-by-leaf with the params; other leaves (step counts...) are
+    validated standalone by validate_spec_tree.
+    """
+    import jax
+
+    problems: List[str] = []
+    param_leaves = jax.tree.leaves(param_specs, is_leaf=_is_spec)
+    param_structure = jax.tree.structure(param_specs, is_leaf=_is_spec)
+    shape_leaves = (jax.tree.leaves(param_shapes,
+                                    is_leaf=lambda x: hasattr(x, "shape"))
+                    if param_shapes is not None else None)
+
+    dp_axes = [a for a in ("data", "expert", "fsdp")
+               if dict(mesh.shape).get(a, 1) > 1]
+
+    def check_aligned(subtree, label):
+        opt_leaves = jax.tree.leaves(subtree, is_leaf=lambda x: _leaf_spec(x) is not None)
+        for i, (p_spec, o_leaf) in enumerate(zip(param_leaves, opt_leaves)):
+            o_spec = _leaf_spec(o_leaf)
+            if o_spec is None:
+                continue
+            if not _spec_extends(p_spec, o_spec):
+                problems.append(
+                    f"{label}[leaf {i}]: opt spec {o_spec} drops or moves "
+                    f"axes of its param spec {p_spec} — ZeRO partitions "
+                    "must extend the param sharding, never contradict it")
+            if zero_stage >= 1 and dp_axes and shape_leaves is not None:
+                shape = getattr(shape_leaves[i], "shape", ())
+                numel = 1
+                for s in shape:
+                    numel *= int(s)
+                covered = any(a in dp_axes for _, a in _axes_of(o_spec))
+                if numel >= _ZERO_COVERAGE_WARN_NUMEL and not covered:
+                    problems.append(
+                        f"WARNING {label}[leaf {i}]: stage-{zero_stage} opt "
+                        f"state for a {tuple(shape)} param carries no DP "
+                        f"partition axis ({dp_axes}) — it is replicated "
+                        "across the data-parallel group")
+
+    def walk(node, label):
+        try:
+            if jax.tree.structure(node, is_leaf=_is_spec) == param_structure:
+                check_aligned(node, label)
+                return
+        except Exception:  # ds-tpu: lint-ok[PY001] — structure probe only
+            pass
+        children = _pytree_children(node)
+        if not children:
+            return
+        for key, child in children:
+            walk(child, f"{label}{key}")
+
+    walk(opt_specs, where)
+    return problems
+
+
+def _pytree_children(node):
+    """One-level pytree children as (label, child) pairs; [] for leaves."""
+    try:
+        from jax.tree_util import default_registry
+        out = default_registry.flatten_one_level(node)
+        if out is None:
+            return []
+        children, _ = out
+    except (ValueError, ImportError, AttributeError):
+        return []
+    return [(f"[{i}]", c) for i, c in enumerate(children)]
+
+
+def validate_engine_sharding(engine) -> None:
+    """Full init-time check for a DeepSpeedEngine; raises
+    DeepSpeedConfigError listing every hard problem (warnings are logged).
+
+    Wired to the ``"validate_sharding": true`` config knob.
+    """
+    from ..runtime.config_utils import DeepSpeedConfigError
+    from ..utils.logging import logger
+
+    mesh = engine.mesh
+    problems: List[str] = []
+    problems += validate_spec_tree(engine.param_specs, mesh,
+                                   shapes=getattr(engine, "_param_shapes", None),
+                                   where="params")
+    opt = getattr(engine, "opt_shardings", None)
+    if opt:
+        problems += validate_spec_tree(opt, mesh, where="opt_state")
+        problems += validate_param_opt_consistency(
+            engine.param_specs, opt, mesh,
+            param_shapes=getattr(engine, "_param_shapes", None),
+            zero_stage=getattr(engine, "zero_stage", 0))
+    grads = getattr(engine, "grad_shardings", None)
+    if grads is not None:
+        problems += validate_spec_tree(grads, mesh, where="grads")
+
+    warnings = [p for p in problems if p.startswith("WARNING")]
+    errors = [p for p in problems if not p.startswith("WARNING")]
+    for w in warnings:
+        logger.warning(f"validate_sharding: {w}")
+    if errors:
+        listing = "\n  ".join(errors)
+        raise DeepSpeedConfigError(
+            f"validate_sharding found {len(errors)} inconsistenc"
+            f"{'y' if len(errors) == 1 else 'ies'}:\n  {listing}")
+    logger.info(
+        f"validate_sharding: param/opt/grad spec trees consistent with mesh "
+        f"{dict(mesh.shape)} ({len(warnings)} warning(s))")

@@ -17,11 +17,23 @@ import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional
 
+from ..runtime.config_utils import DeepSpeedConfigError
 from ..utils.logging import logger
 from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
 
 TUNER_MAP = {"gridsearch": GridSearchTuner, "random": RandomTuner,
              "model_based": ModelBasedTuner}
+
+# What a candidate run is EXPECTED to raise when the point is infeasible:
+# device OOM / bad sharding (XlaRuntimeError subclasses RuntimeError),
+# batch-arithmetic and config rejections (ValueError/DeepSpeedConfigError,
+# TypeError), host OOM (MemoryError). Deliberately NOT here: KeyError /
+# AttributeError — those are code bugs, not infeasibility signals.
+# Anything outside this list is logged and re-raised instead of being
+# silently scored infeasible.
+_CANDIDATE_ERRORS = (ValueError, TypeError, RuntimeError, MemoryError,
+                     NotImplementedError, ArithmeticError, OSError,
+                     DeepSpeedConfigError)
 
 
 @dataclasses.dataclass
@@ -190,11 +202,16 @@ class Autotuner:
                 m = self.measurer(config)
                 return TuneResult(config, m.get("samples_per_sec"),
                                   step_ms=m.get("step_ms"))
-            except Exception as e:
+            except _CANDIDATE_ERRORS as e:
                 logger.warning(f"autotune candidate failed: {e}")
                 return TuneResult(
                     config, None,
                     error="".join(traceback.format_exception_only(e)))
+            except Exception:
+                logger.exception(
+                    f"autotune measurer raised an UNEXPECTED error on "
+                    f"{config} — not scoring it infeasible")
+                raise
         try:
             engine = self.make_engine(config)
             batch = self.make_batch(config)
@@ -206,10 +223,15 @@ class Autotuner:
             dt = (time.perf_counter() - t0) / self.measure_steps
             return TuneResult(config, config["train_batch_size"] / dt,
                               step_ms=dt * 1e3)
-        except Exception as e:  # OOM / bad sharding = infeasible point
+        except _CANDIDATE_ERRORS as e:  # OOM / bad sharding = infeasible point
             logger.warning(f"autotune candidate failed: {e}")
             return TuneResult(config, None,
                               error="".join(traceback.format_exception_only(e)))
+        except Exception:
+            logger.exception(
+                f"autotune candidate raised an UNEXPECTED error on {config} "
+                f"— not scoring it infeasible")
+            raise
 
     def tune(self, base_config: Dict[str, Any],
              zero_stages=(0, 1, 2, 3), micro_batches=(1, 2, 4, 8),

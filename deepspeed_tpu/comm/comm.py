@@ -25,7 +25,8 @@ from enum import Enum
 from typing import Optional
 
 from ..utils.logging import logger, log_dist
-from .mesh import (MESH_AXES, MeshSpec, build_mesh, get_global_mesh, set_global_mesh,
+from .mesh import (MESH_AXES, MeshSpec, build_mesh, get_global_mesh,
+                   peek_global_mesh, set_global_mesh,
                    axis_size, dp_world_size, mp_world_size, pp_world_size)
 
 
@@ -139,9 +140,52 @@ def barrier(group=None, name="ds_barrier"):
 # In-jit collectives (call inside shard_map with the axis bound).
 # ---------------------------------------------------------------------------
 
+def _declared_axes():
+    """Axis names a collective may legally bind: the MESH_AXES vocabulary
+    plus whatever the current global/abstract mesh declares (covers user
+    shard_maps over custom meshes)."""
+    axes = set(MESH_AXES)
+    mesh = peek_global_mesh()
+    if mesh is not None:
+        axes.update(mesh.axis_names)
+    try:
+        from jax.sharding import get_abstract_mesh
+        am = get_abstract_mesh()
+        if not am.empty:
+            axes.update(am.axis_names)
+    except ImportError:  # older jax: no abstract-mesh API
+        pass
+    return axes
+
+
+def _currently_bound(name) -> bool:
+    """Is ``name`` a bound axis in the active trace? Covers user
+    shard_maps over custom meshes on jax versions without the
+    abstract-mesh API (jax.core.axis_frame resolves bound axis names
+    there; raises NameError for unbound ones)."""
+    try:
+        import jax.core
+        jax.core.axis_frame(name)
+        return True
+    except (NameError, AttributeError, ImportError, TypeError, KeyError):
+        return False
+
+
 def _axis(group):
+    """Resolve+validate a group argument. A typo'd axis fails HERE with
+    the declared axes listed, not five frames deep inside lax
+    (ds_tpu_lint SC001 is the static half of this check)."""
     if group is None:
         return MESH_AXES  # whole mesh
+    names = (group,) if isinstance(group, str) else tuple(group)
+    declared = _declared_axes()
+    bad = [n for n in names
+           if isinstance(n, str) and n not in declared
+           and not _currently_bound(n)]
+    if bad:
+        raise ValueError(
+            f"unknown mesh axis/group {bad[0]!r}: declared axes are "
+            f"{tuple(sorted(declared))}")
     return group
 
 
@@ -215,7 +259,7 @@ def broadcast(tensor, src: int = 0, group=None):
 def ppermute(tensor, perm, group):
     """Neighbor exchange (pipeline p2p / ring attention building block)."""
     import jax
-    return jax.lax.ppermute(tensor, group, perm)
+    return jax.lax.ppermute(tensor, _axis(group), perm)
 
 
 def send_recv_next(tensor, group):

@@ -104,10 +104,17 @@ def peek_global_mesh():
 
 
 def axis_size(axis, mesh=None) -> int:
-    """Size of a mesh axis (or product over a tuple of axes)."""
+    """Size of a mesh axis (or product over a tuple of axes).
+
+    Unknown names raise a ValueError naming the declared axes instead of
+    a bare KeyError (or a deep lax failure downstream)."""
     mesh = mesh or get_global_mesh()
     if isinstance(axis, (tuple, list)):
         return int(np.prod([axis_size(a, mesh) for a in axis]))
+    if axis not in mesh.shape:
+        raise ValueError(
+            f"unknown mesh axis {axis!r}: declared axes are "
+            f"{tuple(mesh.shape.keys())}")
     return mesh.shape[axis]
 
 

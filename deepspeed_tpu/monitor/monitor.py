@@ -23,10 +23,21 @@ class TensorBoardMonitor(Monitor):
         self.summary_writer = None
         try:
             from torch.utils.tensorboard import SummaryWriter
+        except (ImportError, AttributeError, TypeError) as e:
+            # not installed, or the classic torch/protobuf/distutils
+            # version-skew crashes that surface as AttributeError/TypeError
+            logger.warning(f"TensorBoard monitor disabled: {e}")
+            return
+        try:
             log_dir = os.path.join(cfg.output_path or "./runs", cfg.job_name)
             self.summary_writer = SummaryWriter(log_dir=log_dir)
-        except Exception as e:
+        except (OSError, ValueError, RuntimeError, TypeError) as e:
+            # unwritable log dir / malformed config (e.g. job_name: null
+            # -> TypeError in os.path.join) / writer init failure:
+            # degrade, training must not die for a monitor. Anything else
+            # propagates.
             logger.warning(f"TensorBoard monitor disabled: {e}")
+            self.summary_writer = None
 
     def write_events(self, event_list, flush=True):
         if self.summary_writer is None:
@@ -43,10 +54,17 @@ class WandbMonitor(Monitor):
         self.enabled = False
         try:
             import wandb
+        except (ImportError, AttributeError, TypeError) as e:
+            # not installed, or dependency version skew at import time
+            logger.warning(f"W&B monitor disabled: {e}")
+            return
+        # wandb.Error is the root of wandb's own failures (auth, comms);
+        # OSError covers offline/disk issues. Anything else propagates.
+        try:
             wandb.init(project=cfg.project, group=cfg.group, entity=cfg.team)
             self._wandb = wandb
             self.enabled = True
-        except Exception as e:
+        except (wandb.Error, OSError, ValueError, RuntimeError) as e:
             logger.warning(f"W&B monitor disabled: {e}")
 
     def write_events(self, event_list):

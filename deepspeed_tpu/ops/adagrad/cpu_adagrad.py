@@ -13,6 +13,7 @@ from typing import Optional
 
 import numpy as np
 
+from ...analysis import lint_ok
 from ..op_builder import CPUAdagradBuilder
 
 _ids = itertools.count()
@@ -31,6 +32,7 @@ class DeepSpeedCPUAdagrad:
         if rc != 0:
             raise RuntimeError("ds_adagrad_create failed")
 
+    @lint_ok("TS002")  # operands are host numpy by contract (ZeRO-Offload)
     def step(self, params: np.ndarray, grads: np.ndarray,
              exp_avg_sq: np.ndarray, lr: Optional[float] = None,
              out_bf16: Optional[np.ndarray] = None):

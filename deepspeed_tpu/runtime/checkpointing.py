@@ -103,6 +103,10 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
     # at most one async save in flight: joining the previous one first
     # also publishes its latest tag
     finalize_pending_checkpoint(engine)
+    # monitor events are buffered on-device between flush cadences; a
+    # checkpoint is a durability point, so drain them to the writers
+    if hasattr(engine, "flush_monitor"):
+        engine.flush_monitor()
     tag = tag or f"global_step{engine.global_steps}"
     path = os.path.abspath(os.path.join(save_dir, str(tag)))
     os.makedirs(path, exist_ok=True)

@@ -354,6 +354,11 @@ class DeepSpeedConfig:
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
     dump_state: bool = False
+    # NEW (TPU): run the analysis-subsystem sharding checker at engine
+    # init — every param/opt/grad PartitionSpec is validated against the
+    # live mesh (declared axes, one-dim-per-axis, divisibility, opt state
+    # extending the param spec). See docs/analysis.md.
+    validate_sharding: bool = False
 
     activation_checkpointing: ActivationCheckpointingConfig = field(
         default_factory=ActivationCheckpointingConfig)

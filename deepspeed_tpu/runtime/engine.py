@@ -79,7 +79,9 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
-        self.skipped_steps = 0
+        self._skipped_steps_host = 0
+        self._skipped_steps_dev = None   # on-device fp16-skip accumulator
+        self._monitor_buffer = []        # queued (label, device value, step)
         self._compiled = {}
 
         dist.init_distributed()
@@ -121,6 +123,16 @@ class DeepSpeedEngine:
 
         # ---- optimizer ----------------------------------------------
         self._configure_optimizer(optimizer, lr_scheduler)
+
+        # ---- sharding consistency gate ------------------------------
+        # "validate_sharding": true runs the analysis-subsystem checker
+        # over the param/opt/grad spec trees against the live mesh —
+        # undefined axes, double-sharded dims, indivisible shapes, and
+        # opt-state specs that contradict their param's sharding fail
+        # here with a readable listing instead of deep inside GSPMD.
+        if config.validate_sharding:
+            from ..analysis.validate import validate_engine_sharding
+            validate_engine_sharding(self)
 
         # ---- monitors / timers --------------------------------------
         self.timers = SynchronizedWallClockTimer()
@@ -199,6 +211,32 @@ class DeepSpeedEngine:
 
     def _loss_accepts(self, kwarg: str) -> bool:
         return "*" in self._loss_fn_kwargs or kwarg in self._loss_fn_kwargs
+
+    # ------------------------------------------------------------------
+    # fp16 skip counter: accumulated ON DEVICE each step (one async
+    # scalar add), materialized on the host only when read — the per-step
+    # `int(metrics["skipped"])` sync this replaces stalled the whole ICI
+    # ring once per step (ds_tpu_lint TS002).
+    # ------------------------------------------------------------------
+
+    @property
+    def skipped_steps(self) -> int:
+        if self._skipped_steps_dev is not None:
+            self._skipped_steps_host += int(self._skipped_steps_dev)
+            self._skipped_steps_dev = None
+        return self._skipped_steps_host
+
+    @skipped_steps.setter
+    def skipped_steps(self, value):
+        # ds-tpu: lint-ok[TS002] — checkpoint restore hands a host int
+        self._skipped_steps_host = int(value)
+        self._skipped_steps_dev = None
+
+    def _accumulate_skipped(self, skipped):
+        """Fold one step's skip flag (device int32 scalar) into the
+        device-side accumulator without syncing."""
+        self._skipped_steps_dev = (skipped if self._skipped_steps_dev is None
+                                   else self._skipped_steps_dev + skipped)
 
     def _apply_activation_checkpointing_config(self):
         """Honor the DeepSpeed ``activation_checkpointing`` config block
@@ -828,6 +866,9 @@ class DeepSpeedEngine:
             self._compiled["grad_step"] = self._make_grad_step()
         grads, new_scaler, metrics = self._compiled["grad_step"](
             self.params, scaler, batch, rng, extra)
+        # ds-tpu: lint-ok[TS002] — the host-side cpu_adam step needs the
+        # finite flag on the host to decide whether to apply the update;
+        # this sync is the native-offload contract, not an accident.
         finite = bool(metrics["finite"])
         lr = float(self.lr_schedule(self.global_steps)) if callable(
             self.lr_schedule) else float(self.lr_schedule)
@@ -906,7 +947,7 @@ class DeepSpeedEngine:
                 self.params, self.optimizer_state, scaler, batch, rng, extra)
         if self.fp16_enabled:
             self.loss_scale_state = new_scaler
-            self.skipped_steps += int(metrics["skipped"])
+            self._accumulate_skipped(metrics["skipped"])
 
         self.global_steps += 1
         self.micro_steps += gas
@@ -1132,7 +1173,7 @@ class DeepSpeedEngine:
             gnorm, new_scaler, skipped = self._device_step(scaler)
         if self.fp16_enabled:
             self.loss_scale_state = new_scaler
-            self.skipped_steps += int(skipped)
+            self._accumulate_skipped(skipped)
         self._accum_grads = None
         self._accum_count = 0
         self.global_steps += 1
@@ -1234,11 +1275,13 @@ class DeepSpeedEngine:
             self._accum_grads, scaler)
         lr = (float(self.lr_schedule(self.global_steps))
               if callable(self.lr_schedule) else float(self.lr_schedule))
+        # host cpu_adam needs the finite flag on the host (native-offload
+        # contract); one sync per optimizer step, not per microbatch.
         new_params = self.native_offload.step(grads, lr=lr,
-                                              finite=bool(finite))
+                                              finite=bool(finite))  # ds-tpu: lint-ok[TS002]
         if new_params is not None:
             self.params = new_params
-        return gnorm, new_scaler, jnp.int32(0 if bool(finite) else 1)
+        return gnorm, new_scaler, jnp.int32(0 if bool(finite) else 1)  # ds-tpu: lint-ok[TS002]
 
     def eval_batch(self, batch: Dict[str, Any], **loss_kwargs):
         self._ensure_params_resident()
@@ -1445,13 +1488,15 @@ class DeepSpeedEngine:
             logger.warning(f"flops profiler failed: {e}")
 
     def _report_step(self, metrics):
-        loss = float(metrics["loss"])
+        # Caller gates this to the steps_per_print cadence; materializing
+        # the scalars here is the logging sync, not a per-step one.
+        loss = float(metrics["loss"])  # ds-tpu: lint-ok[TS002]
         extra = ""
         if self.fp16_enabled:
-            extra = f" loss_scale={float(metrics['loss_scale']):.0f}"
+            extra = f" loss_scale={float(metrics['loss_scale']):.0f}"  # ds-tpu: lint-ok[TS002]
         log_dist(
             f"step={self.global_steps} loss={loss:.4f} "
-            f"lr={self.get_lr():.3e} grad_norm={float(metrics['grad_norm']):.3f}"
+            f"lr={self.get_lr():.3e} grad_norm={float(metrics['grad_norm']):.3f}"  # ds-tpu: lint-ok[TS002]
             f"{extra} samples/sec={self.tput_timer.avg_samples_per_sec():.1f}",
             ranks=[0])
         if self.config.wall_clock_breakdown:
@@ -1459,14 +1504,46 @@ class DeepSpeedEngine:
                              STEP_GLOBAL_TIMER])
 
     def _write_monitor(self, metrics):
-        if self.monitor.enabled:
-            events = [("Train/Samples/train_loss", float(metrics["loss"]),
-                       self.global_samples),
-                      ("Train/Samples/lr", self.get_lr(), self.global_samples)]
-            if self.fp16_enabled:
-                events.append(("Train/Samples/loss_scale",
-                               float(metrics["loss_scale"]), self.global_samples))
-            self.monitor.write_events(events)
+        """Queue this step's monitor events with the scalars still ON
+        DEVICE; they are materialized in one batched transfer at the
+        steps_per_print cadence (flush_monitor). The old per-step
+        ``float(metrics["loss"])`` here was a hidden host sync every
+        step whenever any monitor backend was enabled (ds_tpu_lint
+        TS002's first real catch)."""
+        if not self.monitor.enabled:
+            return
+        events = [("Train/Samples/train_loss", metrics["loss"],
+                   self.global_samples),
+                  ("Train/Samples/lr", self.get_lr(), self.global_samples)]
+        if self.fp16_enabled:
+            events.append(("Train/Samples/loss_scale",
+                           metrics["loss_scale"], self.global_samples))
+        self._monitor_buffer.extend(events)
+        if self.global_steps % self.config.steps_per_print == 0:
+            self.flush_monitor()
+
+    def flush_monitor(self):
+        """Materialize queued monitor events (one batched device_get) and
+        hand them to the writers. Runs at the steps_per_print cadence,
+        from checkpoint save, and on engine teardown; call it directly
+        before reading the monitor files mid-run."""
+        if not self._monitor_buffer:
+            return
+        values = jax.device_get([v for _, v, _ in self._monitor_buffer])
+        events = [(label, float(v), step) for (label, _, step), v
+                  in zip(self._monitor_buffer, values)]
+        self._monitor_buffer = []
+        self.monitor.write_events(events)
+
+    def __del__(self):
+        # Tail events after the last cadence boundary must not be lost
+        # when training ends without a final checkpoint. Teardown may run
+        # at interpreter shutdown with the backend half-dead — best
+        # effort only, never raise from a destructor.
+        try:
+            self.flush_monitor()
+        except Exception:  # ds-tpu: lint-ok[PY001] — destructor, backend may be gone
+            pass
 
 
 def _init_kwargs(sample_batch):

@@ -289,6 +289,8 @@ class PipelineEngine(DeepSpeedEngine):
         a full global batch, pipelines gas microbatches, steps once."""
         cfg = self.config
         expect = cfg.train_batch_size
+        # ds-tpu: lint-ok[TS002] — batch arrives as host numpy from the
+        # dataloader; this is input validation, not a device readback.
         ids = np.asarray(batch["input_ids"])
         if ids.shape[0] != expect:
             raise ValueError(f"batch dim {ids.shape[0]} != train_batch_size "
@@ -304,7 +306,7 @@ class PipelineEngine(DeepSpeedEngine):
                                          scaler, dev_batch, rng)
         if self.fp16_enabled:
             self.loss_scale_state = new_scaler
-            self.skipped_steps += int(metrics["skipped"])
+            self._accumulate_skipped(metrics["skipped"])
         self.global_steps += 1
         self.global_samples += expect
         self.tput_timer.stop(global_step=True)

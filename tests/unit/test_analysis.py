@@ -1,0 +1,659 @@
+"""ds_tpu_lint analyzer tests: every rule must fire on a seeded-violation
+fixture AND stay quiet on a clean equivalent, suppression/baseline must
+triage, and the runtime sharding validator must catch inconsistent spec
+trees (ISSUE 2 acceptance criteria)."""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.analysis import (analyze_source, all_rules,
+                                    declared_mesh_axes, load_baseline,
+                                    save_baseline, split_by_baseline,
+                                    validate_spec, validate_spec_tree,
+                                    validate_param_opt_consistency,
+                                    validate_engine_sharding)
+from deepspeed_tpu.analysis.cli import main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def src(body):
+    return textwrap.dedent(body)
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: (rule, seeded violation, clean equivalent)
+# ---------------------------------------------------------------------------
+
+FIXTURES = [
+    ("TS001",
+     """
+     import jax
+     import jax.numpy as jnp
+
+     @jax.jit
+     def f(x):
+         if x > 0:
+             return x
+         return -x
+     """,
+     """
+     import jax
+     import jax.numpy as jnp
+
+     @jax.jit
+     def f(x):
+         return jnp.where(x > 0, x, -x)
+     """),
+    ("TS002",  # jit scope: the sync-hazard true positive
+     """
+     import jax
+
+     @jax.jit
+     def f(x):
+         y = x * 2
+         return float(y)
+     """,
+     """
+     import jax
+
+     @jax.jit
+     def f(x):
+         scale = float(x.shape[0])
+         return x * scale
+     """),
+    ("TS002",  # step-path scope: the engine.py:1448 shape of the bug
+     """
+     def train_step(params, metrics):
+         loss = float(metrics["loss"])
+         return loss
+     """,
+     """
+     def summarize(params, metrics):
+         loss = float(metrics["loss"])
+         return loss
+     """),
+    ("TS003",
+     """
+     import jax
+     from functools import partial
+
+     @partial(jax.jit, static_argnames=("cfg",))
+     def f(x, cfg=[]):
+         return x
+     """,
+     """
+     import jax
+     from functools import partial
+
+     @partial(jax.jit, static_argnames=("cfg",))
+     def f(x, cfg=()):
+         return x
+     """),
+    ("TS004",
+     """
+     import jax
+
+     @jax.jit
+     def f(xs):
+         total = 0.0
+         for row in xs:
+             total = total + row
+         return total
+     """,
+     """
+     import jax
+
+     @jax.jit
+     def f(xs):
+         total = 0.0
+         for i in range(xs.shape[0]):
+             total = total + i
+         return total
+     """),
+    ("TS005",
+     """
+     import jax.numpy as jnp
+
+     MASK = jnp.zeros((4, 4))
+     """,
+     """
+     import numpy as np
+
+     MASK = np.zeros((4, 4))
+     """),
+    ("PY001",
+     """
+     def f():
+         try:
+             return work()
+         except Exception:
+             return None
+     """,
+     """
+     def f():
+         try:
+             return work()
+         except (ValueError, KeyError):
+             return None
+     """),
+    ("SC001",  # the undefined-collective-axis true positive
+     """
+     import jax
+
+     def f(x):
+         return jax.lax.psum(x, "dataa")
+     """,
+     """
+     import jax
+
+     def f(x):
+         return jax.lax.psum(x, "data")
+     """),
+    ("SC001",  # comm facade form
+     """
+     import deepspeed_tpu.comm as dist
+
+     def f(x):
+         return dist.all_reduce(x, group="bogus")
+     """,
+     """
+     import deepspeed_tpu.comm as dist
+
+     def f(x):
+         return dist.all_reduce(x, group=("data", "fsdp"))
+     """),
+    ("SC002",
+     """
+     from jax.sharding import PartitionSpec as P
+
+     SPEC = P("dataa", None)
+     """,
+     """
+     from jax.sharding import PartitionSpec as P
+
+     SPEC = P("data", None)
+     """),
+]
+
+
+@pytest.mark.parametrize("rule,bad,good", FIXTURES,
+                         ids=[f"{r}-{i}" for i, (r, _, _) in enumerate(FIXTURES)])
+def test_rule_fires_on_seeded_violation_and_not_on_clean(rule, bad, good):
+    bad_findings = analyze_source(src(bad), path="seeded.py")
+    assert rule in rules_of(bad_findings), \
+        f"{rule} did not fire on seeded violation: {bad_findings}"
+    good_findings = analyze_source(src(good), path="clean.py")
+    assert rule not in rules_of(good_findings), \
+        f"{rule} false-positive on clean equivalent: {good_findings}"
+
+
+def test_every_registered_rule_has_a_fixture():
+    covered = {r for r, _, _ in FIXTURES}
+    assert covered == set(all_rules()), \
+        "every rule needs a seeded-violation fixture"
+
+
+def test_broad_except_with_reraise_is_allowed():
+    code = src("""
+    def f():
+        try:
+            return work()
+        except Exception:
+            cleanup()
+            raise
+    """)
+    assert "PY001" not in rules_of(analyze_source(code))
+
+
+def test_branch_on_none_check_is_not_traced_branch():
+    code = src("""
+    import jax
+
+    @jax.jit
+    def f(x, rng=None):
+        if rng is None:
+            return x
+        return x + 1
+    """)
+    assert "TS001" not in rules_of(analyze_source(code))
+
+
+def test_shard_map_passed_function_is_jit_scope():
+    code = src("""
+    import jax
+    from jax.experimental.shard_map import shard_map
+
+    def body(x):
+        return float(x)
+
+    f = shard_map(body, mesh, in_specs=None, out_specs=None)
+    """)
+    assert "TS002" in rules_of(analyze_source(code))
+
+
+def test_flax_module_call_is_jit_scope():
+    code = src("""
+    import flax.linen as nn
+
+    class Layer(nn.Module):
+        def __call__(self, x, deterministic=True):
+            if deterministic:   # static config switch: fine
+                x = x * 2
+            for row in x:       # traced loop: not fine
+                pass
+            return x
+    """)
+    found = rules_of(analyze_source(code))
+    assert "TS004" in found and "TS001" not in found
+
+
+# ---------------------------------------------------------------------------
+# suppression: pragmas, comment-block pragmas, decorator
+# ---------------------------------------------------------------------------
+
+def test_same_line_pragma_suppresses():
+    code = src("""
+    import jax.numpy as jnp
+
+    MASK = jnp.zeros((4, 4))  # ds-tpu: lint-ok[TS005]
+    """)
+    assert "TS005" not in rules_of(analyze_source(code))
+
+
+def test_pragma_with_other_rule_does_not_suppress():
+    code = src("""
+    import jax.numpy as jnp
+
+    MASK = jnp.zeros((4, 4))  # ds-tpu: lint-ok[TS001]
+    """)
+    assert "TS005" in rules_of(analyze_source(code))
+
+
+def test_blanket_pragma_suppresses_all():
+    code = src("""
+    import jax.numpy as jnp
+
+    MASK = jnp.zeros((4, 4))  # ds-tpu: lint-ok
+    """)
+    assert not analyze_source(code)
+
+
+def test_comment_block_pragma_covers_next_source_line():
+    code = src("""
+    import jax.numpy as jnp
+
+    # ds-tpu: lint-ok[TS005] — shared constant, built once on purpose;
+    # this triage note spans several comment lines before the code.
+    MASK = jnp.zeros((4, 4))
+    """)
+    assert "TS005" not in rules_of(analyze_source(code))
+
+
+def test_lint_ok_decorator_suppresses_function_body():
+    code = src("""
+    from deepspeed_tpu.analysis import lint_ok
+
+    @lint_ok("TS002")
+    def train_step(params, metrics):
+        return float(metrics["loss"])
+    """)
+    assert "TS002" not in rules_of(analyze_source(code))
+
+
+def test_lint_ok_decorator_is_runtime_noop():
+    from deepspeed_tpu.analysis import lint_ok
+
+    @lint_ok("TS002")
+    def f(x):
+        return x + 1
+
+    @lint_ok
+    def g(x):
+        return x + 2
+
+    assert f(1) == 2 and g(1) == 3
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow
+# ---------------------------------------------------------------------------
+
+SEEDED_TWO = src("""
+import jax.numpy as jnp
+
+A = jnp.zeros((2,))
+""")
+
+SEEDED_THREE = src("""
+import jax.numpy as jnp
+
+A = jnp.zeros((2,))
+B = jnp.ones((2,))
+""")
+
+
+def test_baseline_roundtrip_and_split(tmp_path):
+    path = str(tmp_path / "base.json")
+    first = analyze_source(SEEDED_TWO, path="mod.py")
+    save_baseline(path, first)
+    baseline = load_baseline(path)
+    assert len(baseline) == len(first) == 1
+
+    # same findings -> all baselined, nothing new
+    new, old, stale = split_by_baseline(
+        analyze_source(SEEDED_TWO, path="mod.py"), baseline)
+    assert not new and len(old) == 1 and not stale
+
+    # an added violation -> exactly it is new
+    new, old, stale = split_by_baseline(
+        analyze_source(SEEDED_THREE, path="mod.py"), baseline)
+    assert len(new) == 1 and "B = " in new[0].source_line and len(old) == 1
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    path = str(tmp_path / "base.json")
+    save_baseline(path, analyze_source(SEEDED_THREE, path="mod.py"))
+    new, old, stale = split_by_baseline(
+        analyze_source(SEEDED_TWO, path="mod.py"), load_baseline(path))
+    assert not new and len(old) == 1 and len(stale) == 1
+
+
+def test_fingerprints_are_line_number_independent():
+    f1 = analyze_source(SEEDED_TWO, path="mod.py")[0]
+    f2 = analyze_source("\n\n\n" + SEEDED_TWO, path="mod.py")[0]
+    assert f1.fingerprint == f2.fingerprint and f1.line != f2.line
+
+
+# ---------------------------------------------------------------------------
+# CLI behavior + exit codes
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED_TWO)
+    base = str(tmp_path / "b.json")
+
+    assert lint_main([str(bad)]) == 1                       # new finding
+    assert lint_main([str(bad), "--baseline", base,
+                      "--update-baseline"]) == 0            # triage
+    assert lint_main([str(bad), "--baseline", base]) == 0   # baselined
+    assert lint_main([]) == 2                               # usage
+    assert lint_main(["--list-rules"]) == 0
+    assert lint_main([str(bad), "--rules", "NOPE"]) == 2
+    assert lint_main([str(bad), "--rules", "PY001"]) == 0   # rule filter
+    # a filtered update would silently drop other rules' triaged entries
+    assert lint_main([str(bad), "--rules", "PY001", "--baseline", base,
+                      "--update-baseline"]) == 2
+    assert lint_main([str(bad), "--baseline", base]) == 0   # base untouched
+    capsys.readouterr()
+
+
+def test_cli_rule_filter_does_not_misreport_stale(tmp_path, capsys):
+    """--rules with --baseline: other rules' triaged entries are neither
+    'new' nor falsely 'stale' (they were never produced by the run)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED_TWO + "\n\ndef f():\n    try:\n        g()\n"
+                   "    except Exception:\n        pass\n")
+    base = str(tmp_path / "b.json")
+    assert lint_main([str(bad), "--baseline", base,
+                      "--update-baseline"]) == 0  # TS005 + PY001 triaged
+    capsys.readouterr()
+    assert lint_main([str(bad), "--baseline", base, "--rules", "PY001"]) == 0
+    out = capsys.readouterr().out
+    assert "stale" not in out.replace("0 stale", ""), out
+
+
+def test_cli_corrupt_baseline_is_usage_error(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED_TWO)
+    corrupt = tmp_path / "b.json"
+    corrupt.write_text("{not json")
+    assert lint_main([str(bad), "--baseline", str(corrupt)]) == 2
+    corrupt.write_text('{"version": 99, "findings": []}')
+    assert lint_main([str(bad), "--baseline", str(corrupt)]) == 2
+    capsys.readouterr()
+
+
+def test_cli_json_format(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED_TWO)
+    assert lint_main([str(bad), "--format", "json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["new"] and out["new"][0]["rule"] == "TS005"
+
+
+def test_cli_mesh_axes_extension(tmp_path, capsys):
+    script = tmp_path / "train.py"
+    script.write_text(src("""
+    import jax
+
+    def f(x):
+        return jax.lax.psum(x, "replica")
+    """))
+    assert lint_main([str(script)]) == 1                    # unknown axis
+    assert lint_main([str(script), "--mesh-axes", "replica"]) == 0
+    capsys.readouterr()
+
+
+def test_repo_is_clean_against_committed_baseline(capsys):
+    """The CI gate: `ds_tpu_lint deepspeed_tpu --baseline ...` exits 0."""
+    pkg = os.path.join(REPO_ROOT, "deepspeed_tpu")
+    baseline = os.path.join(REPO_ROOT, ".ds_tpu_lint_baseline.json")
+    assert os.path.exists(baseline), "committed baseline file missing"
+    rc = lint_main([pkg, "--baseline", baseline, "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"new lint findings in the package:\n{out}"
+    assert "0 stale" in out, f"stale baseline entries — regenerate:\n{out}"
+
+
+def test_declared_mesh_axes_parsed_from_mesh_py():
+    from deepspeed_tpu.comm.mesh import MESH_AXES
+    assert declared_mesh_axes() == tuple(MESH_AXES)
+    assert declared_mesh_axes(extra=("replica",))[-1] == "replica"
+
+
+# ---------------------------------------------------------------------------
+# runtime sharding validator (the validate_sharding knob's engine)
+# ---------------------------------------------------------------------------
+
+MESH_SHAPE = {"stage": 1, "data": 2, "expert": 2, "fsdp": 2, "seq": 1,
+              "model": 1}
+
+
+def test_validate_spec_flags_unknown_axis():
+    probs = validate_spec(P("bogus"), MESH_SHAPE, shape=(8,), where="w")
+    assert len(probs) == 1 and "undefined mesh axis 'bogus'" in probs[0]
+
+
+def test_validate_spec_flags_duplicate_axis():
+    probs = validate_spec(P("data", "data"), MESH_SHAPE, shape=(4, 4))
+    assert any("more than" in p or "2 times" in p for p in probs), probs
+
+
+def test_validate_spec_flags_indivisible_dim():
+    probs = validate_spec(P(("data", "fsdp"),), MESH_SHAPE, shape=(6,))
+    assert any("not divisible" in p for p in probs), probs
+    assert not validate_spec(P(("data", "fsdp"),), MESH_SHAPE, shape=(8,))
+
+
+def test_validate_spec_flags_rank_mismatch():
+    probs = validate_spec(P(None, "data"), MESH_SHAPE, shape=(8,))
+    assert any("rank" in p for p in probs), probs
+
+
+def test_validate_spec_clean():
+    assert validate_spec(P("data", ("expert", "fsdp")), MESH_SHAPE,
+                         shape=(8, 12)) == []
+
+
+def _mesh(data=2, expert=2, fsdp=2):
+    from deepspeed_tpu.comm import build_mesh, MeshSpec
+    return build_mesh(MeshSpec(data=data, expert=expert, fsdp=fsdp))
+
+
+def test_validate_spec_tree_with_shapes():
+    mesh = _mesh()
+    specs = {"w": P("data", None), "b": P("bogus")}
+    shapes = {"w": jax.ShapeDtypeStruct((4, 4), jnp.float32),
+              "b": jax.ShapeDtypeStruct((4,), jnp.float32)}
+    probs = validate_spec_tree(specs, mesh, shapes=shapes)
+    assert len(probs) == 1 and "bogus" in probs[0]
+
+
+def test_param_opt_consistency_catches_dropped_axis():
+    mesh = _mesh()
+    param_specs = {"w": P("expert", None)}
+    opt_specs = {"mu": {"w": P("expert", "data")},   # extends: fine
+                 "nu": {"w": P(None, "data")}}       # drops expert: bug
+    probs = validate_param_opt_consistency(param_specs, opt_specs, mesh)
+    assert len(probs) == 1 and "drops or moves" in probs[0], probs
+
+
+def test_param_opt_consistency_warns_on_uncovered_large_leaf():
+    mesh = _mesh()
+    param_specs = {"w": P(None, None)}
+    opt_specs = {"mu": {"w": P(None, None)}}
+    shapes = {"w": jax.ShapeDtypeStruct((256, 256), jnp.float32)}
+    probs = validate_param_opt_consistency(param_specs, opt_specs, mesh,
+                                           param_shapes=shapes, zero_stage=2)
+    assert len(probs) == 1 and probs[0].startswith("WARNING"), probs
+
+
+def test_param_opt_consistency_clean_on_real_rules():
+    """The generalization of PR 1's MoE×ZeRO spec tests: specs produced by
+    the actual rule tables must validate clean."""
+    from deepspeed_tpu.runtime.zero.sharding import (make_param_rules,
+                                                     make_opt_state_rules)
+    mesh = _mesh()
+    names = {"w": ("experts", "embed", "mlp"), "k": ("embed", "mlp")}
+    shapes = {"w": jax.ShapeDtypeStruct((2, 32, 64), jnp.float32),
+              "k": jax.ShapeDtypeStruct((32, 64), jnp.float32)}
+    prules = make_param_rules(2)
+    param_specs = {k: prules(names[k], shapes[k].shape, mesh) for k in names}
+    orules = make_opt_state_rules(2, mesh)
+    opt_specs = {"mu": {k: orules(param_specs[k], shapes[k].shape, names[k])
+                        for k in names}}
+    assert validate_spec_tree(param_specs, mesh, shapes=shapes) == []
+    probs = validate_param_opt_consistency(param_specs, opt_specs, mesh,
+                                           param_shapes=shapes, zero_stage=2)
+    assert [p for p in probs if not p.startswith("WARNING")] == []
+
+
+# ---------------------------------------------------------------------------
+# engine integration: validate_sharding knob + per-step sync fixes
+# ---------------------------------------------------------------------------
+
+VOCAB, SEQ = 64, 8
+
+
+def _make_engine(tmp_path, extra_cfg=None):
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import GPT, GPTConfig, gpt_loss_fn
+
+    cfg = GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ, d_model=16,
+                    n_layers=2, n_heads=2, dtype=jnp.float32,
+                    scan_layers=True)
+
+    def loss_fn(model, params, batch, rng, train):
+        ids = batch["input_ids"]
+        logits = model.apply(params, ids, deterministic=not train)
+        return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
+
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 2,
+        "validate_sharding": True,
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "lint_pr"},
+    }
+    config.update(extra_cfg or {})
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, VOCAB, size=(8, SEQ),
+                                       dtype=np.int32)}
+    engine, *_ = ds.initialize(model=GPT(cfg), config=config,
+                               loss_fn=loss_fn, sample_batch=batch)
+    return engine, batch
+
+
+@pytest.fixture(scope="module")
+def engine_and_batch(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("monitor")
+    engine, batch = _make_engine(tmp)
+    return engine, batch, tmp
+
+
+def test_engine_inits_clean_with_validate_sharding(engine_and_batch):
+    engine, _, _ = engine_and_batch  # construction already ran the checker
+    assert engine.config.validate_sharding
+
+
+def test_validate_engine_sharding_catches_corrupted_spec(engine_and_batch):
+    engine, _, _ = engine_and_batch
+    from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+    is_spec = lambda x: isinstance(x, P)
+    flat, treedef = jax.tree.flatten(engine.param_specs, is_leaf=is_spec)
+    good = list(flat)
+    flat[0] = P("bogus")
+    engine.param_specs = jax.tree.unflatten(treedef, flat)
+    try:
+        with pytest.raises(DeepSpeedConfigError, match="bogus"):
+            validate_engine_sharding(engine)
+    finally:
+        engine.param_specs = jax.tree.unflatten(treedef, good)
+
+
+def test_monitor_events_buffered_until_cadence(engine_and_batch):
+    """The engine.py per-step `float(metrics["loss"])` fix: monitor events
+    queue on-device and flush once per steps_per_print."""
+    engine, batch, tmp = engine_and_batch
+    csv_dir = os.path.join(str(tmp), "lint_pr")
+
+    engine.train_batch(batch)           # step 1: buffered, no flush
+    assert engine._monitor_buffer, "events should be queued on-device"
+    loss_file = os.path.join(csv_dir, "Train_Samples_train_loss.csv")
+    assert not os.path.exists(loss_file), "flushed too early"
+
+    engine.train_batch(batch)           # step 2: cadence -> flush
+    assert not engine._monitor_buffer
+    assert os.path.exists(loss_file)
+    with open(loss_file) as f:
+        rows = f.read().strip().splitlines()
+    assert len(rows) == 3, rows         # header + 2 steps
+    # queued values materialized to real floats, not reprs of arrays
+    assert float(rows[1].split(",")[1]) > 0
+
+
+def test_flush_monitor_is_idempotent(engine_and_batch):
+    engine, _, _ = engine_and_batch
+    engine.flush_monitor()
+    engine.flush_monitor()
+    assert not engine._monitor_buffer
+
+
+def test_skipped_steps_accumulates_on_device(engine_and_batch):
+    engine, _, _ = engine_and_batch
+    engine.skipped_steps = 0
+    engine._accumulate_skipped(jnp.int32(1))
+    engine._accumulate_skipped(jnp.int32(1))
+    assert isinstance(engine._skipped_steps_dev, jax.Array)
+    assert engine.skipped_steps == 2        # lazy materialization
+    assert engine._skipped_steps_dev is None
+    engine.skipped_steps = 7                # checkpoint-restore path
+    assert engine.skipped_steps == 7

@@ -26,11 +26,41 @@ def _run(args, timeout=120, env_extra=None):
 
 @pytest.mark.parametrize("script", [
     "ds_tpu", "ds_tpu_bench", "ds_tpu_elastic", "ds_tpu_ssh",
-    "ds_tpu_to_universal"])
+    "ds_tpu_to_universal", "ds_tpu_lint"])
 def test_help_exits_zero(script):
     r = _run([os.path.join(BIN, script), "--help"])
     assert r.returncode == 0, r.stderr[-300:]
     assert "usage" in r.stdout.lower()
+
+
+def test_lint_gate_subprocess(tmp_path):
+    """The CI gate invocation, as a real subprocess — with the accelerator
+    stack genuinely blocked (a sitecustomize import hook raises on
+    jax/numpy/flax), proving the lint job needs no dependency install."""
+    (tmp_path / "sitecustomize.py").write_text(
+        "import sys, importlib.abc\n"
+        "class _B(importlib.abc.MetaPathFinder):\n"
+        "    def find_spec(self, fullname, path=None, target=None):\n"
+        "        if fullname.split('.')[0] in ('jax', 'jaxlib', 'numpy',\n"
+        "                                      'flax', 'optax', 'torch'):\n"
+        "            raise ImportError('blocked by test: ' + fullname)\n"
+        "sys.meta_path.insert(0, _B())\n")
+    r = _run([os.path.join(BIN, "ds_tpu_lint"),
+              os.path.join(REPO, "deepspeed_tpu"),
+              "--baseline", os.path.join(REPO, ".ds_tpu_lint_baseline.json"),
+              "-q"],
+             env_extra={"PYTHONPATH": str(tmp_path)})
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-500:]
+    assert "0 new" in r.stdout
+
+
+def test_lint_flags_seeded_violation(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\ndef f(x):\n"
+                   "    return jax.lax.psum(x, 'dataa')\n")
+    r = _run([os.path.join(BIN, "ds_tpu_lint"), str(bad)])
+    assert r.returncode == 1
+    assert "SC001" in r.stdout
 
 
 def test_report_runs():

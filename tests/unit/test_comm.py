@@ -166,3 +166,72 @@ class TestInt8CompressedAllreduce:
         assert out.shape == (8, 77) and ne.shape == (8, 77)
         want = np.asarray(x).mean(axis=0)
         assert np.abs(np.asarray(out)[0] - want).max() < 0.06
+
+
+# ---------------------------------------------------------------------------
+# Axis-name resolution: a typo'd group must fail with a clear ValueError
+# naming the declared axes, not a KeyError or a deep lax error
+# (satellite of the ds_tpu_lint PR; SC001 is the static half).
+# ---------------------------------------------------------------------------
+
+class TestAxisNameResolution:
+    def test_axis_size_unknown_axis(self):
+        build_mesh(MeshSpec(data=8))
+        with pytest.raises(ValueError, match=r"unknown mesh axis.*'data'"):
+            dist.axis_size("dataa")
+
+    def test_axis_size_unknown_axis_in_tuple(self):
+        build_mesh(MeshSpec(data=8))
+        with pytest.raises(ValueError, match="unknown mesh axis"):
+            dist.axis_size(("data", "bogus"))
+
+    def test_host_collective_unknown_group(self):
+        build_mesh(MeshSpec(data=8))
+        x = jnp.arange(8.0)
+        with pytest.raises(ValueError, match=r"unknown mesh axis/group 'bogus'"):
+            dist.all_reduce_host(x, group="bogus")
+
+    def test_in_jit_collective_unknown_group(self):
+        mesh = build_mesh(MeshSpec(data=8))
+        x = jnp.arange(8.0)
+        f = shard_map(lambda t: dist.all_reduce(t, group="nonexistent"),
+                      mesh, (P("data"),), P("data"))
+        with pytest.raises(ValueError, match="declared axes"):
+            jax.jit(f)(x)
+
+    def test_in_jit_collective_unknown_group_in_tuple(self):
+        mesh = build_mesh(MeshSpec(data=8))
+        x = jnp.arange(8.0)
+        f = shard_map(lambda t: dist.all_reduce(t, group=("data", "fsdpp")),
+                      mesh, (P("data"),), P("data"))
+        with pytest.raises(ValueError, match=r"'fsdpp'"):
+            jax.jit(f)(x)
+
+    def test_send_recv_unknown_group(self):
+        build_mesh(MeshSpec(data=8))
+        with pytest.raises(ValueError, match="declared axes"):
+            dist.send_recv_next(jnp.arange(8.0), "ringg")
+
+    def test_error_message_names_all_declared_axes(self):
+        build_mesh(MeshSpec(data=8))
+        with pytest.raises(ValueError) as ei:
+            dist.all_gather_host(jnp.arange(8.0), group="oops")
+        for axis in ("stage", "data", "expert", "fsdp", "seq", "model"):
+            assert axis in str(ei.value)
+
+    def test_valid_groups_still_work(self):
+        build_mesh(MeshSpec(data=4, fsdp=2))
+        x = jnp.arange(8.0)
+        out = dist.all_reduce_host(x, group=("data", "fsdp"))
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+    def test_custom_mesh_axis_bound_in_shard_map_is_accepted(self):
+        """A user's own mesh with axes outside MESH_AXES must keep
+        working: inside the shard_map the axis is bound, so the facade
+        validation defers to the trace context."""
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("rows",))
+        f = shard_map(lambda t: dist.all_reduce(t, group="rows"),
+                      mesh, (P("rows"),), P("rows"))
+        out = jax.jit(f)(jnp.arange(8.0))
+        np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
