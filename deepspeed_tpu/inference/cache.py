@@ -1,0 +1,147 @@
+"""KV-cache tree plumbing for ragged decode and slot-based serving.
+
+The flax "cache" collection produced by ``init_cache`` is a nested dict
+whose attention units hold three leaves (models/layers.py SelfAttention):
+
+- ``cached_key`` / ``cached_value``: ``[b, h, d, max_len]`` in K^T layout,
+  or ``[L, b, h, d, max_len]`` when the blocks are nn.scan-stacked;
+- ``cache_index``: the write position — scalar (``()`` / ``[L]``) on the
+  classic equal-length path, or per-row (``[b]`` / ``[L, b]``) on the
+  ragged/serving path.
+
+These helpers walk the tree by attention unit (any dict holding a
+``cached_key``) so they stay correct for scanned, unrolled, and MoE
+models without hard-coding the module hierarchy. All of them are pure
+jnp functions, safe inside jit.
+"""
+
+import jax
+import jax.numpy as jnp
+
+_KV_KEYS = ("cached_key", "cached_value")
+
+
+def _as_dict(tree):
+    """Unfreeze flax FrozenDicts into plain nested dicts (identity on
+    dicts) so the walkers below can rebuild the tree structurally."""
+    try:
+        from flax.core import unfreeze
+        return unfreeze(tree)
+    except ImportError:
+        return tree
+
+
+def _is_attn_unit(d) -> bool:
+    return isinstance(d, dict) and "cached_key" in d
+
+
+def _map_units(cache, fn):
+    """Rebuild ``cache`` with ``fn(unit_dict) -> unit_dict`` applied to
+    every attention unit."""
+    cache = _as_dict(cache)
+
+    def walk(node):
+        if _is_attn_unit(node):
+            return fn(dict(node))
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(cache)
+
+
+def cache_max_len(cache) -> int:
+    """The allocated sequence capacity (static python int)."""
+    found = []
+
+    def probe(unit):
+        found.append(int(unit["cached_key"].shape[-1]))
+        return unit
+
+    _map_units(cache, probe)
+    if not found:
+        raise ValueError("no attention cache units found in the cache tree")
+    return found[0]
+
+
+def cache_num_rows(cache) -> int:
+    """The batch (slot) dimension of the cache (static python int)."""
+    found = []
+
+    def probe(unit):
+        kv = unit["cached_key"]
+        found.append(int(kv.shape[kv.ndim - 4]))
+        return unit
+
+    _map_units(cache, probe)
+    if not found:
+        raise ValueError("no attention cache units found in the cache tree")
+    return found[0]
+
+
+def set_cache_index(cache, lengths):
+    """Overwrite every ``cache_index`` with per-row ``lengths`` ([b] int32).
+
+    Scan-stacked units get ``[L, b]`` (every layer shares the same row
+    lengths); unstacked units get ``[b]``. The K/V leaves are untouched.
+    """
+    lengths = jnp.asarray(lengths, jnp.int32)
+
+    def setter(unit):
+        stacked = unit["cached_key"].ndim == 5
+        if stacked:
+            n_layers = unit["cached_key"].shape[0]
+            unit["cache_index"] = jnp.broadcast_to(
+                lengths, (n_layers,) + lengths.shape)
+        else:
+            unit["cache_index"] = lengths
+        return unit
+
+    return _map_units(cache, setter)
+
+
+def make_row_cache(cache):
+    """A zeroed single-row cache with the same structure/capacity as
+    ``cache`` (batch axis 1, scalar-mode ``cache_index``) — the prefill
+    scratch a request runs through before its row is scattered into the
+    slot pool."""
+
+    def shrink(unit):
+        out = {}
+        for name in _KV_KEYS:
+            kv = unit[name]
+            ax = kv.ndim - 4
+            shape = kv.shape[:ax] + (1,) + kv.shape[ax + 1:]
+            out[name] = jnp.zeros(shape, kv.dtype)
+        stacked = unit["cached_key"].ndim == 5
+        idx_shape = (unit["cached_key"].shape[0],) if stacked else ()
+        out["cache_index"] = jnp.zeros(idx_shape, jnp.int32)
+        return out
+
+    return _map_units(cache, shrink)
+
+
+def write_cache_row(cache, row_cache, row):
+    """Scatter ``row_cache`` (batch 1, from ``make_row_cache`` + prefill)
+    into batch row ``row`` of ``cache``. Only K/V leaves are written —
+    ``cache_index`` is scheduler state, managed via ``set_cache_index``.
+    ``row`` may be a traced scalar."""
+    cache = _as_dict(cache)
+    row_cache = _as_dict(row_cache)
+
+    def walk(dst, src):
+        if _is_attn_unit(dst):
+            out = dict(dst)
+            for name in _KV_KEYS:
+                leaf = dst[name]
+                ax = leaf.ndim - 4
+                starts = [0] * leaf.ndim
+                starts[ax] = row
+                out[name] = jax.lax.dynamic_update_slice(
+                    leaf, src[name], tuple(starts))
+            return out
+        if isinstance(dst, dict):
+            return {k: walk(v, src[k]) for k, v in dst.items()}
+        return dst
+
+    return walk(cache, row_cache)

@@ -198,19 +198,52 @@ class InferenceEngine:
         prompt lengths reuse one compiled decode loop."""
         from .generation import generate as _generate
         import numpy as np
-        prompt_len = np.shape(input_ids)[-1]
-        needed = prompt_len + max_new_tokens
-        cache_len = max(self.max_tokens, needed)
+        width = np.shape(input_ids)[-1]
+        prompt_lengths = kwargs.get("prompt_lengths")
+        pad_only_ragged = (prompt_lengths is None
+                           and kwargs.get("pad_token_id") is not None)
+        if prompt_lengths is not None:
+            # ragged batch: the request size is the LONGEST TRUE prompt,
+            # not the padded width (width alone would falsely reject
+            # legal batches whose padding pushes width+max_new over the
+            # model limit)
+            prompt_len = int(np.max(np.asarray(prompt_lengths)))
+        else:
+            prompt_len = width
         model_max = getattr(getattr(self.module, "config", None),
                             "max_seq_len", None)
-        if model_max is not None and needed <= model_max:
-            # clamp the preallocated cache to the model limit — but when the
-            # request itself exceeds the limit, pass it through so
-            # generation's informative max_seq_len error fires
-            cache_len = min(cache_len, model_max)
-        kwargs.setdefault("max_len", cache_len)
+        # pad-only ragged mode: true lengths are unknown until generation
+        # normalizes the padding — its own per-row checks are
+        # authoritative, and it sizes the cache itself
+        if not pad_only_ragged:
+            needed = prompt_len + max_new_tokens
+            cache_len = max(self.max_tokens, needed)
+            if model_max is not None:
+                if needed > model_max:
+                    # refuse up front with the request arithmetic spelled
+                    # out — clamping the cache here would silently
+                    # truncate the generation instead
+                    raise ValueError(
+                        f"prompt_len ({prompt_len}) + max_new_tokens "
+                        f"({max_new_tokens}) = {needed} exceeds the "
+                        f"model's max_seq_len {model_max}; shorten the "
+                        "prompt or reduce max_new_tokens")
+                # clamp the preallocated cache to the model limit (the
+                # request itself fits — only the engine's max_tokens
+                # headroom shrinks)
+                cache_len = min(cache_len, model_max)
+            kwargs.setdefault("max_len", cache_len)
         kwargs.setdefault("param_transform", self._param_transform)
         from ..models.layers import activation_quantization_suspended
         with activation_quantization_suspended():
             return _generate(self.module, self.params, input_ids,
                              max_new_tokens=max_new_tokens, **kwargs)
+
+    def serve(self, config=None, **kwargs):
+        """Continuous-batching serving over this engine's module/params
+        (slot-based KV cache, request queue — see docs/serving.md).
+        ``config`` is a ``serving.ServingConfig`` or dict; extra kwargs
+        override individual knobs."""
+        from ..serving.engine import ServingEngine
+        return ServingEngine(self.module, self.params, config,
+                             param_transform=self._param_transform, **kwargs)

@@ -137,6 +137,36 @@ _decode_jit_donating = jax.jit(_decode_loop_impl,
                                donate_argnums=(2,))
 
 
+def _ragged_decode_loop_impl(module, params, cache, last_token, start_pos,
+                             num_steps, t, k, p, rng, param_transform,
+                             greedy, has_k, has_p):
+    """Ragged twin of ``_decode_loop_impl``: ``start_pos`` is a PER-ROW
+    [b] vector — each row appends at its own length (per-row cache_index,
+    models/layers.py) and takes its own rotary/learned position. Kept as
+    a separate jit so the shared-scalar hot path compiles unchanged."""
+    from .cache import set_cache_index
+    cache = set_cache_index(cache, start_pos)
+
+    def step(carry, i):
+        cache, token, pos = carry
+        p_ = param_transform(params) if param_transform is not None else params
+        logits, vars_out = module.apply(
+            {"params": p_, "cache": cache}, token[:, None], decode=True,
+            positions=pos[:, None], mutable=["cache"])
+        nxt = _sample_impl(logits[:, -1, :], jax.random.fold_in(rng, i),
+                           t, k, p, greedy, has_k, has_p)
+        return (vars_out["cache"], nxt, pos + 1), nxt
+
+    (cache, _, _), tokens = jax.lax.scan(
+        step, (cache, last_token, start_pos), jnp.arange(num_steps))
+    return jnp.transpose(tokens), cache
+
+
+_ragged_decode_jit_donating = jax.jit(
+    _ragged_decode_loop_impl, static_argnums=(0, 5, 10, 11, 12, 13),
+    donate_argnums=(2,))
+
+
 def _decode_loop(module, params, cache, last_token, start_pos,
                  num_steps: int, temperature: float, top_k, top_p, rng,
                  param_transform=None, donate_cache: bool = False):
@@ -146,22 +176,88 @@ def _decode_loop(module, params, cache, last_token, start_pos,
               t, k, p, rng, param_transform, greedy, has_k, has_p)
 
 
+def _normalize_ragged_prompts(ids_np, prompt_lengths, pad_token_id):
+    """Host-side padding normalization for the ragged path: returns
+    (right-padded [b, Lmax] int array, lengths [b]). Accepts left- or
+    right-padded rows when ``pad_token_id`` is given (padding must be one
+    contiguous run at an end — the HF batch-encode convention); explicit
+    ``prompt_lengths`` rows are taken as right-aligned at 0.
+
+    Inference trims the pad RUN at one end (trailing run first), so
+    pad-valued tokens *inside* or *leading* a prompt — e.g. BOS == pad —
+    survive. The one irreducible ambiguity is a prompt that itself ENDS
+    with the pad token: indistinguishable from padding, so pass
+    ``prompt_lengths`` explicitly for those."""
+    import numpy as np
+    b, lmax = ids_np.shape
+    if prompt_lengths is None:
+        lengths = np.empty(b, np.int32)
+        out = np.empty_like(ids_np)
+        for i in range(b):
+            row = ids_np[i]
+            if row[-1] == pad_token_id:
+                # right-padded: trim the trailing pad run (all-pad rows
+                # degenerate to a single pad-token prompt)
+                n = lmax
+                while n > 1 and row[n - 1] == pad_token_id:
+                    n -= 1
+                seg = row[:n]
+            else:
+                # left-padded or unpadded: trim the leading pad run
+                start = 0
+                while start < lmax - 1 and row[start] == pad_token_id:
+                    start += 1
+                n = lmax - start
+                seg = row[start:]
+            lengths[i] = n
+            out[i, :n] = seg
+            out[i, n:] = pad_token_id
+        return out, lengths
+    lengths = np.asarray(prompt_lengths, np.int32)
+    if lengths.shape != (b,):
+        raise ValueError(f"prompt_lengths must be [batch]={b}, "
+                         f"got shape {lengths.shape}")
+    if (lengths < 1).any() or (lengths > lmax).any():
+        raise ValueError("prompt_lengths must lie in [1, prompt width "
+                         f"{lmax}], got {lengths.tolist()}")
+    return ids_np, lengths
+
+
 def generate(module, params, input_ids, *, max_new_tokens: int = 32,
              temperature: float = 0.0, top_k: Optional[int] = None,
              top_p: Optional[float] = None, rng: Optional[jax.Array] = None,
              eos_token_id: Optional[int] = None, max_len: Optional[int] = None,
-             param_transform=None):
-    """Generate continuations for a batch of equal-length prompts.
+             param_transform=None, prompt_lengths=None,
+             pad_token_id: Optional[int] = None):
+    """Generate continuations for a batch of prompts.
 
-    Returns [batch, prompt_len + max_new_tokens] token ids. ``eos_token_id``
-    tokens past the first EOS are replaced by EOS (the loop itself runs the
-    full static length — XLA-friendly; the reference's python `while` loop
-    would retrace per length).
+    Equal-length batches return [batch, prompt_len + max_new_tokens] token
+    ids. ``eos_token_id`` tokens past the first EOS are replaced by EOS
+    (the loop itself runs the full static length — XLA-friendly; the
+    reference's python `while` loop would retrace per length).
+
+    Ragged batches — pass ``prompt_lengths`` ([batch] true lengths of
+    right-padded rows) and/or ``pad_token_id`` (lengths inferred; left- or
+    right-padded rows accepted) — decode every row from its OWN length in
+    one compiled program (per-row cache_index + positions; no host-side
+    re-batching by length). Returns [batch, width + max_new_tokens] with
+    each row ``prompt ++ generated ++ padding``.
     """
     input_ids = jnp.asarray(input_ids)
     if input_ids.ndim == 1:
         input_ids = input_ids[None]
     b, prompt_len = input_ids.shape
+
+    if prompt_lengths is not None or pad_token_id is not None:
+        import numpy as np
+        ids_np, lengths = _normalize_ragged_prompts(
+            np.asarray(input_ids), prompt_lengths, pad_token_id)
+        return _generate_ragged(
+            module, params, jnp.asarray(ids_np), lengths,
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, rng=rng, eos_token_id=eos_token_id,
+            max_len=max_len, param_transform=param_transform,
+            pad_token_id=pad_token_id)
     total = max_len or (prompt_len + max_new_tokens)
     if total < prompt_len + max_new_tokens:
         raise ValueError("max_len too small for prompt + max_new_tokens")
@@ -196,9 +292,82 @@ def generate(module, params, input_ids, *, max_new_tokens: int = 32,
         out = jnp.concatenate([input_ids, first[:, None]], axis=1)
 
     if eos_token_id is not None:
-        gen = out[:, prompt_len:]
-        seen = jnp.cumsum(jnp.asarray(gen == eos_token_id, jnp.int32),
-                          axis=1) - jnp.asarray(gen == eos_token_id, jnp.int32)
-        gen = jnp.where(seen > 0, eos_token_id, gen)
-        out = jnp.concatenate([out[:, :prompt_len], gen], axis=1)
+        out = jnp.concatenate(
+            [out[:, :prompt_len], _eos_fill(out[:, prompt_len:],
+                                            eos_token_id)], axis=1)
+    return out
+
+
+def _eos_fill(gen, eos_token_id):
+    """Replace everything after the first EOS with EOS ([b, n] -> [b, n])."""
+    hit = jnp.asarray(gen == eos_token_id, jnp.int32)
+    seen = jnp.cumsum(hit, axis=1) - hit
+    return jnp.where(seen > 0, eos_token_id, gen)
+
+
+def _generate_ragged(module, params, input_ids, lengths, *, max_new_tokens,
+                     temperature, top_k, top_p, rng, eos_token_id, max_len,
+                     param_transform, pad_token_id):
+    """Unequal-length batch generation over one compiled program.
+
+    ``input_ids`` [b, width] right-padded, ``lengths`` [b] host ints.
+    Prefill runs once over the padded batch (pad rows are causally ahead
+    of every valid token, so they cannot leak into valid logits); each
+    row's first token is sampled from ITS last prompt position, then the
+    per-row decode loop appends from each row's own length.
+    """
+    import numpy as np
+    b, width = input_ids.shape
+    total = max_len or (int(lengths.max()) + max_new_tokens)
+    if total < int(lengths.max()) + max_new_tokens:
+        raise ValueError("max_len too small for longest prompt + "
+                         "max_new_tokens")
+    model_max = getattr(getattr(module, "config", None), "max_seq_len", None)
+    if model_max is not None and max(total, width) > model_max:
+        raise ValueError(
+            f"longest prompt + max_new_tokens = {total} (prompt width "
+            f"{width}) exceeds the model's max_seq_len {model_max}")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    lens = jnp.asarray(lengths, jnp.int32)
+
+    # the cache must hold the full PADDED width too — prefill writes the
+    # whole padded batch even though only [0, len_i) per row stays valid
+    cache_len = (max(total, width) + 127) // 128 * 128
+    cache = init_cache(module, params, b, cache_len)
+    logits, cache = _prefill_donating(module, params, cache, input_ids,
+                                      jnp.arange(width), param_transform)
+    last_logits = jnp.take_along_axis(
+        logits, (lens - 1)[:, None, None], axis=1)[:, 0]        # [b, vocab]
+    first = _sample(last_logits, rng, temperature, top_k, top_p)
+
+    if max_new_tokens > 1:
+        greedy, has_k, has_p, t, k, p = _sampling_mode(temperature, top_k,
+                                                       top_p)
+        rest, cache = _ragged_decode_jit_donating(
+            module, params, cache, first, lens, max_new_tokens - 1,
+            t, k, p, jax.random.fold_in(rng, 2**31), param_transform,
+            greedy, has_k, has_p)
+        gen = jnp.concatenate([first[:, None], rest], axis=1)
+    else:
+        gen = first[:, None]
+
+    if eos_token_id is not None:
+        gen = _eos_fill(gen, eos_token_id)
+
+    fill = (pad_token_id if pad_token_id is not None
+            else (eos_token_id if eos_token_id is not None else 0))
+    out = jnp.concatenate(
+        [input_ids, jnp.full((b, max_new_tokens), fill, input_ids.dtype)],
+        axis=1)
+    # place each row's generated run at ITS prompt length
+    out = jax.vmap(
+        lambda row, g, l: jax.lax.dynamic_update_slice(row, g, (l,)))(
+        out, gen.astype(out.dtype), lens)
+    # normalize the whole tail to ONE value: past [0, len+max_new) a row
+    # otherwise holds leftover input padding followed by the fill —
+    # mixed junk that a first-EOS-past-the-prompt scan would decode as
+    # content. After this, every row is exactly prompt ++ gen ++ fill*.
+    cols = jnp.arange(width + max_new_tokens)[None, :]
+    out = jnp.where(cols >= (lens + max_new_tokens)[:, None],
+                    jnp.asarray(fill, out.dtype), out)
     return out

@@ -322,10 +322,35 @@ class SelfAttention(nn.Module):
             else:
                 max_len = cached_key.value.shape[3]
                 idx = cache_index.value
-                k_all = jax.lax.dynamic_update_slice(cached_key.value, kc,
-                                                     (0, 0, 0, idx))
-                v_all = jax.lax.dynamic_update_slice(cached_value.value, vc,
-                                                     (0, 0, 0, idx))
+                if idx.ndim == 1:
+                    # Per-row cache index ([b] vector — the serving slot
+                    # batch / ragged-prompt decode): every row appends its
+                    # token at its OWN length. Only the single-token
+                    # kernel hot path supports ragged rows — prefill and
+                    # masked chunks stay on the shared-scalar path.
+                    if s != 1:
+                        raise NotImplementedError(
+                            "per-row cache_index requires single-token "
+                            f"decode (got chunk length {s}); prefill each "
+                            "row with a scalar index, then set the per-row "
+                            "lengths")
+                    if mask is not None or self.sparsity_config is not None \
+                            or (self.dropout_rate > 0.0 and not deterministic):
+                        raise NotImplementedError(
+                            "per-row cache_index decode does not support "
+                            "external masks, block-sparse patterns, or live "
+                            "attention dropout (the dense cache path is "
+                            "shared-scalar only)")
+                    row_update = jax.vmap(
+                        lambda c, u, i: jax.lax.dynamic_update_slice(
+                            c, u, (0, 0, i)))
+                    k_all = row_update(cached_key.value, kc, idx)
+                    v_all = row_update(cached_value.value, vc, idx)
+                else:
+                    k_all = jax.lax.dynamic_update_slice(cached_key.value, kc,
+                                                         (0, 0, 0, idx))
+                    v_all = jax.lax.dynamic_update_slice(cached_value.value, vc,
+                                                         (0, 0, 0, idx))
                 cached_key.value = k_all
                 cached_value.value = v_all
                 cache_index.value = idx + s

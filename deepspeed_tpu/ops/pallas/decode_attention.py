@@ -112,9 +112,15 @@ def _dma_kernel(len_ref, slopes_ref, q_ref, k_hbm, v_hbm, o_ref,
             v_hbm.at[b, hi, :, :, pl.ds(start, block_k)], vb, sem.at[slot, 1])
         return ck, cv
 
-    ck, cv = copies(0, 0)
-    ck.start()
-    cv.start()
+    # the prologue must not start copies a zero-block row never waits:
+    # leaked semaphore signals would satisfy the NEXT grid step's wait()
+    # while its own DMA is still in flight (real-TPU hazard; interpret
+    # mode doesn't model semaphores)
+    @pl.when(nb > 0)
+    def _first_copies():
+        ck, cv = copies(0, 0)
+        ck.start()
+        cv.start()
 
     def body(j, carry):
         slot = jax.lax.rem(j, 2)
@@ -139,7 +145,13 @@ def _dma_kernel(len_ref, slopes_ref, q_ref, k_hbm, v_hbm, o_ref,
         return carry
 
     jax.lax.fori_loop(0, nb, body, 0)
-    o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+    # length <= 0 rows (empty serving slots) ran zero blocks: l stays 0 and
+    # acc/l would be NaN. Select zeros instead — valid rows always have
+    # l >= 1 (the max-score column contributes exp(0)), so this is a no-op
+    # for them.
+    l = l_ref[...]
+    safe = acc_ref[...] / jnp.where(l > 0.0, l, 1.0)
+    o_ref[0] = jnp.where(l > 0.0, safe, 0.0).astype(o_ref.dtype)
 
 
 def _decode_dma(q_bhd, k, v, lengths, slopes, *, scale, block_k, hb, alibi):
@@ -192,6 +204,9 @@ def _decode_dense(q_bhd, k, v, lengths, slopes, *, scale, alibi):
     logits = jnp.where(col < ln, logits, NEG_INF)
     p = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhk,bhdk->bhd", p, v.astype(jnp.float32))
+    # length <= 0 rows have every column masked; softmax degenerates to
+    # uniform weights over cache garbage. Match the kernel: emit zeros.
+    out = jnp.where(lengths[:, None, None] > 0, out, 0.0)
     return out.astype(q_bhd.dtype)
 
 
@@ -203,7 +218,8 @@ def decode_attention(q, k, v, length, *, softmax_scale=None,
     q: [B, 1, H, d] (or [B, H, d]) — the current token's queries (BSHD).
     k, v: [B, H, d, S] — the preallocated cache in K^T layout.
     length: int32 scalar or [B] — number of valid cache slots per row
-        (the query sits at position length-1).
+        (the query sits at position length-1). Rows with length <= 0
+        (empty serving slots) return zeros.
     alibi_slopes: optional [H] per-head ALiBi slopes (BLOOM).
 
     Returns [B, 1, H, d] (or [B, H, d], matching q's rank).

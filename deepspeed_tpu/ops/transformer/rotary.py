@@ -9,9 +9,9 @@ import jax.numpy as jnp
 
 
 def rotary_embedding(positions, dim, base=10000.0, dtype=jnp.float32):
-    """[seq] positions -> (sin, cos) each [seq, dim/2]."""
+    """[seq] (or [batch, seq]) positions -> (sin, cos) [..., dim/2]."""
     inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
-    freqs = jnp.einsum("s,d->sd", positions.astype(jnp.float32), inv_freq)
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.sin(freqs).astype(dtype), jnp.cos(freqs).astype(dtype)
 
 
@@ -24,7 +24,9 @@ def apply_rotary_pos_emb(q, k, rotary_dim=None, positions=None, base=10000.0):
     """q,k: [batch, seq, heads, head_dim]; rotates the first rotary_dim dims.
 
     GPT-NeoX style (half-split rotation), matching the reference kernel's
-    neox path (apply_rotary_pos_emb.cu rotate_half).
+    neox path (apply_rotary_pos_emb.cu rotate_half). ``positions`` may be
+    [seq] (shared across the batch) or [batch, seq] (per-row — the ragged
+    decode path, where every slot sits at its own sequence position).
     """
     head_dim = q.shape[-1]
     rotary_dim = rotary_dim or head_dim
@@ -32,8 +34,12 @@ def apply_rotary_pos_emb(q, k, rotary_dim=None, positions=None, base=10000.0):
     if positions is None:
         positions = jnp.arange(seq)
     sin, cos = rotary_embedding(positions, rotary_dim, base=base, dtype=q.dtype)
-    sin = jnp.concatenate([sin, sin], axis=-1)[None, :, None, :]
-    cos = jnp.concatenate([cos, cos], axis=-1)[None, :, None, :]
+    sin = jnp.concatenate([sin, sin], axis=-1)
+    cos = jnp.concatenate([cos, cos], axis=-1)
+    if positions.ndim == 1:
+        sin, cos = sin[None], cos[None]              # [1, s, dim]
+    sin = sin[:, :, None, :]                         # [b?, s, 1, dim]
+    cos = cos[:, :, None, :]
 
     def rot(x):
         x_rot, x_pass = x[..., :rotary_dim], x[..., rotary_dim:]

@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from .config_utils import DeepSpeedConfigError, dict_to_dataclass, dataclass_to_dict
+from ..serving.config import ServingConfig
 from ..utils.logging import logger
 
 
@@ -379,6 +380,9 @@ class DeepSpeedConfig:
     aio: AIOConfig = field(default_factory=AIOConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    # continuous-batching serving engine (serving/engine.py); consumed by
+    # ServingEngine.from_config — absent means "not serving"
+    serving: Optional[ServingConfig] = None
 
     # free-form blocks consumed by their subsystems
     sparse_attention: Optional[Dict[str, Any]] = None
@@ -412,6 +416,7 @@ class DeepSpeedConfig:
         "aio": AIOConfig,
         "mesh": MeshConfig,
         "pipeline": PipelineConfig,
+        "serving": ServingConfig,
     }
 
     @classmethod

@@ -1,0 +1,26 @@
+"""Continuous-batching serving engine (slot-based KV cache).
+
+Lazy exports (PEP 562) so ``serving.config`` stays importable without
+jax — ``runtime/config.py`` pulls ``ServingConfig`` into the top-level
+config schema, and that path must work in dependency-free tooling jobs.
+"""
+
+from .config import ServingConfig
+
+__all__ = ["ServingConfig", "ServingEngine", "Request", "FifoScheduler",
+           "ServingMetrics"]
+
+_LAZY = {
+    "ServingEngine": ".engine",
+    "Request": ".request",
+    "FifoScheduler": ".scheduler",
+    "ServingMetrics": ".metrics",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(_LAZY[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

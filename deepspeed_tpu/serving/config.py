@@ -1,0 +1,80 @@
+"""Serving engine configuration (the ``serving`` config block).
+
+Stdlib-only on purpose: ``runtime/config.py`` imports this dataclass to
+wire the block into ``DeepSpeedConfig``, and that module must stay
+importable without jax (the ds_tpu_lint job runs dependency-free).
+"""
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+@dataclass
+class ServingConfig:
+    """Continuous-batching serving knobs (reference analog: the
+    init_inference kwargs + DeepSpeed-MII deployment config).
+
+    The engine owns ``num_slots`` preallocated KV-cache rows of
+    ``max_len`` tokens each; prompts are padded to a small fixed set of
+    prefill buckets (multiples of ``prefill_bucket``) so XLA compiles one
+    prefill executable per bucket and ONE decode executable total.
+    """
+    num_slots: int = 8
+    max_len: int = 1024              # per-request token budget (prompt+output)
+    prefill_bucket: int = 128        # bucket quantum for prompt padding
+    max_queue: Optional[int] = None  # submit() raises past this depth
+    eos_token_id: Optional[int] = None
+    default_max_new_tokens: int = 128
+    temperature: float = 0.0         # engine-wide sampling (greedy default)
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    pipeline_depth: int = 1          # decode dispatches in flight before the
+                                     # host reads tokens back (1 overlaps the
+                                     # device step with host scheduling)
+    metrics_interval: int = 50       # engine iterations between monitor
+                                     # flushes (never per-step host syncs)
+    seed: int = 0
+
+    def validate(self):
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {self.num_slots}")
+        if self.max_len < 2:
+            raise ValueError(f"max_len must be >= 2, got {self.max_len}")
+        if self.prefill_bucket < 1:
+            raise ValueError(
+                f"prefill_bucket must be >= 1, got {self.prefill_bucket}")
+        if self.default_max_new_tokens < 1:
+            raise ValueError("default_max_new_tokens must be >= 1, got "
+                             f"{self.default_max_new_tokens}")
+        if self.pipeline_depth < 0:
+            raise ValueError(
+                f"pipeline_depth must be >= 0, got {self.pipeline_depth}")
+        if self.metrics_interval < 1:
+            raise ValueError(
+                f"metrics_interval must be >= 1, got {self.metrics_interval}")
+        return self
+
+    @property
+    def cache_len(self) -> int:
+        """Slot capacity rounded up to a 128 multiple so the Pallas decode
+        kernel's tiling always applies (generation.py convention)."""
+        return (self.max_len + 127) // 128 * 128
+
+    def bucket_lengths(self) -> Tuple[int, ...]:
+        """The fixed prefill-length set: multiples of ``prefill_bucket``
+        up to the cache capacity (capacity itself included when
+        unaligned). Prefill jit-specializes at most once per entry."""
+        step = self.prefill_bucket
+        out = list(range(step, self.cache_len + 1, step))
+        if not out or out[-1] != self.cache_len:
+            out.append(self.cache_len)
+        return tuple(out)
+
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest bucket >= prompt_len."""
+        for b in self.bucket_lengths():
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest prefill "
+            f"bucket ({self.bucket_lengths()[-1]})")

@@ -1,0 +1,342 @@
+"""Continuous-batching serving engine with a slot-based KV cache.
+
+Reference frame: DeepSpeed-Inference (arXiv:2207.00032) wins at-scale
+transformer serving at the scheduling/KV-cache layer, not the kernel
+layer; on TPU the extra constraint is that decode SHAPES must never
+change across requests (every new shape is an XLA recompile). The
+engine therefore owns a fixed pool of ``num_slots`` preallocated cache
+rows (``[num_slots, heads, head_dim, cache_len]`` per layer, K^T
+layout) and drives exactly TWO compiled programs:
+
+- ``_admit``: prefill one request (padded to a fixed length bucket)
+  through a single-row scratch cache, scatter the row into its slot,
+  sample its first token — one jit specialization per bucket;
+- ``_decode_iter``: ONE masked single-token decode step over the full
+  slot batch — per-slot lengths (per-row cache_index,
+  models/layers.py), per-slot positions, per-slot eos/budget
+  completion. Compiles once, ever.
+
+Requests queue host-side (scheduler.py) and are admitted into free
+slots BETWEEN decode steps; finished slots recycle immediately. Token
+readback is pipelined: the host reads step k's tokens while the device
+runs step k+1 (``pipeline_depth``), so streaming never serializes
+device and host. Metrics derive from those already-read tokens plus
+host scheduler state — no extra per-step syncs (PR-2 rule).
+"""
+
+from collections import deque
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..inference.generation import (init_cache, _prefill_impl, _sample_impl,
+                                    _sampling_mode)
+from ..inference.cache import (cache_max_len, make_row_cache, set_cache_index,
+                               write_cache_row)
+from ..utils.logging import log_dist
+from .config import ServingConfig
+from .request import Request
+from .scheduler import FifoScheduler
+from .metrics import ServingMetrics
+
+
+def _admit_impl(module, params, cache, state, prompt, prompt_len, slot,
+                max_new, rng, eos_id, t, k, p, param_transform,
+                greedy, has_k, has_p):
+    """Prefill ``prompt`` ([1, bucket_len], right-padded) through a fresh
+    single-row cache, scatter the row into ``slot``, sample the first
+    token, and activate the slot's metadata row. The pad tail's K/V is
+    garbage but sits at positions >= prompt_len, which the per-slot
+    length mask never reads and later decode tokens overwrite in order.
+    """
+    row = make_row_cache(cache)
+    logits, row = _prefill_impl(module, params, row, prompt,
+                                jnp.arange(prompt.shape[1]), param_transform)
+    last = jax.lax.dynamic_slice_in_dim(logits, prompt_len - 1, 1,
+                                        axis=1)[:, 0]            # [1, vocab]
+    tok = _sample_impl(last, rng, t, k, p, greedy, has_k, has_p)[0]
+    cache = write_cache_row(cache, row, slot)
+
+    remaining = max_new - 1
+    # eos_id is -1 when eos is disabled — sampled tokens are always >= 0,
+    # so the comparison stays False without a structure flag
+    done = (tok == eos_id) | (remaining <= 0)
+    state = {
+        "lengths": state["lengths"].at[slot].set(prompt_len),
+        "last_token": state["last_token"].at[slot].set(tok),
+        "active": state["active"].at[slot].set(~done),
+        "remaining": state["remaining"].at[slot].set(remaining),
+    }
+    return cache, state, tok, done
+
+
+_admit_jit = jax.jit(_admit_impl, static_argnums=(0, 13, 14, 15, 16),
+                     donate_argnums=(2, 3))
+
+
+def _decode_iter_impl(module, params, cache, state, rng, it, eos_id,
+                      t, k, p, param_transform, greedy, has_k, has_p):
+    """One masked decode step over the full slot batch.
+
+    Every slot — active or not — runs the same static-shape computation;
+    inactive slots write their garbage token at a clamped position inside
+    their own row (re-prefilled on the next admission) and their output
+    is masked to -1. Active slots append at their own length, attend over
+    their own valid prefix (per-row cache_index -> per-slot length mask
+    in the decode kernel), and complete on eos or an exhausted budget.
+    """
+    lengths = state["lengths"]
+    active = state["active"]
+    s_max = cache_max_len(cache)
+    idx_w = jnp.minimum(lengths, s_max - 1)
+    cache = set_cache_index(cache, idx_w)
+    p_ = param_transform(params) if param_transform is not None else params
+    logits, vars_out = module.apply(
+        {"params": p_, "cache": cache}, state["last_token"][:, None],
+        decode=True, positions=idx_w[:, None], mutable=["cache"])
+    nxt = _sample_impl(logits[:, -1, :], jax.random.fold_in(rng, it),
+                       t, k, p, greedy, has_k, has_p)
+
+    remaining = jnp.where(active, state["remaining"] - 1, state["remaining"])
+    done = active & ((nxt == eos_id) | (remaining <= 0))
+    new_state = {
+        "lengths": jnp.where(active, lengths + 1, lengths),
+        "last_token": jnp.where(active, nxt, state["last_token"]),
+        "active": active & ~done,
+        "remaining": remaining,
+    }
+    out_tok = jnp.where(active, nxt, -1)
+    return vars_out["cache"], new_state, out_tok, done
+
+
+_decode_iter_jit = jax.jit(_decode_iter_impl,
+                           static_argnums=(0, 10, 11, 12, 13),
+                           donate_argnums=(2, 3))
+
+
+class ServingEngine:
+    """Continuous-batching serving over a fixed slot pool.
+
+    Usage::
+
+        eng = ServingEngine(module, params, ServingConfig(num_slots=8,
+                                                          max_len=1024))
+        reqs = [eng.submit(prompt, max_new_tokens=64) for prompt in work]
+        eng.run()                      # or: interleave submit()/advance()
+        reqs[0].output_tokens          # streamed per token via on_token=
+
+    Construct directly, from ``InferenceEngine.serve()``, or from a
+    config dict's ``serving`` block via ``from_config``.
+    """
+
+    def __init__(self, module, params, config: Optional[ServingConfig] = None,
+                 *, param_transform=None, monitor=None, rng=None, **overrides):
+        if config is None:
+            config = ServingConfig(**overrides)
+        elif isinstance(config, dict):
+            config = ServingConfig(**{**config, **overrides})
+        elif overrides:
+            raise ValueError("pass knobs either via config= or as keyword "
+                             "overrides, not both")
+        self.config = config.validate()
+        self.module = module
+        self.params = params
+        self._param_transform = param_transform
+
+        model_max = getattr(getattr(module, "config", None), "max_seq_len",
+                            None)
+        if model_max is not None and self.config.max_len > model_max:
+            raise ValueError(
+                f"serving.max_len={self.config.max_len} exceeds the "
+                f"model's max_seq_len {model_max}")
+
+        n = self.config.num_slots
+        self._cache = init_cache(module, params, n, self.config.cache_len)
+        # normalize cache_index to per-row form ([b]-shaped) up front:
+        # init_cache creates the scalar form, and a tree whose index shape
+        # flips after the first decode would cost every jit a second
+        # specialization (the "decode compiles once" contract)
+        self._cache = set_cache_index(self._cache,
+                                      jnp.zeros((n,), jnp.int32))
+        self._state = {
+            "lengths": jnp.zeros((n,), jnp.int32),
+            "last_token": jnp.zeros((n,), jnp.int32),
+            "active": jnp.zeros((n,), bool),
+            "remaining": jnp.zeros((n,), jnp.int32),
+        }
+        self._rng = rng if rng is not None else jax.random.PRNGKey(
+            self.config.seed)
+        self._mode = _sampling_mode(self.config.temperature,
+                                    self.config.top_k, self.config.top_p)
+        # -1 when eos is disabled: sampled tokens are always >= 0, so the
+        # device-side comparison simply never fires (no structure flag,
+        # no branch, one executable either way)
+        self._eos = jnp.int32(self.config.eos_token_id
+                              if self.config.eos_token_id is not None else -1)
+
+        self.scheduler = FifoScheduler(self.config)
+        self.metrics = ServingMetrics(monitor=monitor,
+                                      interval=self.config.metrics_interval)
+        self._slot_req = [None] * n       # host view of slot -> Request
+        self._free = deque(range(n))
+        self._pending = deque()           # in-flight readbacks, FIFO
+        self._iteration = 0
+        self._seq = 0
+        log_dist(f"serving engine: {n} slots x {self.config.cache_len} "
+                 f"tokens, prefill buckets {self.config.bucket_lengths()}",
+                 ranks=[0])
+
+    # -- client API --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               request_id=None, on_token=None) -> Request:
+        """Queue one request; returns its live ``Request`` handle."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if max_new_tokens is None:
+            max_new_tokens = self.config.default_max_new_tokens
+        self.scheduler.validate_request(prompt.shape[0], max_new_tokens)
+        if request_id is None:
+            request_id = self._seq
+        req = Request(prompt, max_new_tokens, request_id, on_token=on_token)
+        req.submitted_iteration = self._iteration
+        self._seq += 1
+        self.scheduler.add(req)
+        self.metrics.on_submit()
+        return req
+
+    def run(self, max_iterations: Optional[int] = None):
+        """Drive admissions/decode/harvest until every submitted request
+        has finished (or ``max_iterations`` engine iterations elapse)."""
+        it = 0
+        while self.busy:
+            self.advance()
+            it += 1
+            if max_iterations is not None and it >= max_iterations:
+                break
+        self.metrics.flush()
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.scheduler.depth or self._pending
+                    or any(r is not None for r in self._slot_req))
+
+    @property
+    def num_free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def iteration(self) -> int:
+        """Engine decode-iteration counter — the deterministic clock the
+        load harness schedules arrivals against."""
+        return self._iteration
+
+    # -- engine loop -------------------------------------------------------
+    def advance(self):
+        """One engine iteration: admit into free slots, dispatch one
+        decode over the slot batch, harvest readbacks beyond the pipeline
+        depth. Safe to call when idle (no-op)."""
+        self._admit_ready()
+        dispatched = self._dispatch_decode()
+        # keep at most pipeline_depth dispatches in flight; drain fully
+        # when nothing new was dispatched (tail of the workload)
+        target = self.config.pipeline_depth if dispatched else 0
+        while len(self._pending) > target:
+            self._harvest_one()
+        busy = sum(r is not None for r in self._slot_req)
+        self.metrics.sample(self.scheduler.depth, busy,
+                            self.config.num_slots, self._iteration)
+        if self._iteration % self.config.metrics_interval == 0:
+            self.metrics.flush()
+
+    def _admit_ready(self):
+        while self._free:
+            req = self.scheduler.next_request()
+            if req is None:
+                return
+            slot = self._free.popleft()
+            n = req.prompt.shape[0]
+            bucket = self.config.bucket_for(n)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = req.prompt
+            greedy, has_k, has_p, t, k, p = self._mode
+            # stable per-request fold: python hash() is salted per process
+            # and would break sampled-output reproducibility across runs
+            if isinstance(req.request_id, int):
+                fold = req.request_id
+            else:
+                import zlib
+                fold = zlib.crc32(repr(req.request_id).encode())
+            rng = jax.random.fold_in(self._rng, fold % (2**31))
+            self._cache, self._state, tok, done = _admit_jit(
+                self.module, self.params, self._cache, self._state,
+                jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
+                jnp.int32(req.max_new_tokens), rng, self._eos, t, k, p,
+                self._param_transform, greedy, has_k, has_p)
+            self._slot_req[slot] = req
+            req._admitted(slot, self._iteration)
+            self.metrics.on_admit()
+            self._pending.append(("admit", slot, req, tok, done))
+
+    def _dispatch_decode(self) -> bool:
+        if all(r is None for r in self._slot_req):
+            return False
+        greedy, has_k, has_p, t, k, p = self._mode
+        snapshot = list(self._slot_req)
+        self._cache, self._state, toks, done = _decode_iter_jit(
+            self.module, self.params, self._cache, self._state,
+            jax.random.fold_in(self._rng, 2**31),
+            jnp.int32(self._iteration), self._eos, t, k, p,
+            self._param_transform, greedy, has_k, has_p)
+        busy = sum(r is not None for r in snapshot)
+        self.metrics.on_decode_dispatch(busy, self.config.num_slots)
+        self._pending.append(("decode", snapshot, toks, done))
+        self._iteration += 1
+        return True
+
+    def _harvest_one(self):
+        """Read back the oldest in-flight dispatch (blocks only on work
+        dispatched >= pipeline_depth iterations ago) and stream its
+        tokens/completions to their requests."""
+        entry = self._pending.popleft()
+        if entry[0] == "admit":
+            _, slot, req, tok, done = entry
+            req._emit(int(np.asarray(tok)), self._iteration)
+            self.metrics.on_token()
+            if bool(np.asarray(done)):
+                self._finish(slot, req)
+            return
+        _, snapshot, toks, done = entry
+        toks = np.asarray(toks)
+        done = np.asarray(done)
+        for slot, req in enumerate(snapshot):
+            if req is None:
+                continue
+            if toks[slot] >= 0:
+                req._emit(int(toks[slot]), self._iteration)
+                self.metrics.on_token()
+            if done[slot]:
+                self._finish(slot, req)
+
+    def _finish(self, slot: int, req: Request):
+        req._finished(self._iteration)
+        self.metrics.on_finish(req)
+        self._slot_req[slot] = None
+        self._free.append(slot)
+
+    # -- construction helpers ---------------------------------------------
+    @classmethod
+    def from_config(cls, module, params, ds_config, **kwargs):
+        """Build from a DeepSpeedConfig (or raw dict) carrying a
+        ``serving`` block; monitor backends configured in the same dict
+        receive the buffered serving metrics."""
+        from ..runtime.config import DeepSpeedConfig
+        if isinstance(ds_config, dict):
+            ds_config = DeepSpeedConfig.from_dict(ds_config)
+        serving = getattr(ds_config, "serving", None) or ServingConfig()
+        monitor = kwargs.pop("monitor", None)
+        if monitor is None:
+            from ..monitor.monitor import MonitorMaster
+            master = MonitorMaster(ds_config)
+            monitor = master if master.enabled else None
+        return cls(module, params, serving, monitor=monitor, **kwargs)

@@ -1,0 +1,148 @@
+"""Buffered serving metrics.
+
+Follows the PR-2 no-per-step-host-sync rule: every value here is either
+host scheduler state (queue depth, slot assignment) or derived from
+token arrays the engine ALREADY read back for streaming — recording a
+metric never adds a device sync. Events buffer host-side and flush to
+the MonitorMaster fan-out (TensorBoard/W&B/CSV) once per
+``metrics_interval`` engine iterations.
+
+Glossary (docs/serving.md has the full definitions):
+- ttft: submit -> first streamed token (wall seconds; *_steps is the
+  engine-iteration count, deterministic run-to-run)
+- queue_depth: requests waiting for a slot, sampled per iteration
+- slot_occupancy: fraction of slots holding a live request at dispatch
+- throughput: generated tokens / wall seconds since the first submit
+"""
+
+import time
+from collections import deque
+from typing import Optional
+
+# sliding window for the percentile histories: a long-lived server must
+# not grow per-request lists (or sort all-time history per snapshot)
+# forever — p50/p95 over the most recent completions is the serving-
+# dashboard convention anyway
+HISTORY_WINDOW = 4096
+
+
+def _percentile(values, q):
+    """Nearest-rank percentile without numpy (values non-empty)."""
+    v = sorted(values)
+    idx = min(len(v) - 1, max(0, int(round(q / 100.0 * (len(v) - 1)))))
+    return v[idx]
+
+
+class ServingMetrics:
+    def __init__(self, monitor=None, interval: int = 50,
+                 history_window: int = HISTORY_WINDOW):
+        self.monitor = monitor
+        self.interval = max(1, int(interval))
+        self.history_window = max(1, int(history_window))
+        self.reset()
+
+    def reset(self):
+        self.requests_submitted = 0
+        self.requests_admitted = 0
+        self.requests_finished = 0
+        self.tokens_generated = 0
+        self.prefills = 0
+        self.decode_iterations = 0
+        self.wasted_slot_steps = 0     # inactive slots carried through decode
+        self.ttft_s = deque(maxlen=self.history_window)
+        self.ttft_steps = deque(maxlen=self.history_window)
+        self.latency_s = deque(maxlen=self.history_window)
+        self.queue_depth_sum = 0
+        self.queue_depth_max = 0
+        self.occupancy_sum = 0.0
+        self.samples = 0
+        self.started_at: Optional[float] = None
+        self._events = []
+
+    # -- engine hooks ------------------------------------------------------
+    def on_submit(self):
+        if self.started_at is None:
+            self.started_at = time.perf_counter()
+        self.requests_submitted += 1
+
+    def on_admit(self):
+        self.requests_admitted += 1
+        self.prefills += 1
+
+    def on_decode_dispatch(self, busy_slots: int, num_slots: int):
+        self.decode_iterations += 1
+        self.wasted_slot_steps += num_slots - busy_slots
+
+    def on_token(self):
+        self.tokens_generated += 1
+
+    def on_finish(self, request):
+        self.requests_finished += 1
+        if request.ttft_s is not None:
+            self.ttft_s.append(request.ttft_s)
+        if (request.first_token_iteration is not None
+                and request.submitted_iteration is not None):
+            self.ttft_steps.append(request.first_token_iteration
+                                   - request.submitted_iteration)
+        if request.latency_s is not None:
+            self.latency_s.append(request.latency_s)
+
+    def sample(self, queue_depth: int, busy_slots: int, num_slots: int,
+               iteration: int):
+        self.queue_depth_sum += queue_depth
+        self.queue_depth_max = max(self.queue_depth_max, queue_depth)
+        self.occupancy_sum += busy_slots / max(1, num_slots)
+        self.samples += 1
+        if self.monitor is not None and getattr(self.monitor, "enabled",
+                                                False):
+            self._events.extend([
+                ("serving/queue_depth", queue_depth, iteration),
+                ("serving/slot_occupancy",
+                 busy_slots / max(1, num_slots), iteration),
+                ("serving/tokens_generated", self.tokens_generated,
+                 iteration),
+                ("serving/requests_finished", self.requests_finished,
+                 iteration),
+            ])
+            if len(self._events) >= 4 * self.interval:
+                self.flush()
+
+    def flush(self):
+        """Hand buffered events to the monitor fan-out (host floats only —
+        no device reads happen here)."""
+        if self._events and self.monitor is not None:
+            events, self._events = self._events, []
+            self.monitor.write_events(events)
+
+    # -- reporting ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """Aggregate dict (the BENCH_serving payload). Counters are
+        all-time; ttft/latency percentiles cover the most recent
+        ``history_window`` completions."""
+        elapsed = (time.perf_counter() - self.started_at
+                   if self.started_at is not None else 0.0)
+        out = {
+            "requests_submitted": self.requests_submitted,
+            "requests_admitted": self.requests_admitted,
+            "requests_finished": self.requests_finished,
+            "tokens_generated": self.tokens_generated,
+            "prefills": self.prefills,
+            "decode_iterations": self.decode_iterations,
+            "wasted_slot_steps": self.wasted_slot_steps,
+            "elapsed_s": elapsed,
+            "throughput_tokens_per_s": (self.tokens_generated / elapsed
+                                        if elapsed > 0 else 0.0),
+            "queue_depth_mean": (self.queue_depth_sum / self.samples
+                                 if self.samples else 0.0),
+            "queue_depth_max": self.queue_depth_max,
+            "slot_occupancy_mean": (self.occupancy_sum / self.samples
+                                    if self.samples else 0.0),
+        }
+        for name, vals in (("ttft_s", self.ttft_s),
+                           ("ttft_steps", self.ttft_steps),
+                           ("latency_s", self.latency_s)):
+            if vals:
+                out[f"{name}_p50"] = _percentile(vals, 50)
+                out[f"{name}_p95"] = _percentile(vals, 95)
+                out[f"{name}_mean"] = sum(vals) / len(vals)
+        return out

@@ -1,0 +1,85 @@
+"""Request objects — the first request-level abstraction in the codebase.
+
+A ``Request`` is one user generation: a token prompt plus an output
+budget. The engine streams generated tokens into it as they are read
+back from the device (``on_token`` fires per token), and stamps the
+timing fields the metrics layer aggregates (TTFT, end-to-end latency).
+"""
+
+import time
+from typing import Callable, List, Optional
+
+QUEUED = "queued"
+RUNNING = "running"
+FINISHED = "finished"
+
+
+class Request:
+    """One generation request and its streamed result."""
+
+    def __init__(self, prompt, max_new_tokens: int, request_id,
+                 on_token: Optional[Callable] = None):
+        self.request_id = request_id
+        self.prompt = prompt                      # 1-D int32 numpy array
+        self.max_new_tokens = int(max_new_tokens)
+        self.on_token = on_token
+        self.status = QUEUED
+        self.tokens: List[int] = []               # generated tokens, in order
+        self.slot: Optional[int] = None
+        # host wall-clock stamps (time.perf_counter)
+        self.submitted_at = time.perf_counter()
+        self.admitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        # engine-iteration stamps (deterministic run-to-run)
+        self.submitted_iteration: Optional[int] = None
+        self.admitted_iteration: Optional[int] = None
+        self.first_token_iteration: Optional[int] = None
+        self.finished_iteration: Optional[int] = None
+
+    # -- engine-side hooks -------------------------------------------------
+    def _admitted(self, slot: int, iteration: int):
+        self.slot = slot
+        self.status = RUNNING
+        self.admitted_at = time.perf_counter()
+        self.admitted_iteration = iteration
+
+    def _emit(self, token: int, iteration: int):
+        if self.first_token_at is None:
+            self.first_token_at = time.perf_counter()
+            self.first_token_iteration = iteration
+        self.tokens.append(int(token))
+        if self.on_token is not None:
+            self.on_token(self, int(token))
+
+    def _finished(self, iteration: int):
+        self.slot = None
+        self.status = FINISHED
+        self.finished_at = time.perf_counter()
+        self.finished_iteration = iteration
+
+    # -- client-side views -------------------------------------------------
+    @property
+    def done(self) -> bool:
+        return self.status == FINISHED
+
+    @property
+    def output_tokens(self) -> List[int]:
+        return list(self.tokens)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+    def __repr__(self):
+        return (f"Request(id={self.request_id!r}, status={self.status}, "
+                f"prompt_len={len(self.prompt)}, "
+                f"generated={len(self.tokens)}/{self.max_new_tokens})")

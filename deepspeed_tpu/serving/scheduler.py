@@ -1,0 +1,66 @@
+"""Request queue + admission policy.
+
+Reference frame: DeepSpeed-Inference/MII serve requests by re-forming
+whole batches; the continuous-batching scheduler here instead admits
+individual requests into free KV-cache slots BETWEEN decode steps, so
+one straggler never holds the batch (the Orca/vLLM scheduling insight,
+applied with TPU-static shapes: admission changes slot METADATA, never
+the compiled decode shape).
+
+FIFO with head-of-line blocking on slot availability only — every
+queued request already fits a slot (submit() validates the token
+budget), so the head never blocks the tail for shape reasons.
+"""
+
+from collections import deque
+from typing import Optional
+
+from .request import Request
+
+
+class FifoScheduler:
+    """FIFO admission queue over the slot pool."""
+
+    def __init__(self, config):
+        self.config = config
+        self._queue = deque()
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def add(self, request: Request):
+        cap = self.config.max_queue
+        if cap is not None and len(self._queue) >= cap:
+            raise RuntimeError(
+                f"serving queue full ({cap} requests); raise max_queue or "
+                "apply client-side backpressure")
+        self._queue.append(request)
+
+    def next_request(self) -> Optional[Request]:
+        """Pop the next admissible request (None when the queue is empty).
+        All queued requests fit by construction, so this is pure FIFO."""
+        if not self._queue:
+            return None
+        return self._queue.popleft()
+
+    def validate_request(self, prompt_len: int, max_new_tokens: int):
+        """Refuse requests that can never fit a slot — the serving analog
+        of the engine.generate max_seq_len check (clear error at submit
+        time, not a truncated response later)."""
+        if prompt_len < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        budget = self.config.max_len
+        if prompt_len + max_new_tokens > budget:
+            raise ValueError(
+                f"prompt_len ({prompt_len}) + max_new_tokens "
+                f"({max_new_tokens}) = {prompt_len + max_new_tokens} "
+                f"exceeds the per-slot budget max_len={budget}; shorten "
+                "the prompt, reduce max_new_tokens, or raise "
+                "serving.max_len")

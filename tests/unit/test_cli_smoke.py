@@ -26,7 +26,7 @@ def _run(args, timeout=120, env_extra=None):
 
 @pytest.mark.parametrize("script", [
     "ds_tpu", "ds_tpu_bench", "ds_tpu_elastic", "ds_tpu_ssh",
-    "ds_tpu_to_universal", "ds_tpu_lint"])
+    "ds_tpu_to_universal", "ds_tpu_lint", "ds_tpu_serve"])
 def test_help_exits_zero(script):
     r = _run([os.path.join(BIN, script), "--help"])
     assert r.returncode == 0, r.stderr[-300:]
@@ -88,6 +88,39 @@ def test_to_universal_rejects_bad_mesh(tmp_path):
               str(tmp_path / "out"), "--target-mesh", "bogus=2"])
     assert r.returncode != 0
     assert "axis" in r.stderr
+
+
+def test_serve_synthetic_demo(tmp_path):
+    """End-to-end serving CLI: tiny synthetic workload, metrics JSON."""
+    out = tmp_path / "metrics.json"
+    r = _run([os.path.join(BIN, "ds_tpu_serve"), "--synthetic", "3",
+              "--num-slots", "2", "--max-len", "48", "--prefill-bucket",
+              "16", "--max-new-tokens", "3", "--d-model", "32",
+              "--n-layers", "1", "--vocab-size", "64", "--quiet",
+              "--metrics-out", str(out)], timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    snap = json.loads(out.read_text())
+    assert snap["requests_finished"] == 3
+    assert snap["tokens_generated"] >= 3
+
+
+def test_bench_serving_writes_artifact(tmp_path):
+    """`ds_tpu_bench serving` replays the seeded trace and writes the
+    BENCH_serving JSON artifact."""
+    out = tmp_path / "BENCH_serving.json"
+    r = _run([os.path.join(BIN, "ds_tpu_bench"), "serving",
+              "--num-requests", "4", "--num-slots", "2", "--max-len", "48",
+              "--prefill-bucket", "16", "--min-prompt", "3", "--max-prompt",
+              "8", "--min-output", "2", "--max-output", "3", "--d-model",
+              "32", "--n-layers", "1", "--vocab-size", "64",
+              "--out", str(out)], timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "BENCH_serving" in r.stdout
+    art = json.loads(out.read_text())
+    assert art["bench"] == "serving"
+    assert art["aggregate"]["requests_finished"] == 4
+    assert len(art["per_request"]) == 4
+    assert all(p["ttft_steps"] is not None for p in art["per_request"])
 
 
 def test_launcher_single_host_exec(tmp_path):

@@ -257,6 +257,213 @@ class TestCheckpointServing:
         with pytest.raises(ValueError, match="max_seq_len"):
             generate(m, params, ids, max_new_tokens=8)
 
+    def test_engine_generate_rejects_oversized_request_with_arithmetic(self):
+        """InferenceEngine.generate must refuse prompt+max_new past the
+        model limit UP FRONT, spelling out the request arithmetic — not
+        clamp the cache and truncate the generation."""
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        from deepspeed_tpu.models import GPT, GPTConfig
+        cfg = GPTConfig(vocab_size=32, max_seq_len=16, d_model=16, n_layers=1,
+                        n_heads=2, dtype=jnp.float32)
+        m = GPT(cfg)
+        ids = jnp.ones((1, 12), jnp.int32)
+        params = m.init(jax.random.PRNGKey(0), ids)["params"]
+        eng = InferenceEngine(m, params=params, dtype=jnp.float32,
+                              max_tokens=64)
+        with pytest.raises(ValueError) as ei:
+            eng.generate(ids, max_new_tokens=8)
+        msg = str(ei.value)
+        # the request arithmetic AND the limit are both in the message
+        assert "12" in msg and "8" in msg and "20" in msg and "16" in msg
+        assert "max_seq_len" in msg
+        # the legal edge still serves (cache clamped to the model limit)
+        out = eng.generate(ids, max_new_tokens=4)
+        assert out.shape == (1, 16)
+
+
+class TestRaggedGeneration:
+    """Unequal-length batch generation (per-row prompt lengths — the
+    serving enabler, gained by generate() for free): parity against the
+    equal-length path and against per-request references."""
+
+    ARCHS = {
+        "gpt2": dict(),
+        "gptj": dict(rotary=True, learned_pos=False, parallel_residual=True,
+                     shared_parallel_ln=True, attn_use_bias=False,
+                     rotary_dim=8),
+        "bloom": dict(alibi=True, learned_pos=False, embed_ln=True),
+    }
+
+    @staticmethod
+    def _setup(arch):
+        cfg = GPTConfig(vocab_size=97, max_seq_len=64, d_model=32,
+                        n_layers=2, n_heads=2, dtype=jnp.float32,
+                        **TestRaggedGeneration.ARCHS[arch])
+        m = GPT(cfg)
+        rng = jax.random.PRNGKey(0)
+        ids = np.asarray(jax.random.randint(rng, (3, 12), 1, 97))
+        params = m.init(rng, jnp.asarray(ids))["params"]
+        return m, params, ids
+
+    def test_equal_lengths_match_classic_path_exactly(self):
+        m, params, ids = self._setup("gpt2")
+        classic = np.asarray(generate(m, params, ids, max_new_tokens=5,
+                                      temperature=0.0))
+        ragged = np.asarray(generate(
+            m, params, ids, max_new_tokens=5, temperature=0.0,
+            prompt_lengths=np.full(3, 12, np.int32)))
+        np.testing.assert_array_equal(classic, ragged)
+
+    @pytest.mark.parametrize("arch", sorted(ARCHS))
+    def test_mixed_lengths_match_per_row_references(self, arch):
+        m, params, ids = self._setup(arch)
+        lens = np.asarray([12, 7, 4], np.int32)
+        padded = ids.copy()
+        for i, n in enumerate(lens):
+            padded[i, n:] = 0
+        out = np.asarray(generate(m, params, padded, max_new_tokens=5,
+                                  temperature=0.0, prompt_lengths=lens,
+                                  max_len=17))
+        for i, n in enumerate(lens):
+            ref = np.asarray(generate(m, params, ids[i:i + 1, :n],
+                                      max_new_tokens=5, temperature=0.0,
+                                      max_len=17))
+            np.testing.assert_array_equal(out[i, :n + 5], ref[0],
+                                          err_msg=f"{arch} row {i}")
+
+    def test_left_padded_input_via_pad_token(self):
+        """HF-convention left-padded batches: lengths inferred from
+        pad_token_id and rows normalized — same result as right-padded
+        with explicit lengths."""
+        m, params, ids = self._setup("gpt2")
+        lens = np.asarray([12, 7, 4], np.int32)
+        PAD = 0
+        right = ids.copy()
+        left = ids.copy()
+        for i, n in enumerate(lens):
+            right[i, n:] = PAD
+            left[i] = np.concatenate([np.full(12 - n, PAD), ids[i, :n]])
+        a = np.asarray(generate(m, params, right, max_new_tokens=4,
+                                temperature=0.0, prompt_lengths=lens,
+                                pad_token_id=PAD))
+        b = np.asarray(generate(m, params, left, max_new_tokens=4,
+                                temperature=0.0, pad_token_id=PAD))
+        np.testing.assert_array_equal(a, b)
+
+    def test_ragged_eos_fill_and_output_layout(self):
+        m, params, ids = self._setup("gpt2")
+        lens = np.asarray([12, 5, 8], np.int32)
+        padded = ids.copy()
+        for i, n in enumerate(lens):
+            padded[i, n:] = 0
+        out = np.asarray(generate(m, params, padded, max_new_tokens=6,
+                                  temperature=0.0, prompt_lengths=lens,
+                                  eos_token_id=3, pad_token_id=0))
+        assert out.shape == (3, 18)
+        for i, n in enumerate(lens):
+            # prompt preserved in place, tail padded with pad_token_id
+            np.testing.assert_array_equal(out[i, :n], ids[i, :n])
+            np.testing.assert_array_equal(out[i, n + 6:], 0)
+            gen = out[i, n:n + 6]
+            hits = np.where(gen == 3)[0]
+            if hits.size:   # all tokens after the first EOS are EOS
+                assert (gen[hits[0]:] == 3).all()
+        # without pad_token_id the tail is UNIFORMLY eos — never leftover
+        # input padding followed by eos (a first-EOS scan past the prompt
+        # must yield exactly the generated run)
+        out2 = np.asarray(generate(m, params, padded, max_new_tokens=6,
+                                   temperature=0.0, prompt_lengths=lens,
+                                   eos_token_id=3))
+        for i, n in enumerate(lens):
+            np.testing.assert_array_equal(out2[i, n + 6:], 3)
+            np.testing.assert_array_equal(out2[i, :n], ids[i, :n])
+
+    def test_pad_valued_tokens_inside_prompt_survive_inference(self):
+        """A right-padded prompt that STARTS with (or contains) the pad
+        token — BOS == pad in several HF tokenizers — must keep its real
+        tokens: the pad run is trimmed from the end it actually occupies,
+        never counted."""
+        from deepspeed_tpu.inference.generation import \
+            _normalize_ragged_prompts
+        PAD = 0
+        rows = np.asarray([[0, 5, 7, 0, 0, 0],    # right-padded, BOS==pad
+                           [0, 0, 9, 5, 0, 8],    # left-padded, interior pad
+                           [4, 5, 6, 7, 8, 9]],   # unpadded
+                          np.int32)
+        out, lens = _normalize_ragged_prompts(rows, None, PAD)
+        assert lens.tolist() == [3, 4, 6]
+        np.testing.assert_array_equal(out[0], [0, 5, 7, 0, 0, 0])
+        np.testing.assert_array_equal(out[1], [9, 5, 0, 8, 0, 0])
+        np.testing.assert_array_equal(out[2], rows[2])
+        # and end-to-end: generation from the normalized batch matches the
+        # explicit-lengths path
+        m, params, _ = self._setup("gpt2")
+        a = np.asarray(generate(m, params, rows, max_new_tokens=3,
+                                temperature=0.0, pad_token_id=PAD))
+        b = np.asarray(generate(m, params, out, max_new_tokens=3,
+                                temperature=0.0,
+                                prompt_lengths=lens, pad_token_id=PAD))
+        np.testing.assert_array_equal(a, b)
+
+    def test_engine_generate_ragged_checks_true_lengths_not_width(self):
+        """engine.generate(..., prompt_lengths=) must size the request by
+        the longest TRUE prompt: a padded width that pushes width+max_new
+        past max_seq_len is not a reason to reject a legal ragged batch."""
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        cfg = GPTConfig(vocab_size=32, max_seq_len=16, d_model=16,
+                        n_layers=1, n_heads=2, dtype=jnp.float32)
+        m = GPT(cfg)
+        ids = np.zeros((2, 12), np.int32)
+        ids[0, :4] = [3, 4, 5, 6]
+        ids[1, :5] = [7, 8, 9, 10, 11]
+        params = m.init(jax.random.PRNGKey(0), jnp.asarray(ids))["params"]
+        eng = InferenceEngine(m, params=params, dtype=jnp.float32)
+        lens = np.asarray([4, 5], np.int32)
+        # width 12 + max_new 8 = 20 > 16, but true need is 13 <= 16
+        out = eng.generate(ids, max_new_tokens=8, prompt_lengths=lens)
+        ref = generate(m, params, ids, max_new_tokens=8, temperature=0.0,
+                       prompt_lengths=lens)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        # pad-only mode defers entirely to generation's own checks
+        out2 = eng.generate(np.where(ids == 0, 0, ids), max_new_tokens=8,
+                            pad_token_id=0)
+        assert np.shape(out2) == (2, 20)
+        # a genuinely oversized ragged request still refuses up front
+        with pytest.raises(ValueError, match="max_seq_len"):
+            eng.generate(ids, max_new_tokens=14, prompt_lengths=lens)
+
+    def test_ragged_padded_width_wider_than_needed_cache(self):
+        """The cache must hold the full PADDED width: short true lengths
+        inside a >128-wide padded batch must not shrink the cache below
+        the prefill chunk."""
+        cfg = GPTConfig(vocab_size=32, max_seq_len=256, d_model=16,
+                        n_layers=1, n_heads=2, dtype=jnp.float32)
+        m = GPT(cfg)
+        ids = np.zeros((2, 140), np.int32)
+        ids[0, :3] = [3, 4, 5]
+        ids[1, :4] = [7, 8, 9, 10]
+        params = m.init(jax.random.PRNGKey(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+        lens = np.asarray([3, 4], np.int32)
+        out = np.asarray(generate(m, params, ids, max_new_tokens=4,
+                                  temperature=0.0, prompt_lengths=lens))
+        for i, n in enumerate(lens):
+            ref = np.asarray(generate(m, params, ids[i:i + 1, :n],
+                                      max_new_tokens=4, temperature=0.0,
+                                      max_len=144))
+            np.testing.assert_array_equal(out[i, :n + 4], ref[0])
+
+    def test_ragged_validation(self):
+        m, params, ids = self._setup("gpt2")
+        with pytest.raises(ValueError, match="prompt_lengths"):
+            generate(m, params, ids, prompt_lengths=np.asarray([5, 5]))
+        with pytest.raises(ValueError, match=r"\[1, prompt width"):
+            generate(m, params, ids,
+                     prompt_lengths=np.asarray([13, 5, 5]))
+        with pytest.raises(ValueError, match="max_seq_len"):
+            generate(m, params, ids, max_new_tokens=60,
+                     prompt_lengths=np.asarray([12, 7, 4]))
+
 
 class TestInt8Serving:
     """Weight-only int8 serving path (VERDICT missing #3; reference:

@@ -208,6 +208,52 @@ class TestDecodeAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
 
+    @staticmethod
+    def _numpy_ref(q, kt, vt, lengths):
+        """Independent numpy attention over the valid prefix of each row
+        (not a jnp re-derivation — the serving satellite's external
+        reference). Rows with length <= 0 are defined as zeros."""
+        qn = np.asarray(q, np.float64)
+        kn = np.asarray(kt, np.float64)
+        vn = np.asarray(vt, np.float64)
+        b, _, h, d = qn.shape
+        out = np.zeros_like(qn)
+        for i, ln in enumerate(np.asarray(lengths)):
+            if ln <= 0:
+                continue
+            for j in range(h):
+                s = (qn[i, 0, j] @ kn[i, j][:, :ln]) / np.sqrt(d)
+                s = np.exp(s - s.max())
+                w = s / s.sum()
+                out[i, 0, j] = w @ vn[i, j][:, :ln].T
+        return out
+
+    @pytest.mark.parametrize("s", [256,    # 128-aligned -> DMA kernel
+                                   130])   # ragged -> dense fallback
+    def test_per_slot_ragged_lengths_vs_numpy(self, s):
+        """Serving slot batches mix lengths {0, 1, 127, 128, 129} (empty
+        slot, single token, both sides of the 128 tile edge): each row
+        must match a pure-numpy reference over ITS prefix, the length-0
+        row must come back exactly zero, and no row may bleed into its
+        neighbors."""
+        from deepspeed_tpu.ops.pallas import decode_attention
+        b, h, d = 5, 2, 32
+        q = rand(20, (b, 1, h, d))
+        kt, vt = rand(21, (b, h, d, s)), rand(22, (b, h, d, s))
+        lengths = jnp.asarray([0, 1, 127, 128, min(129, s)], jnp.int32)
+        out = np.asarray(decode_attention(q, kt, vt, lengths, block_k=128))
+        assert np.isfinite(out).all()
+        np.testing.assert_array_equal(out[0], 0.0)       # empty slot
+        ref = self._numpy_ref(q, kt, vt, lengths)
+        np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+        # isolation: perturbing another row's cache leaves this row's
+        # output bitwise unchanged
+        kt2 = kt.at[0].set(9.0)
+        vt2 = vt.at[0].set(-9.0)
+        out2 = np.asarray(decode_attention(q, kt2, vt2, lengths,
+                                           block_k=128))
+        np.testing.assert_array_equal(out[1:], out2[1:])
+
     def test_layer_cache_path_matches_reference_mask_path(self):
         """SelfAttention's kernel fast path == full causal attention,
         end to end through the flax module (cache len 128-aligned)."""

@@ -1,0 +1,416 @@
+"""Continuous-batching serving engine (deepspeed_tpu/serving/).
+
+The acceptance test drives 33 requests with mixed prompt/output lengths
+through 4 slots (slots << requests) and requires every request's tokens
+to EXACTLY match a per-request whole-batch generate() reference, with
+jit-cache-size assertions proving decode compiles once and prefill at
+most once per length bucket.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt import GPT, GPTConfig
+from deepspeed_tpu.inference.generation import generate, init_cache
+from deepspeed_tpu.serving import ServingConfig
+from deepspeed_tpu.serving.engine import (ServingEngine, _admit_jit,
+                                          _decode_iter_jit)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _model(vocab=97, max_seq_len=128, d_model=32, n_layers=2, n_heads=2,
+           scan_layers=True, seed=0, **kw):
+    cfg = GPTConfig(vocab_size=vocab, max_seq_len=max_seq_len,
+                    d_model=d_model, n_layers=n_layers, n_heads=n_heads,
+                    dtype=jnp.float32, scan_layers=scan_layers, **kw)
+    m = GPT(cfg)
+    params = m.init(jax.random.PRNGKey(seed),
+                    jnp.ones((1, 8), jnp.int32))["params"]
+    return m, params
+
+
+def _mixed_workload(n, vocab, seed=0, prompt_range=(3, 24), out_range=(1, 8)):
+    r = np.random.RandomState(seed)
+    prompts = [r.randint(1, vocab, size=r.randint(*prompt_range)
+                         ).astype(np.int32) for _ in range(n)]
+    outs = [int(r.randint(*out_range)) for _ in range(n)]
+    return prompts, outs
+
+
+# ---------------------------------------------------------------------------
+# config / bucketing policy
+# ---------------------------------------------------------------------------
+
+class TestServingConfig:
+    def test_bucket_policy(self):
+        cfg = ServingConfig(num_slots=2, max_len=100, prefill_bucket=16)
+        assert cfg.cache_len == 128                    # rounds up to 128s
+        assert cfg.bucket_lengths() == (16, 32, 48, 64, 80, 96, 112, 128)
+        assert cfg.bucket_for(1) == 16
+        assert cfg.bucket_for(16) == 16
+        assert cfg.bucket_for(17) == 32
+        assert cfg.bucket_for(128) == 128
+        with pytest.raises(ValueError, match="largest prefill bucket"):
+            cfg.bucket_for(129)
+
+    def test_unaligned_quantum_includes_capacity(self):
+        cfg = ServingConfig(num_slots=1, max_len=128, prefill_bucket=48)
+        assert cfg.bucket_lengths() == (48, 96, 128)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="num_slots"):
+            ServingConfig(num_slots=0).validate()
+        with pytest.raises(ValueError, match="prefill_bucket"):
+            ServingConfig(prefill_bucket=0).validate()
+        with pytest.raises(ValueError, match="pipeline_depth"):
+            ServingConfig(pipeline_depth=-1).validate()
+
+    def test_deepspeed_config_block(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        c = DeepSpeedConfig.from_dict(
+            {"serving": {"num_slots": 4, "max_len": 256,
+                         "eos_token_id": 2}})
+        assert isinstance(c.serving, ServingConfig)
+        assert c.serving.num_slots == 4
+        assert c.serving.eos_token_id == 2
+        assert DeepSpeedConfig.from_dict({}).serving is None
+
+
+# ---------------------------------------------------------------------------
+# cache tree helpers
+# ---------------------------------------------------------------------------
+
+class TestCacheHelpers:
+    @pytest.mark.parametrize("scan_layers", [True, False])
+    def test_set_index_and_row_roundtrip(self, scan_layers):
+        from deepspeed_tpu.inference.cache import (
+            cache_max_len, cache_num_rows, make_row_cache, set_cache_index,
+            write_cache_row)
+        m, params = _model(scan_layers=scan_layers)
+        cache = init_cache(m, params, 3, 128)
+        assert cache_max_len(cache) == 128
+        assert cache_num_rows(cache) == 3
+
+        lens = jnp.asarray([5, 0, 7], jnp.int32)
+        cache = set_cache_index(cache, lens)
+
+        # every cache_index leaf now carries the per-row vector
+        def collect(node, out):
+            if isinstance(node, dict):
+                if "cache_index" in node:
+                    out.append(np.asarray(node["cache_index"]))
+                for v in node.values():
+                    if isinstance(v, dict):
+                        collect(v, out)
+            return out
+        from flax.core import unfreeze
+        idxs = collect(unfreeze(cache), [])
+        assert idxs
+        for a in idxs:
+            np.testing.assert_array_equal(a.reshape(-1, 3)[-1], [5, 0, 7])
+
+        # scatter a marked row and read it back
+        row = make_row_cache(cache)
+        row = jax.tree.map(lambda a: jnp.ones_like(a)
+                           if a.ndim >= 4 else a, row)
+        cache2 = write_cache_row(cache, row, jnp.int32(1))
+
+        def kv_leaves(tree):
+            return [a for a in jax.tree.leaves(tree)
+                    if getattr(a, "ndim", 0) >= 4]
+        for leaf in kv_leaves(cache2):
+            ax = leaf.ndim - 4
+            got = np.moveaxis(np.asarray(leaf), ax, 0)
+            np.testing.assert_array_equal(got[1], 1.0)    # written row
+            np.testing.assert_array_equal(got[0], 0.0)    # neighbors intact
+            np.testing.assert_array_equal(got[2], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+class TestScheduler:
+    def test_fifo_and_queue_cap(self):
+        from deepspeed_tpu.serving.scheduler import FifoScheduler
+        from deepspeed_tpu.serving.request import Request
+        sched = FifoScheduler(ServingConfig(max_queue=2, max_len=64))
+        a = Request(np.ones(3, np.int32), 4, "a")
+        b = Request(np.ones(3, np.int32), 4, "b")
+        sched.add(a)
+        sched.add(b)
+        with pytest.raises(RuntimeError, match="queue full"):
+            sched.add(Request(np.ones(3, np.int32), 4, "c"))
+        assert sched.next_request() is a
+        assert sched.next_request() is b
+        assert sched.next_request() is None
+
+    def test_budget_validation(self):
+        from deepspeed_tpu.serving.scheduler import FifoScheduler
+        sched = FifoScheduler(ServingConfig(max_len=64))
+        sched.validate_request(32, 32)                  # exactly fits
+        with pytest.raises(ValueError, match="per-slot budget"):
+            sched.validate_request(33, 32)
+        with pytest.raises(ValueError, match="empty prompt"):
+            sched.validate_request(0, 4)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance integration test
+# ---------------------------------------------------------------------------
+
+class TestContinuousBatchingParity:
+    def test_33_requests_through_4_slots_match_generate(self):
+        """33 mixed-length requests, 4 slots: every request's streamed
+        tokens exactly match its whole-batch generate() reference;
+        decode compiled once, prefill at most once per bucket used."""
+        # vocab 101 is unique to this test so the jit-cache deltas below
+        # cannot be absorbed by entries from other tests' shapes
+        m, params = _model(vocab=101)
+        prompts, outs = _mixed_workload(33, 101, seed=0)
+
+        streamed = {}
+
+        def on_token(req, tok):
+            streamed.setdefault(req.request_id, []).append(tok)
+
+        eng = ServingEngine(m, params,
+                            ServingConfig(num_slots=4, max_len=128,
+                                          prefill_bucket=16, seed=0))
+        decode_before = _decode_iter_jit._cache_size()
+        admit_before = _admit_jit._cache_size()
+        reqs = [eng.submit(p, max_new_tokens=o, on_token=on_token)
+                for p, o in zip(prompts, outs)]
+        eng.run()
+
+        buckets_used = {eng.config.bucket_for(len(p)) for p in prompts}
+        assert _decode_iter_jit._cache_size() == decode_before + 1
+        assert (_admit_jit._cache_size() - admit_before) <= len(buckets_used)
+
+        for req, p, o in zip(reqs, prompts, outs):
+            assert req.done
+            ref = np.asarray(generate(m, params, p[None], max_new_tokens=o,
+                                      temperature=0.0, max_len=128)
+                             )[0, len(p):]
+            np.testing.assert_array_equal(np.asarray(req.output_tokens), ref,
+                                          err_msg=f"request {req.request_id}")
+            # streamed tokens arrived in order and match the final result
+            assert streamed[req.request_id] == req.output_tokens
+
+        # slots really were recycled: far more admissions than slots, and
+        # the queue actually backed up behind the pool
+        snap = eng.metrics.snapshot()
+        assert snap["requests_admitted"] == 33 > eng.config.num_slots
+        assert snap["requests_finished"] == 33
+        assert snap["queue_depth_max"] > 0
+        assert snap["tokens_generated"] == sum(outs)
+        assert not eng.busy and eng.num_free_slots == 4
+
+    @pytest.mark.parametrize("arch", ["gptj", "bloom"])
+    def test_rotary_and_alibi_variants(self, arch):
+        """Per-slot positions must be exact for rotary (position enters
+        q/k) and ALiBi (relative bias computed in-kernel per slot)."""
+        variants = {
+            "gptj": dict(rotary=True, learned_pos=False,
+                         parallel_residual=True, shared_parallel_ln=True,
+                         attn_use_bias=False, rotary_dim=8),
+            "bloom": dict(alibi=True, learned_pos=False, embed_ln=True),
+        }
+        m, params = _model(vocab=89, **variants[arch])
+        prompts, outs = _mixed_workload(8, 89, seed=1, out_range=(2, 6))
+        eng = ServingEngine(m, params,
+                            ServingConfig(num_slots=2, max_len=128,
+                                          prefill_bucket=16))
+        reqs = [eng.submit(p, max_new_tokens=o)
+                for p, o in zip(prompts, outs)]
+        eng.run()
+        for req, p, o in zip(reqs, prompts, outs):
+            ref = np.asarray(generate(m, params, p[None], max_new_tokens=o,
+                                      temperature=0.0, max_len=128)
+                             )[0, len(p):]
+            np.testing.assert_array_equal(np.asarray(req.output_tokens), ref,
+                                          err_msg=f"{arch} {req.request_id}")
+
+    def test_eos_completes_slot_early(self):
+        """A slot must free on EOS, its stream ending with the EOS token,
+        matching the generate() eos semantics truncated at the first hit."""
+        m, params = _model(vocab=61)
+        prompts, _ = _mixed_workload(6, 61, seed=2)
+        # pick an eos that actually occurs: the first greedily generated
+        # token of request 0
+        probe = np.asarray(generate(m, params, prompts[0][None],
+                                    max_new_tokens=1, temperature=0.0,
+                                    max_len=128))
+        eos = int(probe[0, len(prompts[0])])
+        eng = ServingEngine(m, params,
+                            ServingConfig(num_slots=2, max_len=128,
+                                          prefill_bucket=16,
+                                          eos_token_id=eos))
+        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run()
+        hit_early = 0
+        for req, p in zip(reqs, prompts):
+            ref = np.asarray(generate(m, params, p[None], max_new_tokens=8,
+                                      temperature=0.0, eos_token_id=eos,
+                                      max_len=128))[0, len(p):]
+            got = req.output_tokens
+            if eos in got:
+                assert got[-1] == eos            # stream STOPS at eos
+                assert eos not in got[:-1]
+                hit_early += len(got) < 8
+            np.testing.assert_array_equal(got, ref[:len(got)])
+        assert hit_early > 0   # request 0's first token IS eos by design
+
+
+# ---------------------------------------------------------------------------
+# engine plumbing
+# ---------------------------------------------------------------------------
+
+class TestEnginePlumbing:
+    def test_submit_validation_and_init_guards(self):
+        m, params = _model()
+        eng = ServingEngine(m, params, ServingConfig(num_slots=1,
+                                                     max_len=64))
+        with pytest.raises(ValueError, match="per-slot budget"):
+            eng.submit(np.ones(60, np.int32), max_new_tokens=8)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            # model max_seq_len=128 < requested slot budget
+            ServingEngine(m, params, ServingConfig(num_slots=1,
+                                                   max_len=256))
+        with pytest.raises(ValueError, match="config= or as keyword"):
+            ServingEngine(m, params, ServingConfig(), num_slots=2)
+
+    def test_inference_engine_serve_bridge(self):
+        import deepspeed_tpu
+        m, params = _model(vocab=53)
+        eng = deepspeed_tpu.init_inference(m, params=params,
+                                           dtype=jnp.float32)
+        srv = eng.serve({"num_slots": 2, "max_len": 64,
+                         "prefill_bucket": 16})
+        req = srv.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=3)
+        srv.run()
+        ref = np.asarray(eng.generate(np.arange(1, 6, dtype=np.int32)[None],
+                                      max_new_tokens=3, max_len=64))
+        np.testing.assert_array_equal(req.output_tokens, ref[0, 5:])
+
+    def test_from_config_and_metrics_monitor_flush(self):
+        class FakeMonitor:
+            enabled = True
+
+            def __init__(self):
+                self.events = []
+
+            def write_events(self, events):
+                self.events.extend(events)
+
+        m, params = _model(vocab=53)
+        mon = FakeMonitor()
+        srv = ServingEngine.from_config(
+            m, params, {"serving": {"num_slots": 2, "max_len": 64,
+                                    "prefill_bucket": 16,
+                                    "metrics_interval": 1}}, monitor=mon)
+        for p in (np.arange(1, 5, dtype=np.int32),
+                  np.arange(1, 9, dtype=np.int32)):
+            srv.submit(p, max_new_tokens=3)
+        srv.run()
+        labels = {label for label, _, _ in mon.events}
+        assert "serving/queue_depth" in labels
+        assert "serving/slot_occupancy" in labels
+        snap = srv.metrics.snapshot()
+        assert snap["tokens_generated"] == 6
+        assert snap["requests_finished"] == 2
+        assert snap["ttft_steps_p50"] is not None
+        assert 0 < snap["slot_occupancy_mean"] <= 1
+
+    def test_interleaved_submit_and_advance(self):
+        """submit() during service (the online pattern): later arrivals
+        join the running batch and still match their references."""
+        m, params = _model(vocab=71)
+        prompts, outs = _mixed_workload(6, 71, seed=3, out_range=(3, 6))
+        eng = ServingEngine(m, params,
+                            ServingConfig(num_slots=2, max_len=128,
+                                          prefill_bucket=16))
+        first = [eng.submit(p, max_new_tokens=o)
+                 for p, o in zip(prompts[:2], outs[:2])]
+        for _ in range(2):
+            eng.advance()
+        late = [eng.submit(p, max_new_tokens=o)
+                for p, o in zip(prompts[2:], outs[2:])]
+        eng.run()
+        for req, p, o in zip(first + late, prompts, outs):
+            ref = np.asarray(generate(m, params, p[None], max_new_tokens=o,
+                                      temperature=0.0, max_len=128)
+                             )[0, len(p):]
+            np.testing.assert_array_equal(np.asarray(req.output_tokens), ref)
+
+
+# ---------------------------------------------------------------------------
+# bench harness + lint gate
+# ---------------------------------------------------------------------------
+
+class TestBenchHarness:
+    def test_trace_is_deterministic_and_replay_reproduces_steps(self,
+                                                                tmp_path):
+        import sys
+        if REPO_ROOT not in sys.path:
+            sys.path.insert(0, REPO_ROOT)
+        from benchmarks.serving.load_harness import make_trace, replay
+        t1 = make_trace(7, 12, prompt_len_range=(3, 10),
+                        output_len_range=(2, 5), vocab_size=59)
+        t2 = make_trace(7, 12, prompt_len_range=(3, 10),
+                        output_len_range=(2, 5), vocab_size=59)
+        assert t1 == t2                                # seeded trace
+        arrivals = [t["arrival_step"] for t in t1]
+        assert arrivals == sorted(arrivals)
+
+        m, params = _model(vocab=59)
+
+        def run_once():
+            eng = ServingEngine(m, params,
+                                ServingConfig(num_slots=2, max_len=128,
+                                              prefill_bucket=16, seed=0))
+            handles = replay(eng, make_trace(
+                7, 12, prompt_len_range=(3, 10), output_len_range=(2, 5),
+                vocab_size=59))
+            return ([h.output_tokens for h in handles],
+                    [(h.admitted_iteration, h.first_token_iteration,
+                      h.finished_iteration) for h in handles])
+        tokens_a, steps_a = run_once()
+        tokens_b, steps_b = run_once()
+        assert tokens_a == tokens_b
+        assert steps_a == steps_b      # step-clock metrics reproduce exactly
+
+    def test_replay_admits_same_step_burst_together(self):
+        """An idle gap followed by a burst of same-step arrivals must be
+        admitted as a burst (filling the slots), not serialized one
+        request per idle wake-up."""
+        import sys
+        if REPO_ROOT not in sys.path:
+            sys.path.insert(0, REPO_ROOT)
+        from benchmarks.serving.load_harness import replay
+        m, params = _model(vocab=59)
+        eng = ServingEngine(m, params,
+                            ServingConfig(num_slots=3, max_len=128,
+                                          prefill_bucket=16, seed=0))
+        r = np.random.RandomState(0)
+        trace = [{"id": i, "arrival_step": 50,
+                  "prompt": r.randint(1, 59, size=5).tolist(),
+                  "max_new_tokens": 3} for i in range(3)]
+        handles = replay(eng, trace)
+        admits = [h.admitted_iteration for h in handles]
+        assert len(set(admits)) == 1, admits   # all admitted together
+        assert all(h.done for h in handles)
+
+
+def test_serving_subsystem_lints_clean():
+    """The satellite CI gate: deepspeed_tpu/serving/ ships with ZERO lint
+    findings — no baseline file, no suppressions needed."""
+    from deepspeed_tpu.analysis.cli import main as lint_main
+    assert lint_main([os.path.join(REPO_ROOT, "deepspeed_tpu", "serving"),
+                      "-q"]) == 0
