@@ -169,7 +169,6 @@ def reshape_checkpoint(src_dir: str, dst_dir: str, target_mesh_spec=None,
     """
     import jax
     import orbax.checkpoint as ocp
-    from ..runtime.checkpointing import LATEST_FILE
 
     src = DeepSpeedCheckpoint(src_dir, tag)
     params = src.load_params()
@@ -197,7 +196,12 @@ def reshape_checkpoint(src_dir: str, dst_dir: str, target_mesh_spec=None,
     if src.meta:
         with open(os.path.join(dst, "engine_meta.json"), "w") as f:
             json.dump(src.meta, f)
-    with open(os.path.join(dst_dir, LATEST_FILE), "w") as f:
-        f.write(src.tag)
+    # same publication discipline as the engine save path: integrity
+    # manifest (the reshaped tag becomes a verified fallback candidate)
+    # and an atomic `latest` (a crash mid-write must not tear the tag)
+    from ..runtime.resilience.manifest import write_latest, write_manifest
+    write_manifest(dst, step=(src.meta or {}).get("global_steps"),
+                   tag=src.tag)
+    write_latest(os.path.abspath(dst_dir), src.tag)
     logger.info(f"reshaped checkpoint {src.path} -> {dst}")
     return dst

@@ -120,3 +120,9 @@ class MonitorMaster(Monitor):
         for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
             if m is not None:
                 m.write_events(event_list)
+
+    def write_event(self, label, value, step):
+        """One immediate event — for rare out-of-band transitions
+        (resilience rollbacks, emergency saves) that must reach the
+        writers even if the run dies before the next buffered flush."""
+        self.write_events([(label, value, step)])

@@ -8,8 +8,10 @@ mesh/stage the new run uses (the capability the reference implements by
 hand in deepspeed/checkpoint/ reshaping tools + universal checkpoints).
 """
 
+import atexit
 import json
 import os
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -17,8 +19,39 @@ import jax
 import jax.numpy as jnp
 
 from ..utils.logging import logger, log_dist
+from .resilience.manifest import (LATEST_FILE, CheckpointCorruptionError,
+                                  gc_checkpoints, resolve_verified_tag,
+                                  write_latest, write_manifest)
 
-LATEST_FILE = "latest"
+# Engines with an async save in flight: a clean interpreter exit must not
+# drop a durable save just because nobody called wait_checkpoint() —
+# finalize them best-effort at exit (weak refs: registration must never
+# extend engine lifetime).
+_PENDING_ENGINES = weakref.WeakSet()
+
+
+def _finalize_all_pending():
+    """atexit hook: join and publish every in-flight async save."""
+    for engine in list(_PENDING_ENGINES):
+        try:
+            finalize_pending_checkpoint(engine)
+        except Exception as e:  # ds-tpu: lint-ok[PY001] — atexit must never
+            # raise; a failed finalize is logged, the tag stays unpublished
+            # (exactly the partial-checkpoint protection this protocol gives)
+            logger.warning(f"atexit checkpoint finalize failed: {e}")
+
+
+atexit.register(_finalize_all_pending)
+
+
+def _integrity_config(engine):
+    """The engine's resilience.integrity block, defaulted when the config
+    carries no resilience section (manifests are not opt-in)."""
+    res = getattr(getattr(engine, "config", None), "resilience", None)
+    if res is not None:
+        return res.integrity
+    from .resilience.config import IntegrityConfig
+    return IntegrityConfig()
 
 
 def _checkpointer():
@@ -78,12 +111,46 @@ def finalize_pending_checkpoint(engine):
     # protocol exists to prevent)
     engine._pending_ckpt = None
     engine._async_ckptr.wait_until_finished()
-    save_dir, tag, save_latest = pending
-    if save_latest and jax.process_index() == 0:
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(str(tag))
+    save_dir, tag, save_latest, step = pending
+    path = os.path.join(save_dir, str(tag))
+    _publish_checkpoint(engine, save_dir, tag, save_latest, step)
     log_dist(f"async checkpoint {tag} finalized", ranks=[0])
-    return os.path.join(save_dir, str(tag))
+    return path
+
+
+def _publish_checkpoint(engine, save_dir, tag, save_latest, step):
+    """Post-durability publication, shared by the sync save and the async
+    finalize: integrity manifest, atomic ``latest`` tag, retention GC,
+    and the torn-write fault-injection hook (tests corrupt a checkpoint
+    the way a crash would — AFTER it was fully published).
+
+    ``step`` is the step the checkpoint was TAKEN at, carried through
+    the pending record — at async-finalize time ``engine.global_steps``
+    has moved on, and a wrong manifest step would mis-order the
+    verified-tag chain and the retention GC."""
+    path = os.path.join(save_dir, str(tag))
+    icfg = _integrity_config(engine)
+    if jax.process_count() > 1:
+        # every process's shard files (native npz, orbax per-process dirs)
+        # must be durable before process 0 walks and hashes the tag dir
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_publish_{tag}")
+    if jax.process_index() == 0:
+        if icfg.enabled:
+            write_manifest(path, step=step, tag=str(tag),
+                           algorithm=icfg.algorithm)
+        if save_latest:
+            # tmp + fsync + os.replace + dir fsync: a crash mid-write can
+            # never leave a truncated `latest` that breaks every load
+            write_latest(save_dir, str(tag))
+        if icfg.keep_last_n > 0:
+            gc_checkpoints(save_dir, icfg.keep_last_n, protect=(str(tag),))
+        from .resilience.faults import active_injector
+        inj = active_injector()
+        if inj is not None:
+            # process 0 only: one modeled torn write, one save ordinal
+            inj.on_checkpoint_saved(path)
+    engine._last_save_dir = os.path.abspath(save_dir)
 
 
 def close_async_checkpointer(engine):
@@ -120,8 +187,11 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
         _async_checkpointer(engine).save(
             os.path.join(path, "state"), state, force=True)
         engine._pending_ckpt = (os.path.abspath(save_dir), str(tag),
-                                save_latest)
-        save_latest = False   # published by finalize, post-durability
+                                save_latest, engine.global_steps)
+        # publication (manifest + latest + GC) happens in finalize, after
+        # durability; the atexit hook guarantees a clean interpreter exit
+        # never drops the pending save
+        _PENDING_ENGINES.add(engine)
     else:
         ckptr = _checkpointer()
         ckptr.save(os.path.join(path, "state"), state, force=True)
@@ -154,9 +224,9 @@ def save_engine_checkpoint(engine, save_dir, tag=None, client_state=None,
     if jax.process_index() == 0:
         with open(os.path.join(path, "engine_meta.json"), "w") as f:
             json.dump(meta, f)
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
+    if not async_save:
+        _publish_checkpoint(engine, os.path.abspath(save_dir), str(tag),
+                            save_latest, engine.global_steps)
     log_dist(f"saved checkpoint {path}", ranks=[0])
     return path
 
@@ -166,10 +236,42 @@ def load_module_params(load_dir, mesh=None, tag=None):
     (reference: load_checkpoint with load_module_only=True,
     engine.py:2472) — used by the inference loader to serve weights
     trained by this framework without constructing a training engine."""
+    explicit_tag = tag is not None
+    if explicit_tag and not os.path.isdir(os.path.join(load_dir, str(tag))):
+        # a plain wrong path is a caller mistake, not corruption — don't
+        # mis-diagnose it as an integrity failure
+        raise FileNotFoundError(
+            f"checkpoint tag directory {os.path.join(load_dir, str(tag))} "
+            "does not exist")
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)
         with open(latest) as f:
             tag = f.read().strip()
+    # integrity gate (default policy — no engine config on this path):
+    # serve only checkpoints whose manifest verifies. latest-driven loads
+    # fall back along the retained-tag chain like the engine loader; an
+    # explicitly named tag that fails raises (never serve different
+    # weights than the caller asked for). Process 0 decides, peers take
+    # the broadcast — same skewed-shared-FS discipline as the engine load.
+    if jax.process_index() == 0:
+        chosen, errors = resolve_verified_tag(load_dir, prefer_tag=str(tag))
+        if chosen != str(tag) and explicit_tag:
+            raise CheckpointCorruptionError(
+                f"explicitly requested checkpoint {tag!r} under {load_dir} "
+                f"failed integrity verification: "
+                f"{_corruption_detail(errors)}")
+        if chosen is None:
+            raise CheckpointCorruptionError(
+                f"no verified-good checkpoint under {load_dir} (latest "
+                f"pointed at {tag!r}): {_corruption_detail(errors)}")
+        if chosen != str(tag):
+            logger.warning(
+                f"checkpoint {tag!r} under {load_dir} failed integrity "
+                f"verification ({_corruption_detail(errors)}); serving "
+                f"newest verified-good tag {chosen!r}")
+            tag = chosen
+    if jax.process_count() > 1:
+        tag = _broadcast_tag(str(tag))
     path = os.path.join(os.path.abspath(load_dir), str(tag), "state")
     ckptr = _checkpointer()
     disk = _item_metadata(ckptr, path)
@@ -185,9 +287,30 @@ def load_module_params(load_dir, mesh=None, tag=None):
     return restored["params"]
 
 
+def _corruption_detail(errors):
+    return " | ".join(f"{t}: {'; '.join(e)}" for t, e in errors.items()) \
+        or "no checkpoint tags found"
+
+
+def _broadcast_tag(tag: str) -> str:
+    """Process 0's tag decision, agreed across every process (fixed-size
+    uint8 buffer; empty string = abort the load)."""
+    from jax.experimental import multihost_utils
+    buf = np.zeros(512, np.uint8)
+    if jax.process_index() == 0:
+        data = tag.encode()
+        if len(data) > buf.size:
+            raise ValueError(f"checkpoint tag too long to broadcast: {tag!r}")
+        buf[:len(data)] = np.frombuffer(data, np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return out.tobytes().rstrip(b"\x00").decode()
+
+
 def load_engine_checkpoint(engine, load_dir, tag=None,
                            load_optimizer_states=True,
                            load_module_only=False):
+    icfg = _integrity_config(engine)
+    explicit_tag = tag is not None
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)
         if not os.path.exists(latest):
@@ -195,6 +318,57 @@ def load_engine_checkpoint(engine, load_dir, tag=None,
             return None, {}
         with open(latest) as f:
             tag = f.read().strip()
+    # The verification/fallback DECISION is made by process 0 alone and
+    # broadcast: shared-filesystem visibility can differ per host, and two
+    # processes independently walking the tag chain could restore
+    # DIFFERENT steps. (A process-0 raise below aborts the whole job —
+    # peers block in the broadcast until the launcher reaps them, the
+    # standard SPMD failure mode.)
+    abort_load = False
+    if (icfg.enabled and icfg.verify_on_load
+            and jax.process_index() == 0):
+        chosen, errors = resolve_verified_tag(load_dir, prefer_tag=str(tag))
+        if chosen != str(tag):
+            detail = _corruption_detail(errors)
+            if explicit_tag and not os.path.isdir(
+                    os.path.join(load_dir, str(tag))):
+                # an explicitly named tag that simply is not there is a
+                # caller mistake, not corruption — keep the legacy contract
+                logger.warning(f"checkpoint path "
+                               f"{os.path.join(load_dir, str(tag))} does "
+                               "not exist")
+                abort_load = True
+            elif explicit_tag:
+                # silently restoring a DIFFERENT step than the one the
+                # caller named would corrupt their eval/resume — fallback
+                # is a latest-driven policy only
+                raise CheckpointCorruptionError(
+                    f"explicitly requested checkpoint {tag!r} under "
+                    f"{load_dir} failed integrity verification: {detail}")
+            elif chosen is None:
+                raise CheckpointCorruptionError(
+                    f"no verified-good checkpoint under {load_dir} "
+                    f"(latest pointed at {tag!r}): {detail}")
+            elif not icfg.fallback_on_corruption:
+                raise CheckpointCorruptionError(
+                    f"checkpoint {tag!r} under {load_dir} failed integrity "
+                    f"verification ({detail}) and "
+                    "resilience.integrity.fallback_on_corruption is false")
+            else:
+                logger.warning(
+                    f"checkpoint {tag!r} under {load_dir} failed integrity "
+                    f"verification ({detail}); falling back to newest "
+                    f"verified-good tag {chosen!r}")
+                # repair the torn/stale `latest` so every later load goes
+                # straight to the verified-good tag
+                write_latest(load_dir, chosen)
+                tag = chosen
+    if jax.process_count() > 1:
+        tag = _broadcast_tag("" if abort_load else str(tag))
+        if not tag:
+            return None, {}
+    elif abort_load:
+        return None, {}
     path = os.path.abspath(os.path.join(load_dir, str(tag)))
     if not os.path.isdir(path):
         logger.warning(f"checkpoint path {path} does not exist")

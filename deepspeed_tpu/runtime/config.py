@@ -17,6 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from .config_utils import DeepSpeedConfigError, dict_to_dataclass, dataclass_to_dict
+from .resilience.config import ResilienceConfig
 from ..serving.config import ServingConfig
 from ..utils.logging import logger
 
@@ -383,6 +384,10 @@ class DeepSpeedConfig:
     # continuous-batching serving engine (serving/engine.py); consumed by
     # ServingEngine.from_config — absent means "not serving"
     serving: Optional[ServingConfig] = None
+    # fault-tolerant training (runtime/resilience/, docs/resilience.md);
+    # absent means "no sentinel/preemption/watchdog" — checkpoint
+    # manifests are still written (integrity is not opt-in)
+    resilience: Optional[ResilienceConfig] = None
 
     # free-form blocks consumed by their subsystems
     sparse_attention: Optional[Dict[str, Any]] = None
@@ -417,6 +422,7 @@ class DeepSpeedConfig:
         "mesh": MeshConfig,
         "pipeline": PipelineConfig,
         "serving": ServingConfig,
+        "resilience": ResilienceConfig,
     }
 
     @classmethod

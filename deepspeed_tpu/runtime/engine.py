@@ -142,6 +142,16 @@ class DeepSpeedEngine:
         from ..monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(config)
 
+        # ---- resilience (runtime/resilience/, docs/resilience.md) ----
+        # divergence sentinel + rollback, preemption emergency save, and
+        # the step-hang watchdog; constructed after the monitor so every
+        # recovery transition can emit events
+        self._last_save_dir = None
+        self.resilience = None
+        if config.resilience is not None and config.resilience.enabled:
+            from .resilience.manager import ResilienceManager
+            self.resilience = ResilienceManager(self, config.resilience)
+
         from .data_pipeline.curriculum_scheduler import CurriculumScheduler
         self.curriculum_scheduler = (
             CurriculumScheduler(config.curriculum_learning)
@@ -922,6 +932,8 @@ class DeepSpeedEngine:
         batch = self._place_batch(batch, with_gas_dim=True)
 
         self.tput_timer.start()
+        if self.resilience is not None:
+            self.resilience.on_step_start()
         self._ensure_params_resident()
         self._sync_activation_quantization()
         scaler = self.loss_scale_state or init_loss_scale(1.0)
@@ -965,6 +977,10 @@ class DeepSpeedEngine:
             self._report_step(metrics)
         self._write_monitor(metrics)
         self._evict_params_to_nvme()
+        if self.resilience is not None:
+            # device-side health fold every step; host check (and possible
+            # rollback) only on the bounded check_interval cadence
+            self.resilience.on_step_end(metrics)
         return metrics["loss"]
 
     def _sync_activation_quantization(self):
@@ -1166,6 +1182,8 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             return
         self.timers(STEP_GLOBAL_TIMER).start()
+        if self.resilience is not None:
+            self.resilience.on_step_start()
         scaler = self.loss_scale_state or init_loss_scale(1.0)
         if self.native_offload is not None:
             gnorm, new_scaler, skipped = self._native_offload_step(scaler)
@@ -1190,6 +1208,8 @@ class DeepSpeedEngine:
             log_dist(f"step={self.global_steps} lr={self.get_lr():.3e} "
                      f"grad_norm={float(gnorm):.3f}", ranks=[0])
         self._write_monitor(metrics)
+        if self.resilience is not None:
+            self.resilience.on_step_end(metrics)
 
     def _device_step(self, scaler):
         if "apply_grads" not in self._compiled:
@@ -1354,6 +1374,10 @@ class DeepSpeedEngine:
         """Release engine-held background resources: the async
         checkpointer's worker (after joining any pending save) and the
         NVMe param swapper's aio threads (reference: engine.destroy)."""
+        res = getattr(self, "resilience", None)
+        if res is not None:
+            self.resilience = None
+            res.close()   # uninstall signal handlers, stop the watchdog
         from .checkpointing import close_async_checkpointer
         close_async_checkpointer(self)
         swapper = getattr(self, "_param_swapper", None)

@@ -301,6 +301,8 @@ class PipelineEngine(DeepSpeedEngine):
         scaler = self.loss_scale_state or init_loss_scale(1.0)
         rng = jax.random.fold_in(self.rng, self.global_steps + 1)
         self.tput_timer.start()
+        if self.resilience is not None:
+            self.resilience.on_step_start()
         self.params, self.optimizer_state, new_scaler, metrics = \
             self._compiled["train_step"](self.params, self.optimizer_state,
                                          scaler, dev_batch, rng)
@@ -313,6 +315,8 @@ class PipelineEngine(DeepSpeedEngine):
         if self.global_steps % cfg.steps_per_print == 0:
             self._report_step(metrics)
         self._write_monitor(metrics)
+        if self.resilience is not None:
+            self.resilience.on_step_end(metrics)
         return metrics["loss"]
 
     def eval_batch(self, batch):

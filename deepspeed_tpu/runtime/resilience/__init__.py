@@ -1,0 +1,41 @@
+"""Fault-tolerant training (docs/resilience.md).
+
+Lazy exports (PEP 562, same pattern as ``serving/``): ``config`` and
+``manifest`` stay importable without jax so ``runtime/config.py`` and
+file-level checkpoint tooling work in dependency-free jobs; the
+jax-touching members load on first access.
+"""
+
+from .config import ResilienceConfig
+
+__all__ = ["ResilienceConfig", "ResilienceManager", "DivergenceSentinel",
+           "DivergenceError", "PreemptionHandler", "Watchdog",
+           "emergency_save", "Fault", "FaultInjector", "injected",
+           "CheckpointCorruptionError", "write_manifest", "verify_manifest",
+           "resolve_verified_tag", "gc_checkpoints", "write_latest"]
+
+_LAZY = {
+    "ResilienceManager": ".manager",
+    "DivergenceSentinel": ".sentinel",
+    "DivergenceError": ".sentinel",
+    "PreemptionHandler": ".preemption",
+    "Watchdog": ".preemption",
+    "emergency_save": ".preemption",
+    "Fault": ".faults",
+    "FaultInjector": ".faults",
+    "injected": ".faults",
+    "CheckpointCorruptionError": ".manifest",
+    "write_manifest": ".manifest",
+    "verify_manifest": ".manifest",
+    "resolve_verified_tag": ".manifest",
+    "gc_checkpoints": ".manifest",
+    "write_latest": ".manifest",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(_LAZY[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

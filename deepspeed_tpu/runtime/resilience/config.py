@@ -1,0 +1,139 @@
+"""Resilience configuration (the ``resilience`` config block).
+
+Stdlib-only on purpose (same contract as ``serving/config.py``):
+``runtime/config.py`` wires this dataclass into ``DeepSpeedConfig``, and
+that module must stay importable without jax for dependency-free tooling
+jobs.
+
+Reference frame: DeepSpeed's engine hardens the same paths imperatively —
+skipped-step overflow handling, the ``latest``-tag checkpoint discipline,
+elasticity's restart contract. Here the knobs are declarative and the
+mechanisms live in ``runtime/resilience/`` (docs/resilience.md has the
+failure model and recovery matrix).
+"""
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..config_utils import DeepSpeedConfigError, dict_to_dataclass
+
+_DIGESTS = ("crc32", "sha256")
+
+
+@dataclass
+class IntegrityConfig:
+    """Checkpoint integrity: every save writes a ``manifest.json`` (per-file
+    sizes + digests); loads verify it and fall back along the retained-tag
+    chain on mismatch instead of restoring corrupt state."""
+    enabled: bool = True
+    algorithm: str = "crc32"          # crc32 (fast) | sha256 (cryptographic)
+    verify_on_load: bool = True
+    fallback_on_corruption: bool = True
+    keep_last_n: int = 0              # 0 = retain every tag (no GC)
+
+    def __post_init__(self):
+        if self.algorithm not in _DIGESTS:
+            raise DeepSpeedConfigError(
+                f"resilience.integrity.algorithm must be one of {_DIGESTS}, "
+                f"got {self.algorithm!r}")
+        if self.keep_last_n < 0:
+            raise DeepSpeedConfigError(
+                "resilience.integrity.keep_last_n must be >= 0, got "
+                f"{self.keep_last_n}")
+
+
+@dataclass
+class DivergenceConfig:
+    """Divergence sentinel: per-step non-finite / exploding loss & grad-norm
+    flags fold into an on-device accumulator (no per-step host sync); a host
+    check every ``check_interval`` steps triggers rollback to the last
+    verified-good checkpoint after ``patience`` consecutive bad steps."""
+    enabled: bool = True
+    patience: int = 3                 # consecutive bad steps before rollback
+    check_interval: int = 10          # host-check cadence (optimizer steps)
+    loss_abs_threshold: float = 0.0   # |loss| above this is "bad" (0 = off)
+    grad_norm_threshold: float = 0.0  # grad norm above this is "bad" (0 = off)
+    max_rollbacks: int = 3            # give up (raise) past this many
+    reseed_on_rollback: bool = False  # fold the rollback count into the rng
+
+    def __post_init__(self):
+        if self.patience < 1:
+            raise DeepSpeedConfigError(
+                f"resilience.divergence.patience must be >= 1, got "
+                f"{self.patience}")
+        if self.check_interval < 1:
+            raise DeepSpeedConfigError(
+                f"resilience.divergence.check_interval must be >= 1, got "
+                f"{self.check_interval}")
+        if self.max_rollbacks < 0:
+            raise DeepSpeedConfigError(
+                "resilience.divergence.max_rollbacks must be >= 0, got "
+                f"{self.max_rollbacks}")
+
+
+@dataclass
+class PreemptionConfig:
+    """Preemption handling: on the listed signals, join any in-flight async
+    save and write a best-effort emergency checkpoint before the process
+    goes down (Varuna-style preemptible-capacity discipline)."""
+    enabled: bool = False
+    signals: List[str] = field(
+        default_factory=lambda: ["SIGTERM", "SIGINT"])
+    emergency_tag: Optional[str] = None   # default: emergency_step{N}
+    chain_handler: bool = True            # re-deliver to the prior handler
+
+    def __post_init__(self):
+        import signal as _signal
+        for name in self.signals:
+            if not hasattr(_signal, name):
+                raise DeepSpeedConfigError(
+                    f"resilience.preemption.signals entry {name!r} is not a "
+                    "signal name (e.g. SIGTERM, SIGINT)")
+
+
+@dataclass
+class WatchdogConfig:
+    """Hang detection: a daemon thread that fires when a train step stays
+    in flight past ``step_timeout_s``, dumps diagnostics (last good step,
+    pending checkpoint state, live stacks) and aborts cleanly."""
+    enabled: bool = False
+    step_timeout_s: float = 1800.0
+    poll_interval_s: float = 0.0      # 0 -> step_timeout_s / 4
+    exit_code: int = 70               # EX_SOFTWARE; orchestrators restart on it
+
+    def __post_init__(self):
+        if self.step_timeout_s <= 0:
+            raise DeepSpeedConfigError(
+                "resilience.watchdog.step_timeout_s must be > 0, got "
+                f"{self.step_timeout_s}")
+        if self.poll_interval_s < 0:
+            raise DeepSpeedConfigError(
+                "resilience.watchdog.poll_interval_s must be >= 0, got "
+                f"{self.poll_interval_s}")
+
+
+@dataclass
+class ResilienceConfig:
+    """Top-level ``resilience`` block. ``checkpoint_dir`` is the rollback /
+    emergency-save root; when unset, the engine uses the directory of its
+    most recent ``save_checkpoint`` call."""
+    enabled: bool = True
+    checkpoint_dir: Optional[str] = None
+    integrity: IntegrityConfig = field(default_factory=IntegrityConfig)
+    divergence: DivergenceConfig = field(default_factory=DivergenceConfig)
+    preemption: PreemptionConfig = field(default_factory=PreemptionConfig)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+
+    def __post_init__(self):
+        if isinstance(self.integrity, dict):
+            self.integrity = dict_to_dataclass(
+                IntegrityConfig, self.integrity, "resilience.integrity")
+        if isinstance(self.divergence, dict):
+            self.divergence = dict_to_dataclass(
+                DivergenceConfig, self.divergence, "resilience.divergence")
+        if isinstance(self.preemption, dict):
+            self.preemption = dict_to_dataclass(
+                PreemptionConfig, self.preemption, "resilience.preemption")
+        if isinstance(self.watchdog, dict):
+            self.watchdog = dict_to_dataclass(
+                WatchdogConfig, self.watchdog, "resilience.watchdog")

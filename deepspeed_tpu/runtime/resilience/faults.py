@@ -1,0 +1,211 @@
+"""Deterministic fault injection — recovery paths are tested, not trusted.
+
+Every recovery mechanism in this package (manifest fallback, divergence
+rollback, emergency save, watchdog abort) is exercised by tier-1 tests
+through this harness rather than waiting for production to produce the
+failure. Faults are *deterministic*: they fire at chosen engine steps /
+save ordinals, are one-shot by default (a rollback rewinds
+``global_steps``, so a step-matched fault must not re-fire when the
+counter passes the same value again), and log every firing for
+assertions.
+
+Fault kinds:
+
+- ``nan_grads``  — at step(s) k..k+repeat-1, poison the float params the
+  way a NaN gradient burst would (the post-update state of an Adam step
+  fed NaN grads): the next step's loss/grad-norm go non-finite and the
+  divergence sentinel sees exactly the injected burst.
+- ``torn_write`` — on the Nth checkpoint save, truncate or delete a shard
+  file AFTER the manifest is written: the on-disk state a crash mid-copy
+  (or a shared-FS partial replication) leaves behind, with ``latest``
+  already pointing at the damaged tag.
+- ``delay_step`` — sleep ``duration_s`` inside step k (exercises the
+  watchdog without a real deadlock).
+- ``preempt``    — raise ``signum`` against this process at step k
+  (exercises the emergency-save path with a real signal delivery).
+
+Usage::
+
+    plan = [Fault("nan_grads", step=5, repeat=2),
+            Fault("torn_write", save_index=1)]
+    with injected(plan) as inj:
+        ... train ...
+    assert inj.fired == [...]
+
+The injector is process-global while installed; the engine and the
+checkpoint writer poll ``active_injector()`` at their hook points.
+"""
+
+import os
+import signal as _signal
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ...utils.logging import logger
+
+
+@dataclass
+class Fault:
+    kind: str                          # nan_grads|torn_write|delay_step|preempt
+    step: Optional[int] = None         # engine global_steps to fire at
+    save_index: Optional[int] = None   # torn_write: Nth save (0-based)
+    repeat: int = 1                    # nan_grads: burst length in steps
+    duration_s: float = 0.0            # delay_step: sleep length
+    signum: int = int(_signal.SIGTERM)  # preempt: signal to raise
+    mode: str = "truncate"             # torn_write: truncate | delete
+    target_index: int = 0              # torn_write: file rank (largest first)
+    fires_left: int = field(init=False)
+
+    def __post_init__(self):
+        kinds = ("nan_grads", "torn_write", "delay_step", "preempt")
+        if self.kind not in kinds:
+            raise ValueError(f"fault kind must be one of {kinds}, "
+                             f"got {self.kind!r}")
+        if self.kind == "torn_write":
+            if self.save_index is None:
+                raise ValueError("torn_write faults fire on a save ordinal: "
+                                 "set save_index")
+            if self.mode not in ("truncate", "delete"):
+                raise ValueError(f"torn_write mode must be truncate|delete, "
+                                 f"got {self.mode!r}")
+        elif self.step is None:
+            raise ValueError(f"{self.kind} faults fire on a step: set step")
+        self.fires_left = max(1, self.repeat)
+
+
+class FaultInjector:
+    """Drives a fault plan against the engine/checkpoint hook points."""
+
+    def __init__(self, faults: List[Fault]):
+        self.faults = list(faults)
+        self.fired: List[tuple] = []   # (kind, where) log for assertions
+        self._save_count = 0
+
+    # -- engine hook points ------------------------------------------------
+    def on_step_start(self, step: int, engine) -> None:
+        """Before the step's device dispatch: delays and preemptions."""
+        for f in self.faults:
+            if f.fires_left <= 0 or f.step != step:
+                continue
+            if f.kind == "delay_step":
+                f.fires_left -= 1
+                self.fired.append(("delay_step", step))
+                logger.warning(f"FAULT delay_step: sleeping {f.duration_s}s "
+                               f"at step {step}")
+                time.sleep(f.duration_s)
+            elif f.kind == "preempt":
+                f.fires_left -= 1
+                self.fired.append(("preempt", step))
+                logger.warning(f"FAULT preempt: raising signal {f.signum} "
+                               f"at step {step}")
+                os.kill(os.getpid(), f.signum)
+
+    def on_step_end(self, step: int, engine) -> None:
+        """After the optimizer applied: gradient-poisoning faults. The
+        params are set to the state a NaN gradient burst leaves behind
+        (every float leaf non-finite), so detection and rollback run
+        against realistic post-divergence state."""
+        for f in self.faults:
+            if (f.kind != "nan_grads" or f.fires_left <= 0
+                    or f.step is None or step < f.step
+                    or step >= f.step + f.repeat):
+                continue
+            f.fires_left -= 1
+            self.fired.append(("nan_grads", step))
+            logger.warning(f"FAULT nan_grads: poisoning params after "
+                           f"step {step}")
+            engine.params = _poison_params(engine.params)
+
+    # -- checkpoint hook point --------------------------------------------
+    def on_checkpoint_saved(self, tag_path: str) -> None:
+        """After a save is fully written (manifest included): torn writes."""
+        idx = self._save_count
+        self._save_count += 1
+        for f in self.faults:
+            if (f.kind != "torn_write" or f.fires_left <= 0
+                    or f.save_index != idx):
+                continue
+            f.fires_left -= 1
+            victim = _pick_victim(tag_path, f.target_index)
+            if victim is None:
+                logger.warning(f"FAULT torn_write: no data file under "
+                               f"{tag_path} to damage")
+                continue
+            self.fired.append(("torn_write", victim))
+            if f.mode == "delete":
+                logger.warning(f"FAULT torn_write: deleting {victim}")
+                os.remove(victim)
+            else:
+                size = os.path.getsize(victim)
+                logger.warning(f"FAULT torn_write: truncating {victim} "
+                               f"({size} -> {size // 2} bytes)")
+                with open(victim, "r+b") as fh:
+                    fh.truncate(size // 2)
+
+
+def _poison_params(params):
+    """Float leaves -> NaN (what an unguarded optimizer step does with a
+    NaN gradient); integer/bool leaves keep their values."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(p):
+        if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating):
+            return p * jnp.asarray(float("nan"), p.dtype)
+        return p
+    return jax.tree.map(one, params)
+
+
+def _pick_victim(tag_path: str, target_index: int) -> Optional[str]:
+    """Deterministic target file: data files under the tag dir (manifest
+    excluded — damaging the manifest makes the tag merely *unverifiable*,
+    which is the weaker scenario), largest first."""
+    from .manifest import MANIFEST_FILE
+    files = []
+    for root, _dirs, names in os.walk(tag_path):
+        for name in names:
+            if name == MANIFEST_FILE:
+                continue
+            full = os.path.join(root, name)
+            files.append((-os.path.getsize(full), full))
+    files.sort()
+    if not files:
+        return None
+    return files[min(target_index, len(files) - 1)][1]
+
+
+# -- process-global installation -------------------------------------------
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def active_injector() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("a FaultInjector is already installed")
+    _ACTIVE = injector
+    return injector
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+class injected:
+    """Context manager: ``with injected([Fault(...)]) as inj: ...``"""
+
+    def __init__(self, faults: List[Fault]):
+        self.injector = FaultInjector(faults)
+
+    def __enter__(self) -> FaultInjector:
+        return install(self.injector)
+
+    def __exit__(self, *exc):
+        uninstall()
+        return False

@@ -1,0 +1,198 @@
+"""ResilienceManager — the engine-facing coordinator.
+
+One object owns the fault-tolerance lifecycle around the train loop:
+
+- per-step: heartbeat the watchdog, run step-scoped fault injections,
+  fold the step's health scalars into the on-device sentinel;
+- per-cadence (``divergence.check_interval`` steps): ONE host read of
+  the consecutive-bad counter; at ``patience`` consecutive bad steps,
+  roll back to the newest verified-good checkpoint and resume;
+- at init: install the preemption signal handler and start the watchdog
+  when their blocks opt in.
+
+Every transition (divergence detected, rollback, emergency save) emits
+a monitor event through the engine's buffered monitor path and is
+recorded host-side in ``self.events`` so tests and the chaos CLI can
+assert on the exact recovery sequence.
+"""
+
+from typing import List, Optional, Tuple
+
+from ...utils.logging import logger, log_dist
+from .faults import active_injector
+from .sentinel import DivergenceError, DivergenceSentinel
+
+
+class ResilienceManager:
+    def __init__(self, engine, config):
+        self.engine = engine
+        self.config = config
+        self.rollbacks = 0
+        self.events: List[Tuple[str, float, int]] = []  # (label, value, step)
+        self.sentinel = (DivergenceSentinel(config.divergence)
+                         if config.divergence.enabled else None)
+        self.preemption = None
+        if config.preemption.enabled:
+            from .preemption import PreemptionHandler
+            self.preemption = PreemptionHandler(
+                engine, self.checkpoint_dir,
+                signals=tuple(config.preemption.signals),
+                tag=config.preemption.emergency_tag,
+                chain=config.preemption.chain_handler).install()
+        self.watchdog = None
+        if config.watchdog.enabled:
+            from .preemption import Watchdog
+            self.watchdog = Watchdog(
+                engine, config.watchdog.step_timeout_s,
+                poll_interval_s=config.watchdog.poll_interval_s,
+                exit_code=config.watchdog.exit_code).start()
+
+    # ------------------------------------------------------------------
+    def checkpoint_dir(self) -> Optional[str]:
+        """Rollback/emergency root: the configured dir, else wherever the
+        engine last saved."""
+        return (self.config.checkpoint_dir
+                or getattr(self.engine, "_last_save_dir", None))
+
+    def close(self) -> None:
+        if self.preemption is not None:
+            self.preemption.uninstall()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+
+    # -- train-loop hooks --------------------------------------------------
+    def on_step_start(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.step_started()
+        inj = active_injector()
+        if inj is not None:
+            inj.on_step_start(self.engine.global_steps, self.engine)
+
+    def on_step_end(self, metrics: dict) -> None:
+        """After the step's bookkeeping: disarm the watchdog, run
+        post-step injections, fold health, host-check on cadence. Device
+        work here is a handful of asynchronous scalar ops; the only
+        device->host sync is the cadence-gated sentinel read."""
+        eng = self.engine
+        if self.watchdog is not None:
+            self.watchdog.step_finished()
+        inj = active_injector()
+        if inj is not None:
+            inj.on_step_end(eng.global_steps, eng)
+        if self.sentinel is None:
+            return
+        self.sentinel.fold(metrics)
+        if eng.global_steps % self.config.divergence.check_interval == 0:
+            self._host_check()
+
+    # -- divergence / rollback ---------------------------------------------
+    def _host_check(self) -> None:
+        consec = self.sentinel.read_consecutive()
+        if consec < self.config.divergence.patience:
+            return
+        eng = self.engine
+        self._emit("resilience/divergence_detected", consec,
+                   eng.global_steps)
+        self.rollback(reason=f"{consec} consecutive bad steps "
+                      f"(patience={self.config.divergence.patience})")
+
+    def rollback(self, reason: str = "") -> str:
+        """Restore the newest verified-good checkpoint and resume. Raises
+        ``DivergenceError`` when rollback is exhausted or impossible —
+        silently continuing a diverged run corrupts it."""
+        eng = self.engine
+        cfg = self.config.divergence
+        if self.rollbacks >= cfg.max_rollbacks:
+            raise DivergenceError(
+                f"training diverged ({reason}) and max_rollbacks="
+                f"{cfg.max_rollbacks} is exhausted — the run is not "
+                "recovering; inspect data/LR before resuming")
+        load_dir = self.checkpoint_dir()
+        if load_dir is None:
+            raise DivergenceError(
+                f"training diverged ({reason}) but no checkpoint exists to "
+                "roll back to — set resilience.checkpoint_dir or call "
+                "save_checkpoint() periodically")
+        self.rollbacks += 1
+        logger.warning(f"resilience: rolling back ({reason}) — restoring "
+                       f"from {load_dir} [rollback {self.rollbacks}/"
+                       f"{cfg.max_rollbacks}]")
+        path = self._load_healthy(load_dir, reason)
+        if cfg.reseed_on_rollback:
+            import jax
+            # shift the rng stream so the resumed run draws a different
+            # data/dropout order and does not march into the same cliff
+            eng.rng = jax.random.fold_in(eng.rng, 0x5EED + self.rollbacks)
+        if self.sentinel is not None:   # rollback() is callable with the
+            self.sentinel.reset()       # sentinel disabled (public API)
+        self._emit("resilience/rollback", self.rollbacks, eng.global_steps)
+        log_dist(f"resilience: resumed from {path} at step "
+                 f"{eng.global_steps}", ranks=[0])
+        return path
+
+    def _load_healthy(self, load_dir: str, reason: str) -> str:
+        """Restore the newest verified tag whose params are actually
+        FINITE. Manifest verification proves file integrity, not numeric
+        health — a periodic save that landed inside an undetected
+        divergence window is manifest-valid NaN state, and restoring it
+        would just re-trigger until max_rollbacks. Such tags are
+        quarantined (dropped from the walk, files kept for post-mortem)
+        and the walk continues to the next older tag."""
+        import jax
+        from .manifest import list_tags, quarantine_tag, write_latest
+        # bounded: each failed attempt quarantines one tag
+        attempts = len(list_tags(load_dir)) + 1
+        for attempt in range(attempts):
+            path, _ = self.engine.load_checkpoint(load_dir)
+            if path is None:
+                raise DivergenceError(
+                    f"training diverged ({reason}) and no loadable "
+                    f"checkpoint was found under {load_dir}")
+            if self._params_finite():
+                return path
+            self._emit("resilience/checkpoint_quarantined", 1.0,
+                       self.engine.global_steps)
+            # filesystem mutations from process 0 only (same discipline
+            # as checkpoint publication); the finite verdict came from a
+            # global device reduction, so every process agrees on it
+            if jax.process_index() == 0:
+                quarantine_tag(path)
+                # point latest past the quarantined tag so the next
+                # iteration (and any later restart) walks straight to the
+                # survivor set
+                newest = next((t for t, s in list_tags(load_dir)
+                               if s is not None), None)
+                if newest is not None:
+                    write_latest(load_dir, newest)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices(
+                    f"quarantine_{self.rollbacks}_{attempt}")
+        raise DivergenceError(
+            f"training diverged ({reason}) and every retained checkpoint "
+            f"under {load_dir} holds non-finite params")
+
+    def _params_finite(self) -> bool:
+        """Global all-finite reduction over the float param leaves, run
+        under jit so sharded (incl. multi-host) arrays reduce correctly;
+        the replicated scalar verdict is identical on every process. One
+        rare host read per rollback attempt, never on the step path."""
+        import jax
+        import jax.numpy as jnp
+        leaves = [p for p in jax.tree.leaves(self.engine.params)
+                  if jnp.issubdtype(p.dtype, jnp.floating)]
+        if not leaves:
+            return True
+        ok = jax.jit(lambda ls: jnp.all(jnp.stack(
+            [jnp.all(jnp.isfinite(l)) for l in ls])))(leaves)
+        return bool(ok)  # ds-tpu: lint-ok[TS002] — rollback-only read
+
+    # -- event plumbing ----------------------------------------------------
+    def _emit(self, label: str, value, step: int) -> None:
+        """Host-side event record + the engine's buffered monitor path.
+        Transitions are rare, so flush immediately — a post-mortem must
+        see the rollback event even if the run dies next step."""
+        self.events.append((label, float(value), step))
+        eng = self.engine
+        if getattr(eng, "monitor", None) is not None and eng.monitor.enabled:
+            eng.monitor.write_event(label, float(value), step)

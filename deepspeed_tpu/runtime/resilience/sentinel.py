@@ -1,0 +1,95 @@
+"""Divergence sentinel: on-device bad-step detection, bounded host checks.
+
+A NaN/Inf loss or an exploding grad norm must be *detected* every step
+but *acted on* only rarely — reading a device scalar back to the host
+every step stalls the ICI ring (the exact per-step-sync class the TS002
+lint rule exists to catch; see the PR-2 skipped-steps fix this design
+copies). The sentinel therefore folds each step's health flag into an
+on-device consecutive-bad counter with a handful of eager scalar ops
+(asynchronous dispatch, no sync) and materializes that counter on the
+host only at the ``check_interval`` cadence.
+
+``consecutive`` semantics: ``where(bad, consec + 1, 0)`` per step, so a
+short recovered spike (fewer than ``patience`` bad steps in a row) never
+triggers a rollback — the skipped-step-hysteresis analog of the
+reference's fp16 path applied to bf16/fp32 divergence. The host reads
+the *peak* streak since its last check, so a burst that meets
+``patience`` but ends before the next check boundary is still detected.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+class DivergenceError(RuntimeError):
+    """Training diverged and automatic rollback is exhausted/impossible."""
+
+
+class DivergenceSentinel:
+    """Folds per-step health into device counters; host-reads on demand."""
+
+    def __init__(self, config):
+        self.config = config
+        self._consec = None        # device int32: current consecutive streak
+        self._peak = None          # device int32: max streak since last read
+        self._total_bad = None     # device int32: all-time bad steps
+        self.folds = 0             # host counter: steps folded (trace probe)
+        self.host_reads = 0        # host counter: device->host materializations
+
+    def fold(self, metrics: dict) -> None:
+        """Fold one step's health flag into the device counters. Pure
+        eager jnp scalar ops on values the step already produced —
+        dispatches asynchronously, never blocks on the device."""
+        cfg = self.config
+        loss = metrics.get("loss")
+        gnorm = metrics.get("grad_norm")
+        bad = ~jnp.isfinite(loss)
+        if cfg.loss_abs_threshold > 0:
+            bad = bad | (jnp.abs(loss) > cfg.loss_abs_threshold)
+        if gnorm is not None:
+            bad = bad | ~jnp.isfinite(gnorm)
+            if cfg.grad_norm_threshold > 0:
+                bad = bad | (gnorm > cfg.grad_norm_threshold)
+        skipped = metrics.get("skipped")
+        if skipped is not None:
+            # an fp16 loss-scale overflow step is HANDLED divergence: the
+            # update was skipped and the scaler is already backing off —
+            # counting it here would roll back healthy dynamic-loss-scale
+            # warmup (the scaler's own hysteresis owns that failure mode)
+            bad = bad & (skipped == 0)
+        bad_i = bad.astype(jnp.int32)
+        self._consec = (bad_i if self._consec is None
+                        else jnp.where(bad, self._consec + 1, 0))
+        # peak-since-last-read: a burst that meets patience but ENDS before
+        # the next check boundary must still be detected — the current
+        # streak alone would have been reset to 0 by the first good step
+        self._peak = (self._consec if self._peak is None
+                      else jnp.maximum(self._peak, self._consec))
+        self._total_bad = (bad_i if self._total_bad is None
+                           else self._total_bad + bad_i)
+        self.folds += 1
+
+    def read_consecutive(self) -> int:
+        """Materialize the longest consecutive-bad streak since the last
+        read (ONE host sync; callers must stay on the bounded check
+        cadence). Reading consumes the peak — the next window starts from
+        the still-running current streak."""
+        if self._peak is None:
+            return 0
+        self.host_reads += 1
+        # bounded-cadence read by contract (manager enforces the cadence)
+        peak = int(self._peak)  # ds-tpu: lint-ok[TS002]
+        self._peak = self._consec
+        return peak
+
+    def read_total_bad(self) -> int:
+        if self._total_bad is None:
+            return 0
+        self.host_reads += 1
+        return int(self._total_bad)  # ds-tpu: lint-ok[TS002]
+
+    def reset(self) -> None:
+        """Forget the streak (after a rollback restores good state)."""
+        self._consec = None
+        self._peak = None

@@ -31,6 +31,10 @@ class ServingConfig:
     pipeline_depth: int = 1          # decode dispatches in flight before the
                                      # host reads tokens back (1 overlaps the
                                      # device step with host scheduling)
+    default_deadline_steps: Optional[int] = None
+                                     # queue TTL (engine iterations) applied
+                                     # to requests that don't set their own
+                                     # deadline_steps; None = wait forever
     metrics_interval: int = 50       # engine iterations between monitor
                                      # flushes (never per-step host syncs)
     seed: int = 0
@@ -49,6 +53,11 @@ class ServingConfig:
         if self.pipeline_depth < 0:
             raise ValueError(
                 f"pipeline_depth must be >= 0, got {self.pipeline_depth}")
+        if (self.default_deadline_steps is not None
+                and self.default_deadline_steps < 1):
+            raise ValueError(
+                f"default_deadline_steps must be >= 1 (or null), got "
+                f"{self.default_deadline_steps}")
         if self.metrics_interval < 1:
             raise ValueError(
                 f"metrics_interval must be >= 1, got {self.metrics_interval}")

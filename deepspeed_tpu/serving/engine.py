@@ -190,20 +190,65 @@ class ServingEngine:
 
     # -- client API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               request_id=None, on_token=None) -> Request:
-        """Queue one request; returns its live ``Request`` handle."""
+               request_id=None, on_token=None,
+               deadline_steps: Optional[int] = None) -> Request:
+        """Queue one request; returns its live ``Request`` handle.
+
+        ``deadline_steps`` is a queue TTL on the engine-iteration clock:
+        a request still queued after that many iterations completes with
+        ``timeout`` status instead of waiting forever (default from
+        ``serving.default_deadline_steps``; None = no deadline). Once
+        admitted a request always runs to completion — shedding happens
+        at the queue, never mid-generation."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if max_new_tokens is None:
             max_new_tokens = self.config.default_max_new_tokens
-        self.scheduler.validate_request(prompt.shape[0], max_new_tokens)
+        if deadline_steps is None:
+            deadline_steps = self.config.default_deadline_steps
+        try:
+            self.scheduler.validate_request(prompt.shape[0], max_new_tokens)
+        except ValueError:
+            self.metrics.on_reject()
+            raise
         if request_id is None:
             request_id = self._seq
-        req = Request(prompt, max_new_tokens, request_id, on_token=on_token)
+        req = Request(prompt, max_new_tokens, request_id, on_token=on_token,
+                      deadline_steps=deadline_steps)
         req.submitted_iteration = self._iteration
         self._seq += 1
-        self.scheduler.add(req)
+        try:
+            self.scheduler.add(req)
+        except RuntimeError:
+            self.metrics.on_reject()
+            raise
         self.metrics.on_submit()
         return req
+
+    def cancel(self, request_id) -> bool:
+        """Cancel one request by id: a queued request leaves the queue, an
+        active one releases its slot immediately (its device row is
+        deactivated; already-dispatched decode steps for it are dropped at
+        harvest). Returns False when no live request carries the id."""
+        req = self.scheduler.remove(request_id)
+        if req is not None:
+            req._cancelled(self._iteration)
+            self.metrics.on_cancel(req)
+            return True
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.request_id == request_id:
+                # deactivate the device-side row so in-flight/future decode
+                # iterations mask this slot out, then recycle it
+                self._state = {
+                    **self._state,
+                    "active": self._state["active"].at[slot].set(False),
+                    "remaining": self._state["remaining"].at[slot].set(0),
+                }
+                self._slot_req[slot] = None
+                self._free.append(slot)
+                req._cancelled(self._iteration)
+                self.metrics.on_cancel(req)
+                return True
+        return False
 
     def run(self, max_iterations: Optional[int] = None):
         """Drive admissions/decode/harvest until every submitted request
@@ -233,9 +278,11 @@ class ServingEngine:
 
     # -- engine loop -------------------------------------------------------
     def advance(self):
-        """One engine iteration: admit into free slots, dispatch one
-        decode over the slot batch, harvest readbacks beyond the pipeline
-        depth. Safe to call when idle (no-op)."""
+        """One engine iteration: expire overdue queued requests, admit
+        into free slots, dispatch one decode over the slot batch, harvest
+        readbacks beyond the pipeline depth. Safe to call when idle
+        (no-op)."""
+        self._expire_queued()
         self._admit_ready()
         dispatched = self._dispatch_decode()
         # keep at most pipeline_depth dispatches in flight; drain fully
@@ -248,6 +295,14 @@ class ServingEngine:
                             self.config.num_slots, self._iteration)
         if self._iteration % self.config.metrics_interval == 0:
             self.metrics.flush()
+
+    def _expire_queued(self):
+        """Deadline sweep on the deterministic iteration clock: overdue
+        queued requests complete with ``timeout`` status (load shedding
+        at the queue — admitted requests are never preempted)."""
+        for req in self.scheduler.expire(self._iteration):
+            req._timed_out(self._iteration)
+            self.metrics.on_timeout(req)
 
     def _admit_ready(self):
         while self._free:
@@ -301,6 +356,8 @@ class ServingEngine:
         entry = self._pending.popleft()
         if entry[0] == "admit":
             _, slot, req, tok, done = entry
+            if req.done:     # cancelled between dispatch and readback
+                return
             req._emit(int(np.asarray(tok)), self._iteration)
             self.metrics.on_token()
             if bool(np.asarray(done)):
@@ -310,7 +367,7 @@ class ServingEngine:
         toks = np.asarray(toks)
         done = np.asarray(done)
         for slot, req in enumerate(snapshot):
-            if req is None:
+            if req is None or req.done:   # empty, or cancelled in flight
                 continue
             if toks[slot] >= 0:
                 req._emit(int(toks[slot]), self._iteration)

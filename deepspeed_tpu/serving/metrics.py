@@ -45,6 +45,9 @@ class ServingMetrics:
         self.requests_submitted = 0
         self.requests_admitted = 0
         self.requests_finished = 0
+        self.requests_timed_out = 0    # queued past deadline_steps
+        self.requests_cancelled = 0    # client cancel() (queued or active)
+        self.requests_rejected = 0     # refused at submit (budget/queue cap)
         self.tokens_generated = 0
         self.prefills = 0
         self.decode_iterations = 0
@@ -75,6 +78,15 @@ class ServingMetrics:
 
     def on_token(self):
         self.tokens_generated += 1
+
+    def on_timeout(self, request):
+        self.requests_timed_out += 1
+
+    def on_cancel(self, request):
+        self.requests_cancelled += 1
+
+    def on_reject(self):
+        self.requests_rejected += 1
 
     def on_finish(self, request):
         self.requests_finished += 1
@@ -125,6 +137,9 @@ class ServingMetrics:
             "requests_submitted": self.requests_submitted,
             "requests_admitted": self.requests_admitted,
             "requests_finished": self.requests_finished,
+            "requests_timed_out": self.requests_timed_out,
+            "requests_cancelled": self.requests_cancelled,
+            "requests_rejected": self.requests_rejected,
             "tokens_generated": self.tokens_generated,
             "prefills": self.prefills,
             "decode_iterations": self.decode_iterations,

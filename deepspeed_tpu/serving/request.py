@@ -12,17 +12,27 @@ from typing import Callable, List, Optional
 QUEUED = "queued"
 RUNNING = "running"
 FINISHED = "finished"
+TIMEOUT = "timeout"        # queued past its deadline; never ran
+CANCELLED = "cancelled"    # client cancel()ed it (queued or mid-generation)
+
+TERMINAL = (FINISHED, TIMEOUT, CANCELLED)
 
 
 class Request:
     """One generation request and its streamed result."""
 
     def __init__(self, prompt, max_new_tokens: int, request_id,
-                 on_token: Optional[Callable] = None):
+                 on_token: Optional[Callable] = None,
+                 deadline_steps: Optional[int] = None):
         self.request_id = request_id
         self.prompt = prompt                      # 1-D int32 numpy array
         self.max_new_tokens = int(max_new_tokens)
         self.on_token = on_token
+        # queue TTL in engine iterations: a request still QUEUED when the
+        # engine clock passes submitted_iteration + deadline_steps
+        # completes with TIMEOUT status instead of waiting forever
+        self.deadline_steps = (int(deadline_steps)
+                               if deadline_steps is not None else None)
         self.status = QUEUED
         self.tokens: List[int] = []               # generated tokens, in order
         self.slot: Optional[int] = None
@@ -58,10 +68,28 @@ class Request:
         self.finished_at = time.perf_counter()
         self.finished_iteration = iteration
 
+    def _timed_out(self, iteration: int):
+        self.status = TIMEOUT
+        self.finished_at = time.perf_counter()
+        self.finished_iteration = iteration
+
+    def _cancelled(self, iteration: int):
+        self.slot = None
+        self.status = CANCELLED
+        self.finished_at = time.perf_counter()
+        self.finished_iteration = iteration
+
+    def deadline_iteration(self) -> Optional[int]:
+        """Absolute engine iteration past which a still-queued request
+        expires (None = no deadline)."""
+        if self.deadline_steps is None or self.submitted_iteration is None:
+            return None
+        return self.submitted_iteration + self.deadline_steps
+
     # -- client-side views -------------------------------------------------
     @property
     def done(self) -> bool:
-        return self.status == FINISHED
+        return self.status in TERMINAL
 
     @property
     def output_tokens(self) -> List[int]:

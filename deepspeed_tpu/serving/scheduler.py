@@ -10,10 +10,15 @@ the compiled decode shape).
 FIFO with head-of-line blocking on slot availability only — every
 queued request already fits a slot (submit() validates the token
 budget), so the head never blocks the tail for shape reasons.
+
+Robustness contract: queued requests can carry a ``deadline_steps``
+queue TTL (``expire`` sweeps them out on the engine-iteration clock so a
+saturated server sheds load deterministically instead of growing an
+unbounded backlog), and ``remove`` supports client-side ``cancel()``.
 """
 
 from collections import deque
-from typing import Optional
+from typing import List, Optional
 
 from .request import Request
 
@@ -46,6 +51,28 @@ class FifoScheduler:
         if not self._queue:
             return None
         return self._queue.popleft()
+
+    def expire(self, iteration: int) -> List[Request]:
+        """Remove queued requests whose deadline passed the engine clock
+        (deterministic: the iteration count, not wall time). Callers
+        complete them with ``timeout`` status."""
+        expired = [r for r in self._queue
+                   if r.deadline_iteration() is not None
+                   and iteration >= r.deadline_iteration()]
+        if expired:
+            gone = set(map(id, expired))
+            self._queue = deque(r for r in self._queue
+                                if id(r) not in gone)
+        return expired
+
+    def remove(self, request_id) -> Optional[Request]:
+        """Remove one queued request by id (for ``cancel``); None when no
+        queued request carries that id."""
+        for r in self._queue:
+            if r.request_id == request_id:
+                self._queue.remove(r)
+                return r
+        return None
 
     def validate_request(self, prompt_len: int, max_new_tokens: int):
         """Refuse requests that can never fit a slot — the serving analog

@@ -26,7 +26,7 @@ def _run(args, timeout=120, env_extra=None):
 
 @pytest.mark.parametrize("script", [
     "ds_tpu", "ds_tpu_bench", "ds_tpu_elastic", "ds_tpu_ssh",
-    "ds_tpu_to_universal", "ds_tpu_lint", "ds_tpu_serve"])
+    "ds_tpu_to_universal", "ds_tpu_lint", "ds_tpu_serve", "ds_tpu_chaos"])
 def test_help_exits_zero(script):
     r = _run([os.path.join(BIN, script), "--help"])
     assert r.returncode == 0, r.stderr[-300:]
@@ -102,6 +102,19 @@ def test_serve_synthetic_demo(tmp_path):
     snap = json.loads(out.read_text())
     assert snap["requests_finished"] == 3
     assert snap["tokens_generated"] >= 3
+
+
+def test_chaos_smoke_torn_scenario(tmp_path):
+    """Fast chaos smoke (tier-1): the torn-save scenario must recover —
+    the CLI exits 0 only when the fallback restored a verified tag —
+    and the report JSON records the recovery evidence."""
+    out = tmp_path / "chaos.json"
+    r = _run([os.path.join(BIN, "ds_tpu_chaos"), "--scenario", "torn",
+              "--seed", "0", "--json-out", str(out)], timeout=300)
+    assert r.returncode == 0, r.stdout[-1200:] + r.stderr[-800:]
+    report = json.loads(out.read_text())["scenarios"]["torn"]
+    assert report["ok"] and report["torn_detected"]
+    assert report["fallback_path"].endswith("good")
 
 
 def test_bench_serving_writes_artifact(tmp_path):

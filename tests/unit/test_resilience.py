@@ -1,0 +1,614 @@
+"""Fault-tolerant training (deepspeed_tpu/runtime/resilience/).
+
+Every recovery path is exercised through the deterministic fault
+harness (resilience/faults.py) rather than trusted: torn-checkpoint
+fallback, NaN-burst rollback (bitwise parity with the restored
+checkpoint), emergency-save-on-SIGTERM, watchdog hang detection, and
+the end-to-end chaos acceptance scenario — a run that survives a NaN
+burst + a torn save + a preemption and still matches a fault-free
+reference resumed from the same rollback point.
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import GPT, GPTConfig, gpt_loss_fn
+from deepspeed_tpu.runtime.resilience.faults import Fault, injected
+from deepspeed_tpu.runtime.resilience.manifest import (
+    CheckpointCorruptionError, gc_checkpoints, list_tags, read_manifest,
+    resolve_verified_tag, verify_manifest, write_latest, write_manifest)
+from deepspeed_tpu.runtime.resilience.sentinel import DivergenceError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+VOCAB, SEQ = 128, 16
+MODEL_CFG = GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ, d_model=32,
+                      n_layers=2, n_heads=4, dtype=jnp.float32,
+                      scan_layers=True)
+
+
+def make_batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, VOCAB, size=(n, SEQ), dtype=np.int32)
+    return {"input_ids": ids}
+
+
+def loss_fn(model, params, batch, rng, train):
+    ids = batch["input_ids"]
+    logits = model.apply(params, ids, deterministic=not train)
+    return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
+
+
+def make_engine(ckpt_dir=None, resilience=None, seed=42):
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+    }
+    if resilience is not None:
+        res = dict(resilience)
+        if ckpt_dir is not None:
+            res.setdefault("checkpoint_dir", str(ckpt_dir))
+        cfg["resilience"] = res
+    engine, _, _, _ = ds.initialize(
+        model=GPT(MODEL_CFG), config=cfg, loss_fn=loss_fn,
+        sample_batch=make_batch(1), rng=jax.random.PRNGKey(seed))
+    return engine
+
+
+def params_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def snap(params):
+    # np.array (copy), not np.asarray: on the CPU backend asarray is a
+    # zero-copy view, and the train step DONATES the param buffers
+    return jax.tree.map(lambda x: np.array(x), params)
+
+
+# ---------------------------------------------------------------------------
+# config block
+# ---------------------------------------------------------------------------
+
+class TestResilienceConfig:
+    def test_block_parses(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        cfg = DeepSpeedConfig.from_dict({
+            "train_batch_size": 8,
+            "resilience": {
+                "checkpoint_dir": "/tmp/ck",
+                "integrity": {"algorithm": "sha256", "keep_last_n": 3},
+                "divergence": {"patience": 2, "check_interval": 5},
+                "preemption": {"enabled": True, "signals": ["SIGTERM"]},
+                "watchdog": {"enabled": True, "step_timeout_s": 60},
+            }}, dp_world_size=8)
+        assert cfg.resilience.integrity.algorithm == "sha256"
+        assert cfg.resilience.integrity.keep_last_n == 3
+        assert cfg.resilience.divergence.patience == 2
+        assert cfg.resilience.preemption.enabled
+        assert cfg.resilience.watchdog.step_timeout_s == 60
+
+    def test_bad_values_rejected(self):
+        from deepspeed_tpu.runtime.config_utils import DeepSpeedConfigError
+        from deepspeed_tpu.runtime.resilience.config import (
+            DivergenceConfig, IntegrityConfig, PreemptionConfig)
+        with pytest.raises(DeepSpeedConfigError, match="algorithm"):
+            IntegrityConfig(algorithm="md5")
+        with pytest.raises(DeepSpeedConfigError, match="patience"):
+            DivergenceConfig(patience=0)
+        with pytest.raises(DeepSpeedConfigError, match="signal"):
+            PreemptionConfig(signals=["SIGNOPE"])
+
+
+# ---------------------------------------------------------------------------
+# manifest: integrity, fallback resolution, retention, atomic latest
+# ---------------------------------------------------------------------------
+
+class TestManifest:
+    def _fake_tag(self, root, tag, step, payload=b"x" * 1000):
+        d = root / tag / "state"
+        d.mkdir(parents=True)
+        (d / "data.bin").write_bytes(payload)
+        (root / tag / "meta.json").write_text(json.dumps({"step": step}))
+        write_manifest(str(root / tag), step=step, tag=tag)
+        return root / tag
+
+    def test_roundtrip_and_detection(self, tmp_path):
+        tag = self._fake_tag(tmp_path, "t1", 1)
+        assert verify_manifest(str(tag)) == []
+        m = read_manifest(str(tag))
+        assert m["step"] == 1 and "state/data.bin" in m["files"]
+        # truncation -> size mismatch; rewrite-same-size -> digest mismatch
+        (tag / "state" / "data.bin").write_bytes(b"x" * 500)
+        errs = verify_manifest(str(tag))
+        assert errs and "size" in errs[0]
+        (tag / "state" / "data.bin").write_bytes(b"y" * 1000)
+        errs = verify_manifest(str(tag))
+        assert errs and "crc32" in errs[0]
+        (tag / "state" / "data.bin").unlink()
+        assert any("missing" in e for e in verify_manifest(str(tag)))
+
+    def test_resolve_walks_to_newest_verified(self, tmp_path):
+        self._fake_tag(tmp_path, "t1", 1)
+        self._fake_tag(tmp_path, "t2", 2)
+        t3 = self._fake_tag(tmp_path, "t3", 3)
+        (t3 / "state" / "data.bin").write_bytes(b"torn")
+        # prefer the torn newest -> falls to t2 (not t1)
+        chosen, errors = resolve_verified_tag(str(tmp_path), prefer_tag="t3")
+        assert chosen == "t2" and "t3" in errors
+        # unmanifested prefer tag is honored (legacy checkpoints load)
+        legacy = tmp_path / "legacy"
+        legacy.mkdir()
+        chosen, _ = resolve_verified_tag(str(tmp_path), prefer_tag="legacy")
+        assert chosen == "legacy"
+        # ...but unmanifested tags are never fallback candidates
+        for t in ("t1", "t2"):
+            (tmp_path / t / "state" / "data.bin").write_bytes(b"z")
+        chosen, errors = resolve_verified_tag(str(tmp_path), prefer_tag="t3")
+        assert chosen is None and set(errors) >= {"t1", "t2", "t3"}
+
+    def test_gc_keeps_newest_and_protected(self, tmp_path):
+        for i in range(1, 5):
+            self._fake_tag(tmp_path, f"t{i}", i)
+        write_latest(str(tmp_path), "t1")   # latest protects even the oldest
+        removed = gc_checkpoints(str(tmp_path), keep_last_n=2)
+        assert removed == ["t2"]
+        assert [t for t, _ in list_tags(str(tmp_path))] == ["t4", "t3", "t1"]
+
+    def test_atomic_latest(self, tmp_path):
+        write_latest(str(tmp_path), "tagA")
+        assert (tmp_path / "latest").read_text() == "tagA"
+        assert not (tmp_path / "latest.tmp").exists()
+        write_latest(str(tmp_path), "tagB")
+        assert (tmp_path / "latest").read_text() == "tagB"
+
+
+# ---------------------------------------------------------------------------
+# engine integration: save/load with integrity + fallback
+# ---------------------------------------------------------------------------
+
+def test_engine_save_writes_manifest_and_load_verifies(tmp_path):
+    eng = make_engine()
+    eng.train_batch(make_batch(16, seed=0))
+    eng.save_checkpoint(str(tmp_path), tag="t1")
+    assert verify_manifest(str(tmp_path / "t1")) == []
+    m = read_manifest(str(tmp_path / "t1"))
+    assert m["step"] == 1 and m["algorithm"] == "crc32"
+    # latest written atomically by the shared publication path
+    assert (tmp_path / "latest").read_text() == "t1"
+    assert not (tmp_path / "latest.tmp").exists()
+
+
+def test_torn_checkpoint_falls_back_to_verified_tag(tmp_path):
+    """The tentpole recovery: latest points at a checkpoint with a
+    fault-injected torn shard; load detects the mismatch, restores the
+    previous verified-good tag, and repairs latest."""
+    eng = make_engine()
+    eng.train_batch(make_batch(16, seed=0))
+    eng.save_checkpoint(str(tmp_path), tag="good")
+    good = snap(eng.params)
+    eng.train_batch(make_batch(16, seed=1))
+    with injected([Fault("torn_write", save_index=0)]) as inj:
+        eng.save_checkpoint(str(tmp_path), tag="torn")
+    assert inj.fired and inj.fired[0][0] == "torn_write"
+    assert (tmp_path / "latest").read_text() == "torn"
+    assert verify_manifest(str(tmp_path / "torn"))   # damage detected
+
+    eng2 = make_engine(seed=7)
+    path, _ = eng2.load_checkpoint(str(tmp_path))    # via the torn latest
+    assert path is not None and path.endswith("good")
+    assert params_equal(eng2.params, good)
+    assert eng2.global_steps == 1
+    # latest repaired to the verified-good tag
+    assert (tmp_path / "latest").read_text() == "good"
+
+
+def test_corruption_without_fallback_raises(tmp_path):
+    eng = make_engine(resilience={
+        "integrity": {"fallback_on_corruption": False}})
+    eng.train_batch(make_batch(16, seed=0))
+    with injected([Fault("torn_write", save_index=0)]):
+        eng.save_checkpoint(str(tmp_path), tag="only")
+    with pytest.raises(CheckpointCorruptionError, match="only"):
+        eng.load_checkpoint(str(tmp_path))
+
+
+def test_keep_last_n_gc_on_save(tmp_path):
+    eng = make_engine(resilience={"integrity": {"keep_last_n": 2}})
+    for i in range(4):
+        eng.train_batch(make_batch(16, seed=i))
+        eng.save_checkpoint(str(tmp_path), tag=f"s{i}")
+    tags = {t for t, _ in list_tags(str(tmp_path))}
+    assert tags == {"s2", "s3"}
+    assert (tmp_path / "latest").read_text() == "s3"
+
+
+def test_async_save_publishes_manifest_at_finalize(tmp_path):
+    eng = make_engine()
+    eng.train_batch(make_batch(16, seed=0))
+    eng.save_checkpoint(str(tmp_path), tag="a1", async_save=True)
+    eng.train_batch(make_batch(16, seed=1))
+    assert not (tmp_path / "latest").exists()
+    eng.wait_checkpoint()
+    assert (tmp_path / "latest").read_text() == "a1"
+    assert verify_manifest(str(tmp_path / "a1")) == []
+    eng.destroy()
+
+
+def test_atexit_finalizes_pending_async_save(tmp_path):
+    """A clean interpreter exit must not drop a durable async save: the
+    registered atexit hook joins and publishes it."""
+    from deepspeed_tpu.runtime import checkpointing as ck
+    eng = make_engine()
+    eng.train_batch(make_batch(16, seed=0))
+    eng.save_checkpoint(str(tmp_path), tag="x1", async_save=True)
+    assert eng in ck._PENDING_ENGINES
+    ck._finalize_all_pending()      # what atexit runs on interpreter exit
+    assert (tmp_path / "latest").read_text() == "x1"
+    assert verify_manifest(str(tmp_path / "x1")) == []
+    ck._finalize_all_pending()      # nothing pending: no-op, never raises
+    eng.destroy()
+
+
+# ---------------------------------------------------------------------------
+# divergence sentinel + rollback
+# ---------------------------------------------------------------------------
+
+def test_nan_rollback_restores_checkpoint_bitwise(tmp_path):
+    eng = make_engine(ckpt_dir=tmp_path, resilience={
+        "divergence": {"patience": 2, "check_interval": 1,
+                       "max_rollbacks": 2}})
+    eng.train_batch(make_batch(16, seed=0))
+    eng.train_batch(make_batch(16, seed=1))
+    eng.save_checkpoint(str(tmp_path), tag="good")
+    good = snap(eng.params)
+    with injected([Fault("nan_grads", step=3)]) as inj:
+        for s in range(2, 8):
+            eng.train_batch(make_batch(16, seed=s))
+            if eng.resilience.rollbacks:
+                break
+    assert inj.fired == [("nan_grads", 3)]
+    assert eng.resilience.rollbacks == 1
+    # parity: post-rollback params bitwise-match the restored checkpoint
+    assert params_equal(eng.params, good)
+    assert eng.global_steps == 2
+    labels = [e[0] for e in eng.resilience.events]
+    assert labels == ["resilience/divergence_detected",
+                      "resilience/rollback"]
+    # resume: next step trains finite from the restored state
+    assert np.isfinite(float(eng.train_batch(make_batch(16, seed=99))))
+
+
+def test_rollback_exhaustion_raises(tmp_path):
+    eng = make_engine(ckpt_dir=tmp_path, resilience={
+        "divergence": {"patience": 1, "check_interval": 1,
+                       "max_rollbacks": 0}})
+    eng.train_batch(make_batch(16, seed=0))
+    eng.save_checkpoint(str(tmp_path), tag="g")
+    with injected([Fault("nan_grads", step=2)]):
+        with pytest.raises(DivergenceError, match="max_rollbacks"):
+            for s in range(1, 5):
+                eng.train_batch(make_batch(16, seed=s))
+
+
+def test_divergence_without_checkpoint_raises():
+    eng = make_engine(resilience={
+        "divergence": {"patience": 1, "check_interval": 1}})
+    with injected([Fault("nan_grads", step=1)]):
+        with pytest.raises(DivergenceError, match="no checkpoint"):
+            for s in range(5):
+                eng.train_batch(make_batch(16, seed=s))
+
+
+def test_sentinel_adds_no_per_step_host_sync():
+    """The trace-probe assertion: the sentinel folds health on-device
+    EVERY step but materializes to the host only on the check_interval
+    cadence — and the resilience package lints clean (TS002 guards the
+    rule statically)."""
+    eng = make_engine(resilience={
+        "divergence": {"patience": 3, "check_interval": 4}})
+    sent = eng.resilience.sentinel
+    for s in range(8):
+        eng.train_batch(make_batch(16, seed=s))
+    assert sent.folds == 8          # folded every step (device-side only)
+    assert sent.host_reads == 2     # steps 4 and 8: the bounded cadence
+    assert sent.read_consecutive() == 0
+    assert sent.host_reads == 3     # explicit read = one more sync
+
+
+def test_burst_ending_before_check_boundary_still_detected(tmp_path):
+    """Review regression: a bad streak that meets patience but ENDS before
+    the next check_interval boundary must still trigger — the host reads
+    the PEAK streak since its last check, not just the current one."""
+    eng = make_engine(ckpt_dir=tmp_path, resilience={
+        "divergence": {"patience": 2, "check_interval": 5,
+                       "max_rollbacks": 2}})
+    eng.train_batch(make_batch(16, seed=0))          # step 1
+    eng.save_checkpoint(str(tmp_path), tag="good")
+    good = snap(eng.params)
+    with injected([Fault("nan_grads", step=2)]):
+        eng.train_batch(make_batch(16, seed=1))      # step 2, poison after
+    eng.train_batch(make_batch(16, seed=2))          # step 3: NaN (streak 1)
+    eng.train_batch(make_batch(16, seed=3))          # step 4: NaN (streak 2)
+    # "self-recovery" before the step-5 check: the CURRENT streak resets
+    # to 0 there — only the peak counter can still see the ended burst
+    eng.params = jax.device_put(good, eng.param_shardings)
+    eng.train_batch(make_batch(16, seed=4))          # step 5: finite + check
+    assert eng.resilience.rollbacks == 1
+    assert eng.resilience.events[0][0] == "resilience/divergence_detected"
+    assert eng.resilience.events[0][1] == 2.0        # the peak, not 0
+
+
+def test_explicit_tag_corruption_raises_not_substitutes(tmp_path):
+    """Review regression: load_checkpoint(tag=...) naming a corrupt tag
+    must raise, never silently restore a different step; latest-driven
+    loads keep the fallback walk."""
+    eng = make_engine()
+    eng.train_batch(make_batch(16, seed=0))
+    eng.save_checkpoint(str(tmp_path), tag="good")
+    eng.train_batch(make_batch(16, seed=1))
+    with injected([Fault("torn_write", save_index=0)]):
+        eng.save_checkpoint(str(tmp_path), tag="torn")
+    eng2 = make_engine(seed=3)
+    with pytest.raises(CheckpointCorruptionError, match="explicitly"):
+        eng2.load_checkpoint(str(tmp_path), tag="torn")
+    path, _ = eng2.load_checkpoint(str(tmp_path))    # latest: falls back
+    assert path is not None and path.endswith("good")
+
+
+def test_async_manifest_records_save_time_step(tmp_path):
+    """Review regression: an async save finalized steps later must stamp
+    the manifest with the step the checkpoint was TAKEN at (tag ordering
+    and GC key off it), not the finalize-time step counter."""
+    eng = make_engine()
+    eng.train_batch(make_batch(16, seed=0))
+    eng.save_checkpoint(str(tmp_path), tag="a1", async_save=True)
+    eng.train_batch(make_batch(16, seed=1))
+    eng.train_batch(make_batch(16, seed=2))
+    eng.wait_checkpoint()                            # finalizes at step 3
+    assert read_manifest(str(tmp_path / "a1"))["step"] == 1
+    eng.destroy()
+
+
+def test_fp16_overflow_skips_are_not_divergence():
+    """Review regression: an fp16 loss-scale overflow step (skipped
+    update, scaler backing off) is HANDLED divergence — the sentinel must
+    not count it, or dynamic-loss-scale warmup rolls back healthy runs."""
+    from deepspeed_tpu.runtime.resilience.config import DivergenceConfig
+    from deepspeed_tpu.runtime.resilience.sentinel import DivergenceSentinel
+    sent = DivergenceSentinel(DivergenceConfig(patience=1, check_interval=1))
+    inf = jnp.float32(np.inf)
+    for _ in range(3):   # overflow burst, all skipped by the loss scaler
+        sent.fold({"loss": jnp.float32(2.0), "grad_norm": inf,
+                   "skipped": jnp.int32(1)})
+    assert sent.read_consecutive() == 0
+    # the same non-finite signal on an APPLIED step still counts
+    sent.fold({"loss": jnp.float32(2.0), "grad_norm": inf,
+               "skipped": jnp.int32(0)})
+    assert sent.read_consecutive() == 1
+
+
+def test_rollback_quarantines_manifest_valid_nan_checkpoint(tmp_path):
+    """Review regression: a save landing inside an undetected divergence
+    window is integrity-valid NaN state; rollback must detect the
+    non-finite restore, quarantine that tag, and walk on to the older
+    genuinely-good tag instead of looping to max_rollbacks."""
+    eng = make_engine(ckpt_dir=tmp_path, resilience={
+        "divergence": {"patience": 2, "check_interval": 10,
+                       "max_rollbacks": 2}})
+    eng.train_batch(make_batch(16, seed=0))
+    eng.save_checkpoint(str(tmp_path), tag="healthy")
+    good = snap(eng.params)
+    with injected([Fault("nan_grads", step=2)]):
+        eng.train_batch(make_batch(16, seed=1))      # poisoned after step 2
+    # periodic save INSIDE the undetected window: manifest-valid NaN state
+    eng.save_checkpoint(str(tmp_path), tag="nan_but_valid")
+    assert verify_manifest(str(tmp_path / "nan_but_valid")) == []
+    for s in range(2, 12):                           # run into the check
+        eng.train_batch(make_batch(16, seed=s))
+        if eng.resilience.rollbacks:
+            break
+    assert eng.resilience.rollbacks == 1             # ONE rollback, not max
+    assert params_equal(eng.params, good)            # the healthy tag won
+    assert eng.global_steps == 1
+    labels = [e[0] for e in eng.resilience.events]
+    assert "resilience/checkpoint_quarantined" in labels
+    # the NaN tag is out of the walk but kept on disk for post-mortem
+    assert (tmp_path / "nan_but_valid" / "manifest.json.quarantined").exists()
+    chosen, errors = resolve_verified_tag(str(tmp_path),
+                                          prefer_tag="nan_but_valid")
+    assert chosen == "healthy"
+    assert "quarantined" in errors["nan_but_valid"][0]
+    assert (tmp_path / "latest").read_text() == "healthy"
+
+
+def test_unknown_manifest_algorithm_is_error_not_crash(tmp_path):
+    """Review regression: a parseable manifest with an unknown digest
+    algorithm (corrupt field / newer framework) must yield a verification
+    error — the corruption-fallback path cannot itself crash."""
+    d = tmp_path / "t" / "state"
+    d.mkdir(parents=True)
+    (d / "data.bin").write_bytes(b"x" * 100)
+    write_manifest(str(tmp_path / "t"), step=1, tag="t")
+    m = json.loads((tmp_path / "t" / "manifest.json").read_text())
+    m["algorithm"] = "sha512"
+    (tmp_path / "t" / "manifest.json").write_text(json.dumps(m))
+    errs = verify_manifest(str(tmp_path / "t"))
+    assert errs and "unknown digest algorithm" in errs[0]
+    chosen, errors = resolve_verified_tag(str(tmp_path), prefer_tag="t")
+    assert chosen is None and "t" in errors
+
+
+def test_load_module_params_missing_tag_is_file_not_found(tmp_path):
+    from deepspeed_tpu.runtime.checkpointing import load_module_params
+    with pytest.raises(FileNotFoundError, match="does not exist"):
+        load_module_params(str(tmp_path), tag="no_such_tag")
+
+
+def test_resilience_package_lints_clean():
+    """CI gate: deepspeed_tpu/runtime/resilience/ ships with ZERO lint
+    findings (trace-safety TS* incl. the host-sync rule, and PY001)."""
+    from deepspeed_tpu.analysis.cli import main as lint_main
+    assert lint_main([os.path.join(REPO_ROOT, "deepspeed_tpu", "runtime",
+                                   "resilience"), "-q"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# preemption + watchdog
+# ---------------------------------------------------------------------------
+
+def test_emergency_save_on_sigterm(tmp_path):
+    """In-process SIGTERM: the handler joins pending saves, writes a
+    verified emergency checkpoint, and chains to the prior handler."""
+    chained = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+    try:
+        eng = make_engine(ckpt_dir=tmp_path, resilience={
+            "preemption": {"enabled": True, "signals": ["SIGTERM"]}})
+        eng.train_batch(make_batch(16, seed=0))
+        before = snap(eng.params)
+        os.kill(os.getpid(), signal.SIGTERM)
+        handler = eng.resilience.preemption
+        assert handler.triggered == signal.SIGTERM
+        assert handler.saved_path is not None
+        assert chained == [signal.SIGTERM]          # prior handler ran
+        tag = (tmp_path / "latest").read_text()
+        assert tag == "emergency_step1"
+        assert verify_manifest(str(tmp_path / tag)) == []
+        eng2 = make_engine(seed=9)
+        path, _ = eng2.load_checkpoint(str(tmp_path))
+        assert path is not None and params_equal(eng2.params, before)
+        # destroy() uninstalls: the chained recorder is current again
+        eng.destroy()
+        assert signal.getsignal(signal.SIGTERM) is not handler._handle
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_preempt_fault_joins_inflight_async_save(tmp_path):
+    """Emergency save first finalizes the in-flight async save, so BOTH
+    checkpoints are durable and verified after the signal."""
+    eng = make_engine(ckpt_dir=tmp_path, resilience={
+        "preemption": {"enabled": True, "signals": ["SIGTERM"],
+                       "chain_handler": False}})
+    eng.train_batch(make_batch(16, seed=0))
+    eng.save_checkpoint(str(tmp_path), tag="async1", async_save=True)
+    with injected([Fault("preempt", step=1,
+                         signum=int(signal.SIGTERM))]) as inj:
+        eng.train_batch(make_batch(16, seed=1))
+    assert inj.fired == [("preempt", 1)]
+    assert verify_manifest(str(tmp_path / "async1")) == []
+    assert verify_manifest(str(tmp_path / "emergency_step1")) == []
+    assert (tmp_path / "latest").read_text() == "emergency_step1"
+    eng.destroy()
+
+
+def test_watchdog_fires_on_hang_with_diagnostics():
+    from deepspeed_tpu.runtime.resilience.preemption import Watchdog
+
+    class FakeEngine:
+        global_steps = 17
+        _pending_ckpt = ("/ck", "t", True)
+
+    reports = []
+    wd = Watchdog(FakeEngine(), step_timeout_s=0.15, poll_interval_s=0.03,
+                  abort_fn=reports.append).start()
+    import time
+    wd.step_started()
+    time.sleep(0.5)
+    assert wd.fired
+    assert "last completed step: 17" in reports[0]
+    assert "pending async checkpoint: ('/ck', 't', True)" in reports[0]
+    assert "stack" in reports[0]
+    wd.stop()
+
+
+def test_watchdog_disarms_between_steps():
+    from deepspeed_tpu.runtime.resilience.preemption import Watchdog
+    wd = Watchdog(object(), step_timeout_s=0.1, poll_interval_s=0.02,
+                  abort_fn=lambda r: None).start()
+    import time
+    wd.step_started()
+    wd.step_finished()
+    time.sleep(0.3)     # idle time after a completed step never trips it
+    assert not wd.fired
+    wd.stop()
+
+
+def test_delay_fault_trips_engine_watchdog():
+    eng = make_engine(resilience={
+        "divergence": {"enabled": False},
+        "watchdog": {"enabled": True, "step_timeout_s": 0.3,
+                     "poll_interval_s": 0.05}})
+    reports = []
+    eng.resilience.watchdog._abort_fn = reports.append
+    with injected([Fault("delay_step", step=0, duration_s=1.0)]):
+        eng.train_batch(make_batch(16, seed=0))
+    assert eng.resilience.watchdog.fired and reports
+    assert "stuck" in reports[0]
+    eng.destroy()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos acceptance scenario
+# ---------------------------------------------------------------------------
+
+def test_chaos_end_to_end_nan_torn_preempt(tmp_path):
+    """The acceptance criterion: one run survives (a) an injected NaN
+    burst, (b) a torn write on the next save, (c) a simulated preemption
+    — and finishes with a verified-good latest checkpoint and final
+    params IDENTICAL to a fault-free reference resumed from the same
+    rollback point."""
+    eng = make_engine(ckpt_dir=tmp_path, resilience={
+        "divergence": {"patience": 2, "check_interval": 1,
+                       "max_rollbacks": 2},
+        "preemption": {"enabled": True, "signals": ["SIGTERM"],
+                       "chain_handler": False}})
+    steps = 6
+    # healthy prefix, anchor checkpoint at step 2 (the rollback point)
+    while eng.global_steps < 2:
+        eng.train_batch(make_batch(16, seed=eng.global_steps + 1))
+    eng.save_checkpoint(str(tmp_path), tag="anchor")
+    with injected([Fault("nan_grads", step=3),
+                   Fault("torn_write", save_index=0),
+                   Fault("preempt", step=5,
+                         signum=int(signal.SIGTERM))]) as inj:
+        while eng.global_steps < 4:     # (a) burst lands after step 3
+            eng.train_batch(make_batch(16, seed=eng.global_steps + 1))
+        eng.save_checkpoint(str(tmp_path), tag="torn")   # (b) save tears
+        while eng.global_steps < steps:  # detection -> rollback -> (c)
+            eng.train_batch(make_batch(16, seed=eng.global_steps + 1))
+    assert [k for k, _ in inj.fired] == ["nan_grads", "torn_write",
+                                         "preempt"]
+    assert eng.resilience.rollbacks == 1
+    assert eng.resilience.preemption.triggered == signal.SIGTERM
+    final = snap(eng.params)
+
+    # fault-free reference resumed from the same rollback point, same
+    # step-keyed batches
+    ref = make_engine(seed=5)
+    ref.load_checkpoint(str(tmp_path), tag="anchor")
+    while ref.global_steps < steps:
+        ref.train_batch(make_batch(16, seed=ref.global_steps + 1))
+    assert params_equal(final, ref.params)
+
+    # the surviving latest resolves to a verified-good tag and loads
+    tag, _errors = resolve_verified_tag(str(tmp_path))
+    assert tag is not None and verify_manifest(str(tmp_path / tag)) == []
+    eng3 = make_engine(seed=11)
+    path, _ = eng3.load_checkpoint(str(tmp_path))
+    assert path is not None
+    eng.destroy()

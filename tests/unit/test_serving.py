@@ -414,3 +414,93 @@ def test_serving_subsystem_lints_clean():
     from deepspeed_tpu.analysis.cli import main as lint_main
     assert lint_main([os.path.join(REPO_ROOT, "deepspeed_tpu", "serving"),
                       "-q"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# robustness: queue deadlines (TTL), cancel, timeout/rejection counters
+# ---------------------------------------------------------------------------
+
+class TestServingRobustness:
+    def test_queued_request_times_out_on_deadline(self):
+        """1 slot, a long-running head request, a queued request with a
+        tight deadline: the queued one completes with `timeout` status
+        instead of waiting forever, and never consumes a slot."""
+        m, params = _model(vocab=61)
+        eng = ServingEngine(m, params,
+                            ServingConfig(num_slots=1, max_len=128,
+                                          prefill_bucket=16))
+        r = np.random.RandomState(0)
+        head = eng.submit(r.randint(1, 61, size=4), max_new_tokens=12)
+        late = eng.submit(r.randint(1, 61, size=4), max_new_tokens=4,
+                          deadline_steps=3)
+        eng.run()
+        assert head.status == "finished"
+        assert len(head.output_tokens) == 12
+        assert late.status == "timeout"
+        assert late.done and late.output_tokens == []
+        assert late.finished_iteration is not None
+        snap = eng.metrics.snapshot()
+        assert snap["requests_timed_out"] == 1
+        assert snap["requests_finished"] == 1
+
+    def test_deadline_from_config_default(self):
+        m, params = _model(vocab=61)
+        eng = ServingEngine(m, params,
+                            ServingConfig(num_slots=1, max_len=128,
+                                          prefill_bucket=16,
+                                          default_deadline_steps=2))
+        r = np.random.RandomState(1)
+        head = eng.submit(r.randint(1, 61, size=4), max_new_tokens=10)
+        late = eng.submit(r.randint(1, 61, size=4), max_new_tokens=4)
+        assert late.deadline_steps == 2        # inherited from the config
+        eng.run()
+        assert head.status == "finished"       # admitted before expiry
+        assert late.status == "timeout"
+
+    def test_cancel_queued_and_active(self):
+        """cancel() frees a queued entry without touching slots, and an
+        active cancel releases the slot immediately for the next queued
+        request (which must still decode correctly)."""
+        from deepspeed_tpu.inference.generation import generate as gen
+        m, params = _model(vocab=61)
+        eng = ServingEngine(m, params,
+                            ServingConfig(num_slots=1, max_len=128,
+                                          prefill_bucket=16))
+        r = np.random.RandomState(2)
+        active = eng.submit(r.randint(1, 61, size=5), max_new_tokens=20,
+                            request_id="active")
+        queued = eng.submit(r.randint(1, 61, size=5), max_new_tokens=3,
+                            request_id="queued")
+        tail_prompt = r.randint(1, 61, size=5)
+        tail = eng.submit(tail_prompt, max_new_tokens=4, request_id="tail")
+        eng.advance()                           # admit `active`, 1 decode
+        assert eng.cancel("queued") is True
+        assert queued.status == "cancelled" and queued.done
+        assert eng.cancel("active") is True     # frees the only slot
+        assert active.status == "cancelled" and active.slot is None
+        assert eng.cancel("nope") is False      # unknown id
+        assert eng.cancel("active") is False    # already terminal
+        eng.run()
+        assert tail.status == "finished"
+        ref = np.asarray(gen(m, params, tail_prompt[None], max_new_tokens=4,
+                             temperature=0.0, max_len=128))[0, 5:]
+        np.testing.assert_array_equal(np.asarray(tail.output_tokens), ref)
+        snap = eng.metrics.snapshot()
+        assert snap["requests_cancelled"] == 2
+        assert snap["requests_finished"] == 1
+        # cancelled requests must not have streamed tokens post-cancel
+        assert len(active.output_tokens) <= 2   # admit token + <=1 decode
+
+    def test_rejection_counters(self):
+        m, params = _model(vocab=61)
+        eng = ServingEngine(m, params,
+                            ServingConfig(num_slots=1, max_len=32,
+                                          prefill_bucket=16, max_queue=1))
+        r = np.random.RandomState(3)
+        with pytest.raises(ValueError, match="per-slot budget"):
+            eng.submit(r.randint(1, 61, size=30), max_new_tokens=10)
+        eng.submit(r.randint(1, 61, size=4), max_new_tokens=2)
+        with pytest.raises(RuntimeError, match="queue full"):
+            eng.submit(r.randint(1, 61, size=4), max_new_tokens=2)
+        assert eng.metrics.snapshot()["requests_rejected"] == 2
+        eng.run()
