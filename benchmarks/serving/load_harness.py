@@ -97,6 +97,36 @@ def run_benchmark(args):
         vocab_size=args.vocab_size)
     handles = replay(engine, trace)
 
+    # decode-side performance accounting (docs/observability.md): the
+    # static estimator prices one generated token at a full forward over
+    # the mean realized context; MFU needs a peak figure (chip table on
+    # TPU, --peak-tflops elsewhere)
+    from deepspeed_tpu.observability.perf import (CHIP_PEAK_TFLOPS,
+                                                  detect_chip)
+    from deepspeed_tpu.profiling.flops_profiler import (
+        _count_params, transformer_flops_per_token)
+    n_params = _count_params(params)
+    ctxs = [len(t["prompt"]) + len(h.output_tokens)
+            for t, h in zip(trace, handles)]
+    mean_ctx = float(np.mean(ctxs)) if ctxs else 0.0
+    flops_per_token = transformer_flops_per_token(
+        n_params, args.n_layers, args.d_model, mean_ctx, backward=False)
+    peak_tflops = args.peak_tflops
+    if peak_tflops is None:
+        chip = detect_chip()
+        peak_tflops = CHIP_PEAK_TFLOPS.get(chip) if chip else None
+    agg = engine.metrics.snapshot()
+    tok_s = agg.get("throughput_tokens_per_s", 0.0)
+    perf = {
+        "n_params": n_params,
+        "mean_context_tokens": mean_ctx,
+        "flops_per_token_fwd": flops_per_token,
+        "achieved_tflops": tok_s * flops_per_token / 1e12,
+        "peak_tflops": peak_tflops,
+        "mfu": (tok_s * flops_per_token / (peak_tflops * 1e12)
+                if peak_tflops else None),
+    }
+
     per_request = []
     for t, h in zip(trace, handles):
         per_request.append({
@@ -122,7 +152,8 @@ def run_benchmark(args):
                   "mean_interarrival": args.mean_interarrival,
                   "prompt_len_range": [args.min_prompt, args.max_prompt],
                   "output_len_range": [args.min_output, args.max_output]},
-        "aggregate": engine.metrics.snapshot(),
+        "aggregate": agg,
+        "perf": perf,
         "per_request": per_request,
     }
 
@@ -148,6 +179,10 @@ def build_parser():
     p.add_argument("--n-layers", type=int, default=2)
     p.add_argument("--n-heads", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--peak-tflops", type=float, default=None,
+                   help="chip peak TFLOP/s for the artifact's MFU field "
+                        "(defaults to the detected chip's table entry; "
+                        "null when unknown)")
     p.add_argument("--out", default="BENCH_serving.json")
     return p
 

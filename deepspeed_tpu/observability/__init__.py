@@ -1,0 +1,120 @@
+"""Unified observability: trace spans, metrics registry, MFU accounting.
+
+Three layers behind one ``observability`` config block
+(docs/observability.md):
+
+- **Trace spans** (trace.py): ``span("fwd")`` host wall-clock intervals
+  in a bounded ring buffer, xprof-aligned via
+  ``jax.profiler.TraceAnnotation``, dumpable as Chrome-trace JSON.
+- **Metrics registry** (metrics.py): counters/gauges/histograms shared
+  by engine throughput, ServingMetrics, and resilience counters;
+  flushed through the MonitorMaster fan-out on the metrics cadence.
+- **Performance accounting** (perf.py): step-time p50/p95, tokens/sec,
+  and MFU against the chip peak-FLOPs table, fed by the static
+  per-model FLOPs estimator (profiling/flops_profiler).
+
+``Observability`` below bundles the three for the engines: it gates the
+module-global tracer to the configured capture window, runs the
+bounded-cadence device probe, and owns the flush cadence. Everything
+obeys the no-per-step-host-sync rule (ds_tpu_lint TS002 gates this
+package at zero findings).
+"""
+
+from .config import ObservabilityConfig
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      get_registry)
+from .perf import (CHIP_PEAK_TFLOPS, PerfAccountant, detect_chip,
+                   resolve_peak_flops)
+from .trace import (DeviceProbe, Tracer, activate, active_tracer,
+                    chrome_trace_events, deactivate, format_summary, span,
+                    summarize, summarize_trace_file, write_chrome_trace)
+
+__all__ = [
+    "ObservabilityConfig", "Observability",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "CHIP_PEAK_TFLOPS", "PerfAccountant", "detect_chip",
+    "resolve_peak_flops",
+    "DeviceProbe", "Tracer", "activate", "active_tracer",
+    "chrome_trace_events", "deactivate", "format_summary", "span",
+    "summarize", "summarize_trace_file", "write_chrome_trace",
+]
+
+
+class Observability:
+    """Engine-facing bundle: window-gated tracer + registry + perf.
+
+    ``begin_step(step)`` opens/closes the trace window (activating the
+    module-global tracer so ``span()`` call sites across the codebase
+    light up together); ``end_step(step, sync_value, tokens)`` runs the
+    bounded-cadence device probe and feeds the step-time window.
+    """
+
+    def __init__(self, config: ObservabilityConfig, *,
+                 steps_per_print: int = 10, registry=None):
+        self.config = config
+        self.registry = registry if registry is not None else get_registry()
+        self.tracer = Tracer(max_events=config.trace_buffer_events)
+        self.probe = DeviceProbe(config.probe_interval)
+        self.perf = PerfAccountant(window=config.perf_window,
+                                   peak_flops=resolve_peak_flops(config))
+        self.metrics_interval = (config.metrics_interval
+                                 if config.metrics_interval is not None
+                                 else max(1, int(steps_per_print)))
+        self._window_open = False
+
+    def window_contains(self, step: int) -> bool:
+        cfg = self.config
+        if not cfg.trace or step < cfg.trace_start_step:
+            return False
+        return (cfg.trace_num_steps <= 0
+                or step < cfg.trace_start_step + cfg.trace_num_steps)
+
+    def begin_step(self, step: int):
+        """Honor the capture window for the step about to run. Cheap
+        host arithmetic; flips the module tracer only on window edges.
+        An externally activated tracer (ds_tpu_bench --trace) owns the
+        span stream for the whole process — the window never steals it
+        or shuts it off."""
+        want = self.window_contains(step)
+        if want and not self._window_open:
+            cur = active_tracer()
+            if cur is None or cur is self.tracer:
+                activate(self.tracer)
+                self._window_open = True
+        elif not want and self._window_open:
+            if active_tracer() is self.tracer:
+                deactivate()
+            self._window_open = False
+
+    def end_step(self, step: int, sync_value=None, tokens=None):
+        """Post-step hook: device probe on its bounded cadence, then the
+        wall-clock step-time sample. No other host sync happens here."""
+        self.probe.maybe_block(sync_value, step)
+        self.perf.on_step(tokens)
+
+    def close(self):
+        """Release the module tracer if this bundle holds it."""
+        if self._window_open and active_tracer() is self.tracer:
+            deactivate()
+        self._window_open = False
+
+    # -- reporting ---------------------------------------------------------
+    def trace_summary(self) -> dict:
+        return summarize(self.tracer.events)
+
+    def write_trace(self, path: str) -> str:
+        meta = {"dropped_events": self.tracer.dropped}
+        return write_chrome_trace(self.tracer.events, path, metadata=meta)
+
+    def snapshot(self) -> dict:
+        """Registry snapshot + perf summary + probe counters, JSON-able
+        (the ``ds_tpu_trace --metrics-out`` / ``ds_tpu_report`` payload)."""
+        return {
+            "registry": self.registry.snapshot(),
+            "perf": self.perf.summary(),
+            "probe": {"interval": self.probe.interval,
+                      "host_reads": self.probe.host_reads,
+                      "last_wait_s": self.probe.last_wait_s},
+            "trace": {"events_buffered": len(self.tracer.events),
+                      "events_dropped": self.tracer.dropped},
+        }

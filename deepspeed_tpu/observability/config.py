@@ -1,0 +1,71 @@
+"""Observability configuration (the ``observability`` config block).
+
+Stdlib-only on purpose: ``runtime/config.py`` imports this dataclass to
+wire the block into ``DeepSpeedConfig``, and that module must stay
+importable without jax (the ds_tpu_lint job runs dependency-free).
+"""
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class ObservabilityConfig:
+    """Unified observability knobs (docs/observability.md).
+
+    One block drives three layers: host-side trace spans (Chrome-trace /
+    Perfetto dumpable, xprof-aligned via ``jax.profiler.TraceAnnotation``),
+    the process metrics registry flushed through the monitor fan-out, and
+    MFU / step-time performance accounting. Everything here obeys the
+    no-per-step-host-sync rule: spans are host wall-clock only, and the
+    single sanctioned ``block_until_ready`` probe runs on the bounded
+    ``probe_interval`` cadence (the PR-4 sentinel discipline).
+    """
+    enabled: bool = False
+    trace: bool = True               # record host spans while the window
+                                     # below is open (enabled=true only)
+    trace_start_step: int = 1        # first global step of the capture window
+    trace_num_steps: int = 0         # window length; 0 = to end of run
+    trace_buffer_events: int = 100_000
+                                     # span ring-buffer capacity (oldest
+                                     # events drop first; Tracer.dropped
+                                     # counts evictions)
+    metrics_interval: Optional[int] = None
+                                     # steps between registry/perf flushes
+                                     # through the monitor; None = the
+                                     # engine's steps_per_print cadence
+    probe_interval: int = 0          # device-accurate step-time probe: one
+                                     # block_until_ready every N steps
+                                     # (0 = never; keep >= steps_per_print
+                                     # scale on real hardware)
+    perf_window: int = 256           # step-time sliding window for p50/p95
+    peak_tflops: Optional[float] = None
+                                     # per-chip peak (bf16) override for MFU;
+                                     # None = look up `chip` / the detected
+                                     # device kind in perf.CHIP_PEAK_TFLOPS
+    chip: Optional[str] = None       # chip-table key override ("tpu-v4", ...)
+
+    def __post_init__(self):
+        if self.trace_start_step < 0:
+            raise ValueError(f"observability.trace_start_step must be >= 0, "
+                             f"got {self.trace_start_step}")
+        if self.trace_num_steps < 0:
+            raise ValueError(f"observability.trace_num_steps must be >= 0, "
+                             f"got {self.trace_num_steps}")
+        if self.trace_buffer_events < 1:
+            raise ValueError(
+                f"observability.trace_buffer_events must be >= 1, got "
+                f"{self.trace_buffer_events}")
+        if self.probe_interval < 0:
+            raise ValueError(f"observability.probe_interval must be >= 0, "
+                             f"got {self.probe_interval}")
+        if self.perf_window < 2:
+            raise ValueError(f"observability.perf_window must be >= 2, got "
+                             f"{self.perf_window}")
+        if self.metrics_interval is not None and self.metrics_interval < 1:
+            raise ValueError(
+                f"observability.metrics_interval must be >= 1 (or null), "
+                f"got {self.metrics_interval}")
+        if self.peak_tflops is not None and self.peak_tflops <= 0:
+            raise ValueError(f"observability.peak_tflops must be > 0, got "
+                             f"{self.peak_tflops}")

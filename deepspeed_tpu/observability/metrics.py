@@ -1,0 +1,193 @@
+"""Process metrics registry: counters, gauges, histograms.
+
+ONE registry (``get_registry()``) is shared by every telemetry producer
+— engine throughput/perf accounting, ``ServingMetrics`` mirrors, and the
+resilience event counters — so "what is this process doing" is a single
+``snapshot()`` instead of four private buffers. Values are plain host
+floats/ints: recording a metric never touches the device (the monitor
+buffering in runtime/engine.py owns the one batched device_get per
+flush cadence).
+
+Stdlib-only so the registry works in dependency-free contexts (the lint
+job, ``ds_tpu_report`` on a login node).
+"""
+
+import json
+from collections import deque
+from typing import Callable, Dict, Optional
+
+DEFAULT_HISTOGRAM_WINDOW = 512
+
+
+def percentile(values, q):
+    """Nearest-rank percentile (rounded index over the sorted values);
+    None on empty input. The one percentile implementation every
+    telemetry producer shares — trace summaries, the registry
+    histograms, perf accounting, and ServingMetrics all delegate here
+    so the same q over the same data always picks the same element."""
+    if not values:
+        return None
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+class Counter:
+    """Monotonic event count."""
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = None
+
+    def set(self, v):
+        self.value = v
+
+
+class Histogram:
+    """Sliding-window distribution (p50/p95 over the most recent
+    ``window`` observations — the long-lived-server convention; all-time
+    count/sum ride along)."""
+    __slots__ = ("name", "window", "count", "total")
+
+    def __init__(self, name: str, window: int = DEFAULT_HISTOGRAM_WINDOW):
+        self.name = name
+        self.window = deque(maxlen=max(1, int(window)))
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, v):
+        self.window.append(float(v))
+        self.count += 1
+        self.total += float(v)
+
+    def percentile(self, q):
+        return percentile(self.window, q)
+
+    def summary(self) -> dict:
+        out = {"count": self.count, "sum": self.total}
+        if self.window:
+            out["mean"] = sum(self.window) / len(self.window)
+            out["p50"] = self.percentile(50)
+            out["p95"] = self.percentile(95)
+            out["max"] = max(self.window)
+        return out
+
+
+class MetricsRegistry:
+    """Named-instrument registry. ``counter``/``gauge``/``histogram``
+    get-or-create (a name keeps its first kind; a kind clash raises);
+    ``register_collector`` attaches a callable polled at snapshot time
+    for subsystems that already keep their own state (ServingMetrics)."""
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._collectors: Dict[str, Callable[[], dict]] = {}
+
+    def _check_free(self, name, own):
+        for kind, table in (("counter", self._counters),
+                            ("gauge", self._gauges),
+                            ("histogram", self._hists)):
+            if table is not own and name in table:
+                raise ValueError(
+                    f"metric {name!r} already registered as a {kind}")
+
+    def counter(self, name: str) -> Counter:
+        if name not in self._counters:
+            self._check_free(name, self._counters)
+            self._counters[name] = Counter(name)
+        return self._counters[name]
+
+    def gauge(self, name: str) -> Gauge:
+        if name not in self._gauges:
+            self._check_free(name, self._gauges)
+            self._gauges[name] = Gauge(name)
+        return self._gauges[name]
+
+    def histogram(self, name: str,
+                  window: int = DEFAULT_HISTOGRAM_WINDOW) -> Histogram:
+        if name not in self._hists:
+            self._check_free(name, self._hists)
+            self._hists[name] = Histogram(name, window)
+        return self._hists[name]
+
+    def register_collector(self, name: str, fn: Callable[[], dict]):
+        """``fn()`` returns a flat {metric: value} dict merged into
+        snapshots under ``collected.<name>``."""
+        self._collectors[name] = fn
+
+    def snapshot(self) -> dict:
+        """JSON-able state of every instrument (plus collector polls)."""
+        out = {
+            "counters": {n: c.value for n, c in sorted(self._counters.items())},
+            "gauges": {n: g.value for n, g in sorted(self._gauges.items())
+                       if g.value is not None},
+            "histograms": {n: h.summary()
+                           for n, h in sorted(self._hists.items())},
+        }
+        if self._collectors:
+            out["collected"] = {n: fn()
+                                for n, fn in sorted(self._collectors.items())}
+        return out
+
+    def to_events(self, step: int):
+        """Flatten to monitor-fan-out ``(label, value, step)`` events.
+        Histograms emit their p50/p95 under ``<name>/p50`` etc."""
+        events = []
+        for n, c in sorted(self._counters.items()):
+            events.append((n, c.value, step))
+        for n, g in sorted(self._gauges.items()):
+            if g.value is not None:
+                events.append((n, g.value, step))
+        for n, h in sorted(self._hists.items()):
+            p50, p95 = h.percentile(50), h.percentile(95)
+            if p50 is not None:
+                events.append((f"{n}/p50", p50, step))
+                events.append((f"{n}/p95", p95, step))
+        return events
+
+    def flush_to_monitor(self, monitor, step: int):
+        """Hand the current values to a MonitorMaster-like fan-out
+        (host floats only; gated to the caller's cadence)."""
+        if monitor is None or not getattr(monitor, "enabled", False):
+            return
+        events = self.to_events(step)
+        if events:
+            monitor.write_events(events)
+
+    def write_json(self, path: str):
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f, indent=1, default=str)
+        return path
+
+    def reset(self):
+        """Drop every instrument and collector (test isolation)."""
+        self._counters.clear()
+        self._gauges.clear()
+        self._hists.clear()
+        self._collectors.clear()
+
+
+_DEFAULT_REGISTRY: Optional[MetricsRegistry] = None
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide shared registry."""
+    global _DEFAULT_REGISTRY
+    if _DEFAULT_REGISTRY is None:
+        _DEFAULT_REGISTRY = MetricsRegistry()
+    return _DEFAULT_REGISTRY

@@ -1,3 +1,5 @@
-from .flops_profiler import FlopsProfiler, get_model_profile
+from .flops_profiler import (FlopsProfiler, estimate_step_flops,
+                             get_model_profile, transformer_flops_per_token)
 
-__all__ = ["FlopsProfiler", "get_model_profile"]
+__all__ = ["FlopsProfiler", "get_model_profile", "estimate_step_flops",
+           "transformer_flops_per_token"]

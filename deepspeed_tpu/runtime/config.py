@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 
 from .config_utils import DeepSpeedConfigError, dict_to_dataclass, dataclass_to_dict
 from .resilience.config import ResilienceConfig
+from ..observability.config import ObservabilityConfig
 from ..serving.config import ServingConfig
 from ..utils.logging import logger
 
@@ -388,6 +389,10 @@ class DeepSpeedConfig:
     # absent means "no sentinel/preemption/watchdog" — checkpoint
     # manifests are still written (integrity is not opt-in)
     resilience: Optional[ResilienceConfig] = None
+    # unified observability: trace spans + metrics registry + MFU
+    # accounting (deepspeed_tpu/observability/, docs/observability.md);
+    # absent/disabled leaves only the near-free no-op span path
+    observability: Optional[ObservabilityConfig] = None
 
     # free-form blocks consumed by their subsystems
     sparse_attention: Optional[Dict[str, Any]] = None
@@ -423,6 +428,7 @@ class DeepSpeedConfig:
         "pipeline": PipelineConfig,
         "serving": ServingConfig,
         "resilience": ResilienceConfig,
+        "observability": ObservabilityConfig,
     }
 
     @classmethod

@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P, NamedSharding
 from .. import comm as dist
 from ..comm.mesh import DENSE_DP_AXES
 from ..models.layers import set_activation_rules
+from ..observability.trace import span as _span
 from ..utils.logging import logger, log_dist
 from ..utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
                            FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
@@ -141,6 +142,18 @@ class DeepSpeedEngine:
             steps_per_output=config.steps_per_print)
         from ..monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(config)
+
+        # ---- observability (observability/, docs/observability.md) ----
+        # window-gated trace spans + the shared metrics registry + MFU/
+        # step-time accounting; when the block is absent the span() call
+        # sites below reduce to the module no-op (near-free by the
+        # microbenchmark test)
+        self.observability = None
+        self._tokens_per_step = None
+        if config.observability is not None and config.observability.enabled:
+            from ..observability import Observability
+            self.observability = Observability(
+                config.observability, steps_per_print=config.steps_per_print)
 
         # ---- resilience (runtime/resilience/, docs/resilience.md) ----
         # divergence sentinel + rollback, preemption emergency save, and
@@ -727,7 +740,11 @@ class DeepSpeedEngine:
                 return jax.lax.with_sharding_constraint(g, grad_shardings)
 
         def microbatch_loss(params, batch, rng, scale, extra):
-            loss = loss_fn(model, params, batch, rng, True, **extra)
+            # xprof phase scope: forward ops carry "fwd" in their
+            # op_name (cotangents show as transpose(fwd)), lining device
+            # profiles up with the host-side trace spans
+            with jax.named_scope("fwd"):
+                loss = loss_fn(model, params, batch, rng, True, **extra)
             return loss * scale / gas, loss
 
         def accumulate(params, scaler, batch, rng, extra):
@@ -794,16 +811,19 @@ class DeepSpeedEngine:
             if streamed is not None:
                 def apply(operand):
                     params_, opt_state_, grads_ = operand
-                    return streamed.clipped_apply(
-                        params_, grads_, opt_state_,
-                        lr_schedule(opt_state_["count"]), gnorm,
-                        cfg.gradient_clipping)
+                    with jax.named_scope("optimizer_step"):
+                        return streamed.clipped_apply(
+                            params_, grads_, opt_state_,
+                            lr_schedule(opt_state_["count"]), gnorm,
+                            cfg.gradient_clipping)
             else:
                 def apply(operand):
                     params_, opt_state_, grads_ = operand
-                    updates, new_opt = optimizer.update(grads_, opt_state_, params_)
                     import optax
-                    new_params = optax.apply_updates(params_, updates)
+                    with jax.named_scope("optimizer_step"):
+                        updates, new_opt = optimizer.update(grads_, opt_state_,
+                                                            params_)
+                        new_params = optax.apply_updates(params_, updates)
                     return new_params, new_opt
 
             if fp16:
@@ -928,8 +948,13 @@ class DeepSpeedEngine:
                     f"{'per-host share of ' if nproc > 1 else ''}train_batch_size "
                     f"{local_rows}")
             return x.reshape(gas, micro_global // nproc, *x.shape[1:])
-        batch = jax.tree.map(to_micro, batch)
-        batch = self._place_batch(batch, with_gas_dim=True)
+        obs = self.observability
+        if obs is not None:
+            obs.begin_step(self.global_steps + 1)
+            self._tokens_per_step = _count_tokens(batch, cfg.train_batch_size)
+        with _span("data"):
+            batch = jax.tree.map(to_micro, batch)
+            batch = self._place_batch(batch, with_gas_dim=True)
 
         self.tput_timer.start()
         if self.resilience is not None:
@@ -948,15 +973,20 @@ class DeepSpeedEngine:
                 and self.moq_quantizer.config.eigenvalue_enabled
                 and self.config.eigenvalue.enabled):
             self._last_eval_batch = jax.tree.map(lambda x: x[0], batch)
-        if self.native_offload is not None:
-            new_scaler, metrics = self._native_offload_batch(
-                batch, scaler, rng, extra)
-        else:
-            if "train_step" not in self._compiled:
-                self._compiled["train_step"] = self._make_train_step()
-            step_fn = self._compiled["train_step"]
-            self.params, self.optimizer_state, new_scaler, metrics = step_fn(
-                self.params, self.optimizer_state, scaler, batch, rng, extra)
+        # the fused jit is one program, so host-side it is one span;
+        # the fwd / bwd / optimizer split lives in the device profile
+        # (named_scope above) and in the split calling convention
+        with _span("fwd_bwd_step"):
+            if self.native_offload is not None:
+                new_scaler, metrics = self._native_offload_batch(
+                    batch, scaler, rng, extra)
+            else:
+                if "train_step" not in self._compiled:
+                    self._compiled["train_step"] = self._make_train_step()
+                step_fn = self._compiled["train_step"]
+                self.params, self.optimizer_state, new_scaler, metrics = \
+                    step_fn(self.params, self.optimizer_state, scaler,
+                            batch, rng, extra)
         if self.fp16_enabled:
             self.loss_scale_state = new_scaler
             self._accumulate_skipped(metrics["skipped"])
@@ -968,6 +998,8 @@ class DeepSpeedEngine:
         self.tput_timer.stop(global_step=True)
         self._last_loss = metrics["loss"]
         self._last_grad_norm = metrics["grad_norm"]
+        if obs is not None:
+            self._observe_step(metrics)
 
         if (cfg.flops_profiler.enabled
                 and self.global_steps == cfg.flops_profiler.profile_step):
@@ -1136,12 +1168,19 @@ class DeepSpeedEngine:
             theta = self.progressive_layer_drop.update_state(self.global_steps)
             extra["layer_keep_prob"] = jnp.float32(theta)
         self._remember_extra(extra, loss_kwargs)
-        batch = self._place_batch(batch, with_gas_dim=False)
+        if self.observability is not None:
+            self.observability.begin_step(self.global_steps + 1)
+            # a parity-API optimizer step consumes gas microbatches
+            self._tokens_per_step = _count_tokens(
+                batch, self.config.train_batch_size)
+        with _span("data"):
+            batch = self._place_batch(batch, with_gas_dim=False)
         rng = jax.random.fold_in(self.rng, self.micro_steps + 1)
         scale = (self.loss_scale_state or init_loss_scale(1.0)).scale
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        loss, grads = self._compiled["fwd_grads"](self.params, batch, rng,
-                                                  scale, extra)
+        with _span("fwd"):
+            loss, grads = self._compiled["fwd_grads"](self.params, batch, rng,
+                                                      scale, extra)
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._pending_grads = grads
         self._last_loss = loss
@@ -1156,16 +1195,18 @@ class DeepSpeedEngine:
             raise RuntimeError("backward() called without a preceding forward()")
         gas = self.config.gradient_accumulation_steps
         self.timers(BACKWARD_GLOBAL_TIMER).start()
-        # accumulate in grad_accum_dtype (fp32 default) like the fused
-        # path's buffer — summing many /gas-scaled microbatch grads in
-        # bf16 rounds the small contributions away
-        accum_dtype = jnp.dtype(self.config.data_types.resolve())
-        scaled = jax.tree.map(lambda g: (g / gas).astype(accum_dtype),
-                              self._pending_grads)
-        if self._accum_grads is None:
-            self._accum_grads = scaled
-        else:
-            self._accum_grads = jax.tree.map(jnp.add, self._accum_grads, scaled)
+        with _span("bwd"):
+            # accumulate in grad_accum_dtype (fp32 default) like the fused
+            # path's buffer — summing many /gas-scaled microbatch grads in
+            # bf16 rounds the small contributions away
+            accum_dtype = jnp.dtype(self.config.data_types.resolve())
+            scaled = jax.tree.map(lambda g: (g / gas).astype(accum_dtype),
+                                  self._pending_grads)
+            if self._accum_grads is None:
+                self._accum_grads = scaled
+            else:
+                self._accum_grads = jax.tree.map(jnp.add, self._accum_grads,
+                                                 scaled)
         self._pending_grads = None
         self._accum_count += 1
         self.micro_steps += 1
@@ -1185,10 +1226,11 @@ class DeepSpeedEngine:
         if self.resilience is not None:
             self.resilience.on_step_start()
         scaler = self.loss_scale_state or init_loss_scale(1.0)
-        if self.native_offload is not None:
-            gnorm, new_scaler, skipped = self._native_offload_step(scaler)
-        else:
-            gnorm, new_scaler, skipped = self._device_step(scaler)
+        with _span("step"):
+            if self.native_offload is not None:
+                gnorm, new_scaler, skipped = self._native_offload_step(scaler)
+            else:
+                gnorm, new_scaler, skipped = self._device_step(scaler)
         if self.fp16_enabled:
             self.loss_scale_state = new_scaler
             self._accumulate_skipped(skipped)
@@ -1207,6 +1249,8 @@ class DeepSpeedEngine:
         if self.global_steps % self.config.steps_per_print == 0:
             log_dist(f"step={self.global_steps} lr={self.get_lr():.3e} "
                      f"grad_norm={float(gnorm):.3f}", ranks=[0])
+        if self.observability is not None:
+            self._observe_step(metrics)
         self._write_monitor(metrics)
         if self.resilience is not None:
             self.resilience.on_step_end(metrics)
@@ -1360,10 +1404,11 @@ class DeepSpeedEngine:
         (at the next save, or via ``wait_checkpoint()``)."""
         self._ensure_params_resident()
         from .checkpointing import save_engine_checkpoint
-        return save_engine_checkpoint(self, save_dir, tag=tag,
-                                      client_state=client_state,
-                                      save_latest=save_latest,
-                                      async_save=async_save)
+        with _span("checkpoint_save"):
+            return save_engine_checkpoint(self, save_dir, tag=tag,
+                                          client_state=client_state,
+                                          save_latest=save_latest,
+                                          async_save=async_save)
 
     def wait_checkpoint(self):
         """Join the in-flight async save and publish its latest tag."""
@@ -1374,6 +1419,9 @@ class DeepSpeedEngine:
         """Release engine-held background resources: the async
         checkpointer's worker (after joining any pending save) and the
         NVMe param swapper's aio threads (reference: engine.destroy)."""
+        obs = getattr(self, "observability", None)
+        if obs is not None:
+            obs.close()   # release the module-global tracer if held
         res = getattr(self, "resilience", None)
         if res is not None:
             self.resilience = None
@@ -1527,6 +1575,65 @@ class DeepSpeedEngine:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                              STEP_GLOBAL_TIMER])
 
+    # ------------------------------------------------------------------
+    # observability (deepspeed_tpu/observability/, docs/observability.md)
+    # ------------------------------------------------------------------
+
+    def _observe_step(self, metrics):
+        """Post-step observability hook: the bounded-cadence device
+        probe (the ONLY sync this subsystem ever performs —
+        ``DeviceProbe.host_reads`` counts it, the trace-probe test
+        asserts it) + a host wall-clock step-time sample, then the
+        perf/registry flush on the metrics cadence."""
+        obs = self.observability
+        obs.end_step(self.global_steps, sync_value=metrics["loss"],
+                     tokens=self._tokens_per_step)
+        if self.global_steps % obs.metrics_interval == 0:
+            self._flush_perf_metrics()
+
+    def _flush_perf_metrics(self):
+        """Throughput/MFU gauges into the shared registry and the
+        monitor fan-out (host floats only — nothing here reads the
+        device). The per-step FLOPs figure resolves lazily from the
+        static estimator once batch geometry is known."""
+        obs = self.observability
+        perf = obs.perf
+        if perf.flops_per_step is None and perf.tokens_per_step:
+            from ..profiling.flops_profiler import (_count_params,
+                                                    estimate_step_flops)
+            mcfg = getattr(self.module, "config", None)
+            batch_size = self.config.train_batch_size or 1
+            perf.flops_per_step = estimate_step_flops(
+                _count_params(self._param_shapes), batch_size,
+                perf.tokens_per_step // batch_size,
+                n_layers=getattr(mcfg, "n_layers", 0) or 0,
+                d_model=getattr(mcfg, "d_model", 0) or 0)
+        reg = obs.registry
+        reg.gauge("train/global_steps").set(self.global_steps)
+        reg.gauge("train/samples").set(self.global_samples)
+        for key, value in perf.summary().items():
+            reg.gauge(f"train/{key}").set(value)
+        reg.flush_to_monitor(self.monitor, self.global_samples)
+
+    def dump_trace(self, path: str) -> str:
+        """Write captured spans as Chrome-trace JSON (load in Perfetto /
+        chrome://tracing). Requires the ``observability`` block; see
+        ``bin/ds_tpu_trace`` for the windowed-capture CLI."""
+        if self.observability is None:
+            raise RuntimeError(
+                "observability is not enabled — add "
+                '{"observability": {"enabled": true}} to the config')
+        return self.observability.write_trace(path)
+
+    def metrics_snapshot(self) -> dict:
+        """JSON-able registry + perf + probe state (the payload
+        ``ds_tpu_trace --metrics-out`` writes and ``ds_tpu_report``
+        prints)."""
+        if self.observability is None:
+            from ..observability import get_registry
+            return {"registry": get_registry().snapshot()}
+        return self.observability.snapshot()
+
     def _write_monitor(self, metrics):
         """Queue this step's monitor events with the scalars still ON
         DEVICE; they are materialized in one batched transfer at the
@@ -1553,11 +1660,12 @@ class DeepSpeedEngine:
         before reading the monitor files mid-run."""
         if not self._monitor_buffer:
             return
-        values = jax.device_get([v for _, v, _ in self._monitor_buffer])
-        events = [(label, float(v), step) for (label, _, step), v
-                  in zip(self._monitor_buffer, values)]
-        self._monitor_buffer = []
-        self.monitor.write_events(events)
+        with _span("monitor_flush"):
+            values = jax.device_get([v for _, v, _ in self._monitor_buffer])
+            events = [(label, float(v), step) for (label, _, step), v
+                      in zip(self._monitor_buffer, values)]
+            self._monitor_buffer = []
+            self.monitor.write_events(events)
 
     def __del__(self):
         # Tail events after the last cadence boundary must not be lost
@@ -1568,6 +1676,16 @@ class DeepSpeedEngine:
             self.flush_monitor()
         except Exception:  # ds-tpu: lint-ok[PY001] — destructor, backend may be gone
             pass
+
+
+def _count_tokens(global_batch, rows):
+    """Token count of one optimizer step from batch SHAPES (host
+    metadata only — never reads a buffer): global batch rows x the
+    sequence dim of the first >=2-D leaf."""
+    for leaf in jax.tree.leaves(global_batch):
+        if hasattr(leaf, "ndim") and leaf.ndim >= 2:
+            return int(rows) * int(leaf.shape[1])
+    return int(rows)
 
 
 def _init_kwargs(sample_batch):

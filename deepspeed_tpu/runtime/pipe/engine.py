@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P, NamedSharding
 
 from ... import comm as dist
+from ...observability.trace import span as _span
 from ...utils.jax_compat import shard_map
 from ...utils.logging import log_dist
 from ...utils.tree import map_opt_state_sharding
@@ -199,18 +200,21 @@ class PipelineEngine(DeepSpeedEngine):
             ys = jnp.zeros((n_micro,) + mb_shape, xs.dtype)
 
             def tick(state, t):
-                carry, ys = state
-                inject = jax.lax.dynamic_index_in_dim(
-                    xs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
-                x = jnp.where(stage == 0, inject, carry)
-                y = run_local(blocks_local, x)
-                out_idx = t - (S - 1)
-                valid = jnp.logical_and(out_idx >= 0, out_idx < n_micro)
-                ys_new = jax.lax.dynamic_update_index_in_dim(
-                    ys, y, jnp.clip(out_idx, 0, n_micro - 1), 0)
-                ys = jnp.where(valid, ys_new, ys)
-                nxt = jax.lax.ppermute(
-                    y, "stage", [(i, (i + 1) % S) for i in range(S)])
+                # xprof phase scope: each micro-batch pipeline tick's
+                # compute + ppermute rotation groups under "pipe_tick"
+                with jax.named_scope("pipe_tick"):
+                    carry, ys = state
+                    inject = jax.lax.dynamic_index_in_dim(
+                        xs, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+                    x = jnp.where(stage == 0, inject, carry)
+                    y = run_local(blocks_local, x)
+                    out_idx = t - (S - 1)
+                    valid = jnp.logical_and(out_idx >= 0, out_idx < n_micro)
+                    ys_new = jax.lax.dynamic_update_index_in_dim(
+                        ys, y, jnp.clip(out_idx, 0, n_micro - 1), 0)
+                    ys = jnp.where(valid, ys_new, ys)
+                    nxt = jax.lax.ppermute(
+                        y, "stage", [(i, (i + 1) % S) for i in range(S)])
                 return (nxt, ys), None
 
             (carry, ys), _ = jax.lax.scan(tick, (carry, ys), jnp.arange(T))
@@ -295,7 +299,12 @@ class PipelineEngine(DeepSpeedEngine):
         if ids.shape[0] != expect:
             raise ValueError(f"batch dim {ids.shape[0]} != train_batch_size "
                              f"{expect}")
-        dev_batch = self._place_batch(batch, with_gas_dim=False)
+        obs = self.observability
+        if obs is not None:
+            obs.begin_step(self.global_steps + 1)
+            self._tokens_per_step = expect * int(ids.shape[1])
+        with _span("data"):
+            dev_batch = self._place_batch(batch, with_gas_dim=False)
         if "train_step" not in self._compiled:
             self._compiled["train_step"] = self._make_train_step()
         scaler = self.loss_scale_state or init_loss_scale(1.0)
@@ -303,15 +312,19 @@ class PipelineEngine(DeepSpeedEngine):
         self.tput_timer.start()
         if self.resilience is not None:
             self.resilience.on_step_start()
-        self.params, self.optimizer_state, new_scaler, metrics = \
-            self._compiled["train_step"](self.params, self.optimizer_state,
-                                         scaler, dev_batch, rng)
+        with _span("fwd_bwd_step"):
+            self.params, self.optimizer_state, new_scaler, metrics = \
+                self._compiled["train_step"](self.params,
+                                             self.optimizer_state,
+                                             scaler, dev_batch, rng)
         if self.fp16_enabled:
             self.loss_scale_state = new_scaler
             self._accumulate_skipped(metrics["skipped"])
         self.global_steps += 1
         self.global_samples += expect
         self.tput_timer.stop(global_step=True)
+        if obs is not None:
+            self._observe_step(metrics)
         if self.global_steps % cfg.steps_per_print == 0:
             self._report_step(metrics)
         self._write_monitor(metrics)

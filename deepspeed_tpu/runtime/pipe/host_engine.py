@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ... import comm as dist
+from ...observability.trace import span as _span
 from ...utils.logging import log_dist
 from ..config import DeepSpeedConfig
 from ..config_utils import DeepSpeedConfigError
@@ -341,27 +342,34 @@ class HostDrivenPipelineEngine:
                     elif isinstance(cmd, RecvGrad):
                         grads_in[s][b] = grad_mail.pop((s, micro_of(s, t)))
                     elif isinstance(cmd, ForwardPass):
+                        # per-(stage, micro) span: the host-driven
+                        # schedule is where micro-batch stage phases are
+                        # individually visible (the SPMD engine fuses
+                        # them into one program)
                         m = micro_of(s, t)
                         x = act_in[s][b]
-                        if s == S - 1:
-                            loss = self._last_fwd_prog()(
-                                self.params[s], x, micro_ids[m])
-                            losses.append(loss)
-                        else:
-                            out_act[s][b] = self._fwd_prog(s)(
-                                self.params[s], x)
-                            out_micro[s][b] = m
+                        with _span("pipe/fwd", {"stage": s, "micro": m}):
+                            if s == S - 1:
+                                loss = self._last_fwd_prog()(
+                                    self.params[s], x, micro_ids[m])
+                                losses.append(loss)
+                            else:
+                                out_act[s][b] = self._fwd_prog(s)(
+                                    self.params[s], x)
+                                out_micro[s][b] = m
                     elif isinstance(cmd, BackwardPass):
                         m = micro_of(s, t)
                         x = act_in[s][b]
-                        if s == S - 1:
-                            dp, dx = self._last_bwd_prog()(
-                                self.params[s], x, micro_ids[m])
-                        else:
-                            cot = grads_in[s][b]
-                            grads_in[s][b] = None
-                            dp, dx = self._bwd_prog(s)(self.params[s], x, cot)
-                        grad_accum[s] = add_grads(grad_accum[s], dp)
+                        with _span("pipe/bwd", {"stage": s, "micro": m}):
+                            if s == S - 1:
+                                dp, dx = self._last_bwd_prog()(
+                                    self.params[s], x, micro_ids[m])
+                            else:
+                                cot = grads_in[s][b]
+                                grads_in[s][b] = None
+                                dp, dx = self._bwd_prog(s)(self.params[s],
+                                                           x, cot)
+                            grad_accum[s] = add_grads(grad_accum[s], dp)
                         dx_pending[s][b] = dx
                         dx_micro[s][b] = m
                         act_in[s][b] = None
@@ -372,7 +380,8 @@ class HostDrivenPipelineEngine:
                         pass
                     elif isinstance(cmd, OptimizerStep):
                         if s == S - 1:   # run the step exactly once
-                            self._take_step(grad_accum)
+                            with _span("pipe/step"):
+                                self._take_step(grad_accum)
                             grad_accum = [None] * S
 
         self.global_steps += 1

@@ -18,6 +18,7 @@ assert on the exact recovery sequence.
 
 from typing import List, Optional, Tuple
 
+from ...observability.metrics import get_registry
 from ...utils.logging import logger, log_dist
 from .faults import active_injector
 from .sentinel import DivergenceError, DivergenceSentinel
@@ -191,8 +192,15 @@ class ResilienceManager:
     def _emit(self, label: str, value, step: int) -> None:
         """Host-side event record + the engine's buffered monitor path.
         Transitions are rare, so flush immediately — a post-mortem must
-        see the rollback event even if the run dies next step."""
+        see the rollback event even if the run dies next step. Every
+        event also bumps a cumulative counter in the shared
+        observability registry, so ``ds_tpu_report`` / metrics snapshots
+        show recovery activity alongside throughput — under a distinct
+        ``<label>/total`` name, because the registry flush writes
+        counters to the SAME monitor fan-out and the bare label already
+        carries this event's immediate value/step semantics below."""
         self.events.append((label, float(value), step))
+        get_registry().counter(f"{label}/total").inc()
         eng = self.engine
         if getattr(eng, "monitor", None) is not None and eng.monitor.enabled:
             eng.monitor.write_event(label, float(value), step)

@@ -35,6 +35,7 @@ from ..inference.generation import (init_cache, _prefill_impl, _sample_impl,
                                     _sampling_mode)
 from ..inference.cache import (cache_max_len, make_row_cache, set_cache_index,
                                write_cache_row)
+from ..observability.trace import span as _span
 from ..utils.logging import log_dist
 from .config import ServingConfig
 from .request import Request
@@ -323,11 +324,12 @@ class ServingEngine:
                 import zlib
                 fold = zlib.crc32(repr(req.request_id).encode())
             rng = jax.random.fold_in(self._rng, fold % (2**31))
-            self._cache, self._state, tok, done = _admit_jit(
-                self.module, self.params, self._cache, self._state,
-                jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
-                jnp.int32(req.max_new_tokens), rng, self._eos, t, k, p,
-                self._param_transform, greedy, has_k, has_p)
+            with _span("serving/admit"):
+                self._cache, self._state, tok, done = _admit_jit(
+                    self.module, self.params, self._cache, self._state,
+                    jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
+                    jnp.int32(req.max_new_tokens), rng, self._eos, t, k, p,
+                    self._param_transform, greedy, has_k, has_p)
             self._slot_req[slot] = req
             req._admitted(slot, self._iteration)
             self.metrics.on_admit()
@@ -338,11 +340,12 @@ class ServingEngine:
             return False
         greedy, has_k, has_p, t, k, p = self._mode
         snapshot = list(self._slot_req)
-        self._cache, self._state, toks, done = _decode_iter_jit(
-            self.module, self.params, self._cache, self._state,
-            jax.random.fold_in(self._rng, 2**31),
-            jnp.int32(self._iteration), self._eos, t, k, p,
-            self._param_transform, greedy, has_k, has_p)
+        with _span("serving/decode_iter"):
+            self._cache, self._state, toks, done = _decode_iter_jit(
+                self.module, self.params, self._cache, self._state,
+                jax.random.fold_in(self._rng, 2**31),
+                jnp.int32(self._iteration), self._eos, t, k, p,
+                self._param_transform, greedy, has_k, has_p)
         busy = sum(r is not None for r in snapshot)
         self.metrics.on_decode_dispatch(busy, self.config.num_slots)
         self._pending.append(("decode", snapshot, toks, done))
@@ -354,26 +357,27 @@ class ServingEngine:
         dispatched >= pipeline_depth iterations ago) and stream its
         tokens/completions to their requests."""
         entry = self._pending.popleft()
-        if entry[0] == "admit":
-            _, slot, req, tok, done = entry
-            if req.done:     # cancelled between dispatch and readback
-                return
-            req._emit(int(np.asarray(tok)), self._iteration)
-            self.metrics.on_token()
-            if bool(np.asarray(done)):
-                self._finish(slot, req)
-            return
-        _, snapshot, toks, done = entry
-        toks = np.asarray(toks)
-        done = np.asarray(done)
-        for slot, req in enumerate(snapshot):
-            if req is None or req.done:   # empty, or cancelled in flight
-                continue
-            if toks[slot] >= 0:
-                req._emit(int(toks[slot]), self._iteration)
+        with _span("serving/harvest"):
+            if entry[0] == "admit":
+                _, slot, req, tok, done = entry
+                if req.done:     # cancelled between dispatch and readback
+                    return
+                req._emit(int(np.asarray(tok)), self._iteration)
                 self.metrics.on_token()
-            if done[slot]:
-                self._finish(slot, req)
+                if bool(np.asarray(done)):
+                    self._finish(slot, req)
+                return
+            _, snapshot, toks, done = entry
+            toks = np.asarray(toks)
+            done = np.asarray(done)
+            for slot, req in enumerate(snapshot):
+                if req is None or req.done:  # empty, or cancelled in flight
+                    continue
+                if toks[slot] >= 0:
+                    req._emit(int(toks[slot]), self._iteration)
+                    self.metrics.on_token()
+                if done[slot]:
+                    self._finish(slot, req)
 
     def _finish(self, slot: int, req: Request):
         req._finished(self._iteration)

@@ -19,6 +19,9 @@ import time
 from collections import deque
 from typing import Optional
 
+from ..observability.metrics import get_registry
+from ..observability.metrics import percentile as _percentile_impl
+
 # sliding window for the percentile histories: a long-lived server must
 # not grow per-request lists (or sort all-time history per snapshot)
 # forever — p50/p95 over the most recent completions is the serving-
@@ -27,19 +30,33 @@ HISTORY_WINDOW = 4096
 
 
 def _percentile(values, q):
-    """Nearest-rank percentile without numpy (values non-empty)."""
-    v = sorted(values)
-    idx = min(len(v) - 1, max(0, int(round(q / 100.0 * (len(v) - 1)))))
-    return v[idx]
+    """Nearest-rank percentile without numpy (values non-empty) — the
+    shared observability implementation."""
+    return _percentile_impl(values, q)
 
 
 class ServingMetrics:
     def __init__(self, monitor=None, interval: int = 50,
-                 history_window: int = HISTORY_WINDOW):
+                 history_window: int = HISTORY_WINDOW, registry=None):
         self.monitor = monitor
         self.interval = max(1, int(interval))
         self.history_window = max(1, int(history_window))
+        # mirror into the process-wide observability registry so one
+        # snapshot covers train + serve + resilience; registry=False
+        # opts out (isolated tests)
+        self.registry = get_registry() if registry is None else (
+            registry or None)
         self.reset()
+        if self.registry is not None:
+            # weakly bound: a torn-down engine's metrics must not be
+            # kept alive (or polled as current) by the process registry
+            import weakref
+            ref = weakref.ref(self)
+
+            def _collect():
+                m = ref()
+                return m.snapshot() if m is not None else {}
+            self.registry.register_collector("serving", _collect)
 
     def reset(self):
         self.requests_submitted = 0

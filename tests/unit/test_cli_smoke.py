@@ -26,7 +26,8 @@ def _run(args, timeout=120, env_extra=None):
 
 @pytest.mark.parametrize("script", [
     "ds_tpu", "ds_tpu_bench", "ds_tpu_elastic", "ds_tpu_ssh",
-    "ds_tpu_to_universal", "ds_tpu_lint", "ds_tpu_serve", "ds_tpu_chaos"])
+    "ds_tpu_to_universal", "ds_tpu_lint", "ds_tpu_serve", "ds_tpu_chaos",
+    "ds_tpu_trace"])
 def test_help_exits_zero(script):
     r = _run([os.path.join(BIN, script), "--help"])
     assert r.returncode == 0, r.stderr[-300:]
@@ -134,6 +135,54 @@ def test_bench_serving_writes_artifact(tmp_path):
     assert art["aggregate"]["requests_finished"] == 4
     assert len(art["per_request"]) == 4
     assert all(p["ttft_steps"] is not None for p in art["per_request"])
+
+
+def test_trace_windowed_capture(tmp_path):
+    """`ds_tpu_trace` runs a short training loop and writes a valid
+    Chrome-trace JSON (windowed capture) + the metrics snapshot."""
+    trace = tmp_path / "trace.json"
+    metrics = tmp_path / "metrics.json"
+    r = _run([os.path.join(BIN, "ds_tpu_trace"), "--steps", "6",
+              "--start-step", "2", "--window", "3", "--probe-interval", "2",
+              "--batch-size", "4", "--seq-len", "16", "--vocab-size", "64",
+              "--d-model", "32", "--n-layers", "1", "--quiet",
+              "--out", str(trace), "--metrics-out", str(metrics),
+              "--cpu", "1"], timeout=300)
+    assert r.returncode == 0, r.stdout[-800:] + r.stderr[-800:]
+    payload = json.loads(trace.read_text())
+    names = [e["name"] for e in payload["traceEvents"]]
+    # 3-step window, split convention: each captured step records the
+    # iteration phases as complete ("X") events
+    for phase in ("train_iteration", "data", "fwd", "bwd", "step"):
+        assert names.count(phase) == 3, (phase, names)
+    assert all(e["ph"] == "X" and "ts" in e and "dur" in e
+               for e in payload["traceEvents"])
+    snap = json.loads(metrics.read_text())
+    assert "train/tokens_per_sec" in snap["registry"]["gauges"]
+    assert snap["perf"]["steps_measured"] >= 1
+    assert "trace_summary" in snap
+
+
+def test_bench_trace_attaches_capture(tmp_path):
+    """`ds_tpu_bench serving --trace` attaches the span capture to the
+    bench run and dumps serving-phase spans as Chrome-trace JSON."""
+    trace = tmp_path / "bench_trace.json"
+    out = tmp_path / "BENCH_serving.json"
+    r = _run([os.path.join(BIN, "ds_tpu_bench"), "serving",
+              "--trace", str(trace),
+              "--num-requests", "3", "--num-slots", "2", "--max-len", "48",
+              "--prefill-bucket", "16", "--min-prompt", "3", "--max-prompt",
+              "8", "--min-output", "2", "--max-output", "3", "--d-model",
+              "32", "--n-layers", "1", "--vocab-size", "64",
+              "--out", str(out)], timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    names = {e["name"]
+             for e in json.loads(trace.read_text())["traceEvents"]}
+    assert {"serving/admit", "serving/decode_iter",
+            "serving/harvest"} <= names, names
+    # the artifact also embeds the static-estimator perf block
+    perf = json.loads(out.read_text())["perf"]
+    assert perf["n_params"] > 0 and perf["flops_per_token_fwd"] > 0
 
 
 def test_launcher_single_host_exec(tmp_path):
