@@ -23,19 +23,62 @@ import numpy as np
 
 def make_trace(seed: int, num_requests: int, *, mean_interarrival: float = 2.0,
                prompt_len_range=(4, 64), output_len_range=(4, 32),
-               vocab_size: int = 256):
+               vocab_size: int = 256, shared_prefix_len: int = 0,
+               shared_prefix_frac: float = 0.0, long_prompt_len: int = 0,
+               long_prompt_frac: float = 0.0):
     """Deterministic request trace: list of dicts with ``arrival_step``
-    (non-decreasing), ``prompt`` (token list) and ``max_new_tokens``."""
+    (non-decreasing), ``prompt`` (token list) and ``max_new_tokens``.
+
+    The paging-stressor knobs shape the prefix-adversarial scenario:
+    ``shared_prefix_frac`` of the requests open with one fixed seeded
+    ``shared_prefix_len``-token system prompt (the prefix-cache target),
+    and ``long_prompt_frac`` carry a ``long_prompt_len``-token prompt —
+    the adversarial monopolizer chunked prefill must not let stall the
+    decode batch. Both populations are chosen by the seeded RNG, so the
+    mix is bit-reproducible."""
     r = np.random.RandomState(seed)
+    shared = (r.randint(1, vocab_size, size=shared_prefix_len)
+              .astype(np.int32) if shared_prefix_len else None)
     trace = []
     step = 0
     for i in range(num_requests):
         step += int(r.geometric(min(1.0, 1.0 / max(mean_interarrival, 1e-6))))
-        n = int(r.randint(prompt_len_range[0], prompt_len_range[1] + 1))
         out = int(r.randint(output_len_range[0], output_len_range[1] + 1))
-        prompt = r.randint(1, vocab_size, size=n).astype(np.int32)
-        trace.append({"id": i, "arrival_step": step,
+        n = int(r.randint(prompt_len_range[0], prompt_len_range[1] + 1))
+        kind = r.random_sample()
+        if long_prompt_len and kind < long_prompt_frac:
+            prompt = r.randint(1, vocab_size,
+                               size=long_prompt_len).astype(np.int32)
+            kind_name = "long"
+        elif shared is not None and kind < long_prompt_frac \
+                + shared_prefix_frac:
+            tail = r.randint(1, vocab_size, size=n).astype(np.int32)
+            prompt = np.concatenate([shared, tail])
+            kind_name = "shared_prefix"
+        else:
+            prompt = r.randint(1, vocab_size, size=n).astype(np.int32)
+            kind_name = "uniform"
+        trace.append({"id": i, "arrival_step": step, "kind": kind_name,
                       "prompt": prompt.tolist(), "max_new_tokens": out})
+    # an enabled stressor population must actually appear: with few
+    # requests the Bernoulli draw can miss entirely, and a
+    # "prefix-adversarial" trace with no adversary stresses nothing.
+    # Post-loop rewrites keep every other request's tokens untouched
+    # (same RandomState, consumed after the main stream) — still
+    # bit-reproducible per seed.
+    if long_prompt_len and long_prompt_frac \
+            and not any(t["kind"] == "long" for t in trace):
+        t = trace[len(trace) // 2]
+        t["kind"] = "long"
+        t["prompt"] = r.randint(1, vocab_size,
+                                size=long_prompt_len).astype(np.int32).tolist()
+    if shared is not None and shared_prefix_frac \
+            and not any(t["kind"] == "shared_prefix" for t in trace):
+        for t in trace[:-1]:                 # keep any forced long intact
+            if t["kind"] == "uniform":
+                t["kind"] = "shared_prefix"
+                t["prompt"] = shared.tolist() + t["prompt"]
+                break
     return trace
 
 
@@ -78,23 +121,75 @@ def build_demo_model(*, vocab_size=256, max_seq_len=256, d_model=64,
     return model, params
 
 
+def _scenario_knobs(args):
+    """Resolve the trace-shaping knobs for the chosen scenario. The
+    ``prefix-adversarial`` scenario fills in any knob the caller left at
+    its zero default: most requests share a page-aligned system prompt
+    (the prefix-cache target) and a seeded minority carry near-max-len
+    prompts (the chunked-prefill adversary)."""
+    knobs = {
+        "shared_prefix_len": args.shared_prefix_len,
+        "shared_prefix_frac": args.shared_prefix_frac,
+        "long_prompt_len": args.long_prompt_len,
+        "long_prompt_frac": args.long_prompt_frac,
+    }
+    if args.scenario == "prefix-adversarial":
+        page = args.page_len if args.paged else 128
+        if not knobs["shared_prefix_len"]:
+            # two full pages so the cached run is page-granular-shareable
+            knobs["shared_prefix_len"] = min(2 * page,
+                                             max(page, args.max_prompt))
+        if not knobs["shared_prefix_frac"]:
+            knobs["shared_prefix_frac"] = 0.6
+        if not knobs["long_prompt_len"]:
+            knobs["long_prompt_len"] = args.max_len - args.max_output
+        if not knobs["long_prompt_frac"]:
+            knobs["long_prompt_frac"] = 0.1
+    # every resolved knob must leave headroom for the generation budget:
+    # a shared-prefix prompt is prefix + an up-to-max_prompt tail, a long
+    # prompt is exactly long_prompt_len, and validate_request rejects
+    # prompt + max_new > max_len — clamp here instead of crashing
+    # mid-replay on legal flag combinations
+    budget = args.max_len - args.max_output
+    knobs["shared_prefix_len"] = max(
+        0, min(knobs["shared_prefix_len"], budget - args.max_prompt))
+    knobs["long_prompt_len"] = max(0, min(knobs["long_prompt_len"], budget))
+    return knobs
+
+
 def run_benchmark(args):
     from deepspeed_tpu.serving import ServingConfig
     from deepspeed_tpu.serving.engine import ServingEngine
+    from deepspeed_tpu.serving.paging import PagingConfig
 
     model, params = build_demo_model(
         vocab_size=args.vocab_size, max_seq_len=args.max_len,
         d_model=args.d_model, n_layers=args.n_layers, n_heads=args.n_heads,
         seed=args.seed)
+    paging = None
+    if args.paged:
+        num_pages = None
+        if args.hbm_rows is not None:
+            # pool budget expressed in full-length-row equivalents: the
+            # density experiment holds HBM fixed while slots scale
+            cache_len = -(-args.max_len // 128) * 128
+            num_pages = args.hbm_rows * (cache_len // args.page_len) + 1
+        paging = PagingConfig(
+            page_len=args.page_len, num_pages=num_pages,
+            prefill_chunk=args.prefill_chunk,
+            max_chunks_per_iter=args.max_chunks_per_iter,
+            enable_prefix_cache=not args.no_prefix_cache)
     cfg = ServingConfig(num_slots=args.num_slots, max_len=args.max_len,
-                        prefill_bucket=args.prefill_bucket, seed=args.seed)
+                        prefill_bucket=args.prefill_bucket, seed=args.seed,
+                        paging=paging)
     engine = ServingEngine(model, params, cfg)
+    knobs = _scenario_knobs(args)
     trace = make_trace(
         args.seed, args.num_requests,
         mean_interarrival=args.mean_interarrival,
         prompt_len_range=(args.min_prompt, args.max_prompt),
         output_len_range=(args.min_output, args.max_output),
-        vocab_size=args.vocab_size)
+        vocab_size=args.vocab_size, **knobs)
     handles = replay(engine, trace)
 
     # decode-side performance accounting (docs/observability.md): the
@@ -127,10 +222,44 @@ def run_benchmark(args):
                 if peak_tflops else None),
     }
 
+    # paged-mode accounting (CPU-backend byte arithmetic, no device
+    # introspection): the pool's resident K/V bytes vs what the SAME
+    # byte budget buys as contiguous full-length rows — the density
+    # claim is concurrent_requests_peak / full_length_rows_equivalent
+    paging_block = None
+    if engine._paged is not None:
+        mgr = engine._paged
+        stats = mgr.stats()
+        pool_bytes = mgr.pool_bytes()
+        bytes_per_token = pool_bytes / (mgr.num_pages * mgr.page_len)
+        rows_equiv = stats["full_length_rows_equivalent"]
+        peak = agg.get("concurrent_requests_peak", 0)
+        paging_block = {
+            **stats,
+            "pool_bytes": pool_bytes,
+            "contiguous_bytes_equivalent": int(
+                bytes_per_token * rows_equiv * cfg.cache_len),
+            "concurrent_requests_peak": peak,
+            "density_gain_vs_full_rows": (peak / rows_equiv
+                                          if rows_equiv else None),
+            # resident-vs-transient honesty (docs/serving.md): the
+            # density claim prices the page pool, but each jitted decode
+            # step also gathers a contiguous [num_slots, cache_len] view
+            # as XLA-managed scratch — reported, not hidden
+            "decode_gather_transient_bytes": int(
+                bytes_per_token * cfg.num_slots * cfg.cache_len),
+            "prefill_tokens_computed": agg.get("prefill_tokens_computed", 0),
+            "prefill_tokens_reused": agg.get("prefill_tokens_reused", 0),
+            "prefill_recompute_skipped_frac": agg.get(
+                "prefill_recompute_skipped_frac", 0.0),
+            "ttft_steps_under_load_p95": agg.get("ttft_steps_under_load_p95"),
+        }
+
     per_request = []
     for t, h in zip(trace, handles):
         per_request.append({
             "id": t["id"], "arrival_step": t["arrival_step"],
+            "kind": t.get("kind", "uniform"),
             "prompt_len": len(t["prompt"]),
             "max_new_tokens": t["max_new_tokens"],
             "generated": len(h.output_tokens),
@@ -140,22 +269,35 @@ def run_benchmark(args):
                            - h.submitted_iteration),
             "ttft_s": h.ttft_s, "latency_s": h.latency_s,
         })
-    return {
+    result = {
         "bench": "serving",
         "config": {
             "num_slots": cfg.num_slots, "max_len": cfg.max_len,
             "prefill_bucket": cfg.prefill_bucket,
+            "paging": (None if cfg.paging is None else {
+                "enabled": cfg.paging.enabled,
+                "page_len": cfg.paging.page_len,
+                "num_pages": cfg.paging.pool_pages(cfg.num_slots,
+                                                   cfg.cache_len),
+                "prefill_chunk": cfg.paging.chunk_tokens,
+                "max_chunks_per_iter": cfg.paging.max_chunks_per_iter,
+                "enable_prefix_cache": cfg.paging.enable_prefix_cache,
+            }),
             "model": {"vocab_size": args.vocab_size, "d_model": args.d_model,
                       "n_layers": args.n_layers, "n_heads": args.n_heads},
         },
         "trace": {"seed": args.seed, "num_requests": args.num_requests,
                   "mean_interarrival": args.mean_interarrival,
                   "prompt_len_range": [args.min_prompt, args.max_prompt],
-                  "output_len_range": [args.min_output, args.max_output]},
+                  "output_len_range": [args.min_output, args.max_output],
+                  "scenario": args.scenario, **knobs},
         "aggregate": agg,
         "perf": perf,
         "per_request": per_request,
     }
+    if paging_block is not None:
+        result["paging"] = paging_block
+    return result
 
 
 def build_parser():
@@ -179,6 +321,30 @@ def build_parser():
     p.add_argument("--n-layers", type=int, default=2)
     p.add_argument("--n-heads", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--scenario", choices=["uniform", "prefix-adversarial"],
+                   default="uniform",
+                   help="prefix-adversarial: most requests share a seeded "
+                        "system prompt and a minority carry near-max-len "
+                        "prompts (fills in the four knobs below when left "
+                        "at 0)")
+    p.add_argument("--shared-prefix-len", type=int, default=0)
+    p.add_argument("--shared-prefix-frac", type=float, default=0.0)
+    p.add_argument("--long-prompt-len", type=int, default=0)
+    p.add_argument("--long-prompt-frac", type=float, default=0.0)
+    p.add_argument("--paged", action="store_true",
+                   help="serve through the block-paged KV cache "
+                        "(serving/paging/) instead of contiguous slot rows")
+    p.add_argument("--page-len", type=int, default=128)
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="tokens prefilled per engine iteration (page_len "
+                        "multiple; default one page)")
+    p.add_argument("--max-chunks-per-iter", type=int, default=1)
+    p.add_argument("--hbm-rows", type=int, default=None,
+                   help="page-pool budget in full-length-row equivalents "
+                        "(default: memory parity with num_slots contiguous "
+                        "rows) — the density experiment holds this fixed "
+                        "while num_slots scales")
+    p.add_argument("--no-prefix-cache", action="store_true")
     p.add_argument("--peak-tflops", type=float, default=None,
                    help="chip peak TFLOP/s for the artifact's MFU field "
                         "(defaults to the detected chip's table entry; "
@@ -200,6 +366,17 @@ def main(argv=None):
           f"ttft p50 {agg.get('ttft_steps_p50', '-')} steps; "
           f"occupancy {agg['slot_occupancy_mean']:.2f}; "
           f"artifact -> {args.out}")
+    pg = result.get("paging")
+    if pg is not None:
+        gain = pg["density_gain_vs_full_rows"]
+        print(f"  paged: util {pg['page_utilization']:.2f}, "
+              f"prefix hit rate {pg.get('prefix_hit_rate', 0.0):.2f} "
+              f"({pg['prefill_recompute_skipped_frac']:.0%} prefill "
+              f"recompute skipped), peak {pg['concurrent_requests_peak']} "
+              f"concurrent on {pg['full_length_rows_equivalent']} "
+              f"full-row HBM ({'-' if gain is None else f'{gain:.1f}x'} "
+              f"density), ttft-under-load p95 "
+              f"{pg['ttft_steps_under_load_p95']} steps")
     return 0
 
 

@@ -121,6 +121,163 @@ def make_row_cache(cache):
     return _map_units(cache, shrink)
 
 
+# ---------------------------------------------------------------------------
+# paged-pool plumbing (serving/paging): the same cache-tree walkers applied
+# to a page pool — a cache tree whose "batch" axis is physical pages and
+# whose "sequence" axis is one page. Pure jnp, safe inside jit; page 0 is
+# the reserved null page (garbage sink for masked/unowned writes).
+# ---------------------------------------------------------------------------
+
+def init_page_pool(module, params, num_pages: int, page_len: int):
+    """Allocate a paged KV pool: ``[num_pages, h, d, page_len]`` per
+    attention unit (``[L, num_pages, ...]`` scan-stacked) — shape-only
+    init, no FLOPs burned."""
+    from .generation import init_cache
+    return init_cache(module, params, num_pages, page_len)
+
+
+def cache_page_len(pool) -> int:
+    """Tokens per page of a page pool (static python int)."""
+    return cache_max_len(pool)
+
+
+def gather_pages(pool, page_table, scalar_index: bool = False):
+    """Materialize the contiguous per-slot view of a paged pool.
+
+    ``page_table`` is ``[slots, max_pages]`` int32 (physical page per
+    logical page; unowned entries hold the null page). Returns a cache
+    tree shaped exactly like the classic slot cache —
+    ``[slots, h, d, max_pages * page_len]`` per unit — so the existing
+    attention decode path runs unchanged on top of it. ``cache_index``
+    comes back zeroed per-row (``[slots]``), or scalar-mode when
+    ``scalar_index`` (the single-row chunk-prefill form); callers set the
+    real lengths via ``set_cache_index``."""
+    page_table = jnp.asarray(page_table, jnp.int32)
+    slots, max_pages = page_table.shape
+
+    def gather(unit):
+        out = {}
+        stacked = unit["cached_key"].ndim == 5
+        for name in _KV_KEYS:
+            kv = unit[name]
+            if stacked:
+                g = kv[:, page_table]              # [L, s, m, h, d, p]
+                g = g.transpose(0, 1, 3, 4, 2, 5)  # [L, s, h, d, m, p]
+                out[name] = g.reshape(g.shape[:4] + (-1,))
+            else:
+                g = kv[page_table]                 # [s, m, h, d, p]
+                g = g.transpose(0, 2, 3, 1, 4)     # [s, h, d, m, p]
+                out[name] = g.reshape(g.shape[:3] + (-1,))
+        n_layers = unit["cached_key"].shape[0] if stacked else None
+        if scalar_index:
+            idx_shape = (n_layers,) if stacked else ()
+        else:
+            idx_shape = (n_layers, slots) if stacked else (slots,)
+        out["cache_index"] = jnp.zeros(idx_shape, jnp.int32)
+        return out
+
+    return _map_units(pool, gather)
+
+
+def _walk_with(pool, src, fn):
+    """Rebuild ``pool`` applying ``fn(pool_unit, src_subtree)`` at every
+    attention unit, where ``src`` mirrors the pool's tree structure
+    (e.g. the "kv_token" collection emitted by models/layers.py)."""
+    pool = _as_dict(pool)
+    src = _as_dict(src)
+
+    def walk(dst, s):
+        if _is_attn_unit(dst):
+            return fn(dict(dst), s)
+        if isinstance(dst, dict):
+            return {k: walk(v, s[k]) for k, v in dst.items()}
+        return dst
+
+    return walk(pool, src)
+
+
+def extract_token_kv(cache, idx):
+    """Per-unit single-token K/V read from a contiguous cache view:
+    row ``b``'s entry at position ``idx[b]`` — the fallback source for
+    the pool scatter when the module does not publish a "kv_token"
+    collection. Leaves come back ``[b, h, d, 1]`` (``[L, b, h, d, 1]``
+    stacked), matching the kv_token layout."""
+    idx = jnp.asarray(idx, jnp.int32)
+
+    def extract(unit):
+        stacked = unit["cached_key"].ndim == 5
+        sel = (idx[None, :, None, None, None] if stacked
+               else idx[:, None, None, None])
+        return {"k": jnp.take_along_axis(unit["cached_key"], sel, axis=-1),
+                "v": jnp.take_along_axis(unit["cached_value"], sel, axis=-1)}
+
+    # rebuild a token tree with the cache's structure, one {"k","v"} dict
+    # per attention unit (the kv_token collection's layout)
+    cache = _as_dict(cache)
+
+    def walk(node):
+        if _is_attn_unit(node):
+            return extract(node)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(cache)
+
+
+def scatter_token_pages(pool, token_tree, pages, offsets):
+    """Scatter one decode step's K/V into the pool: row ``b``'s token
+    lands at ``pool[pages[b], :, :, offsets[b]]``. Distinct active rows
+    own distinct tail pages by construction; masked rows are routed to
+    the null page by the caller, so duplicate indices only ever collide
+    on garbage."""
+    pages = jnp.asarray(pages, jnp.int32)
+    offsets = jnp.asarray(offsets, jnp.int32)
+
+    def scatter(unit, tok):
+        out = dict(unit)
+        for name, leaf in (("cached_key", tok["k"]),
+                           ("cached_value", tok["v"])):
+            kv = unit[name]
+            if kv.ndim == 5:
+                val = leaf[..., 0].transpose(1, 0, 2, 3)   # [s, L, h, d]
+                out[name] = kv.at[:, pages, :, :, offsets].set(val)
+            else:
+                out[name] = kv.at[pages, :, :, offsets].set(leaf[..., 0])
+        return out
+
+    return _walk_with(pool, token_tree, scatter)
+
+
+def scatter_chunk_pages(pool, token_tree, page_run):
+    """Scatter a page-aligned prefill chunk into the pool. ``token_tree``
+    leaves are ``[1, h, d, chunk]`` (``[L, 1, h, d, chunk]`` stacked)
+    with ``chunk`` an exact multiple of ``page_len``; ``page_run`` is the
+    ``chunk // page_len`` physical pages the chunk covers, in order."""
+    page_run = jnp.asarray(page_run, jnp.int32)
+    n_t = page_run.shape[0]
+
+    def scatter(unit, tok):
+        out = dict(unit)
+        page_len = unit["cached_key"].shape[-1]
+        for name, leaf in (("cached_key", tok["k"]),
+                           ("cached_value", tok["v"])):
+            kv = unit[name]
+            if kv.ndim == 5:
+                n_l, _, h, d, _ = kv.shape
+                val = leaf[:, 0].reshape(n_l, h, d, n_t, page_len)
+                val = val.transpose(0, 3, 1, 2, 4)         # [L, n_t, h, d, p]
+                out[name] = kv.at[:, page_run].set(val)
+            else:
+                _, h, d, _ = kv.shape
+                val = leaf[0].reshape(h, d, n_t, page_len)
+                val = val.transpose(2, 0, 1, 3)            # [n_t, h, d, p]
+                out[name] = kv.at[page_run].set(val)
+        return out
+
+    return _walk_with(pool, token_tree, scatter)
+
+
 def write_cache_row(cache, row_cache, row):
     """Scatter ``row_cache`` (batch 1, from ``make_row_cache`` + prefill)
     into batch row ``row`` of ``cache``. Only K/V leaves are written —

@@ -268,7 +268,10 @@ class GPT(nn.Module):
 
             h, _ = nn.scan(
                 body,
-                variable_axes={"params": 0, "cache": 0},
+                # kv_token: per-layer single-call K/V published for the
+                # paged-serving scatter (models/layers.py); the collection
+                # only materializes when the caller marks it mutable
+                variable_axes={"params": 0, "cache": 0, "kv_token": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},

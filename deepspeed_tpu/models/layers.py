@@ -317,6 +317,17 @@ class SelfAttention(nn.Module):
                                          vc.shape, vc.dtype)
             cache_index = self.variable("cache", "cache_index",
                                         lambda: jnp.zeros((), jnp.int32))
+            if not self.is_initializing() and \
+                    self.is_mutable_collection("kv_token"):
+                # Paged-serving hook (serving/paging): publish THIS call's
+                # K/V (post-rotary, K^T layout) so the caller can scatter
+                # it straight into its page pool instead of re-slicing the
+                # full cache. Structural opt-in: only appears when the
+                # caller lists "kv_token" as mutable, so the classic
+                # contiguous programs (generate(), slot serving) keep
+                # their exact tree structure and compiled executables.
+                self.variable("kv_token", "k", lambda: kc).value = kc
+                self.variable("kv_token", "v", lambda: vc).value = vc
             if self.is_initializing():
                 max_len = s
             else:

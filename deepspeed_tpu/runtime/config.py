@@ -383,7 +383,10 @@ class DeepSpeedConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     # continuous-batching serving engine (serving/engine.py); consumed by
-    # ServingEngine.from_config — absent means "not serving"
+    # ServingEngine.from_config — absent means "not serving". May carry a
+    # nested "paging" sub-block (serving/paging/config.py): block-paged KV
+    # cache + prefix sharing + chunked prefill; ServingConfig.__post_init__
+    # lifts the nested dict (dict_to_dataclass is shallow).
     serving: Optional[ServingConfig] = None
     # fault-tolerant training (runtime/resilience/, docs/resilience.md);
     # absent means "no sentinel/preemption/watchdog" — checkpoint
@@ -501,6 +504,14 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError("gradient_clipping must be >= 0")
         if self.zero_optimization.stage > 0 and not (self.fp16.enabled or self.bf16.enabled):
             logger.info("ZeRO enabled with fp32 training (no fp16/bf16 block)")
+        if self.serving is not None:
+            # fail at config parse, not at ServingEngine construction —
+            # the paging sub-block's page/chunk arithmetic in particular
+            # (page_len | cache_len, chunk alignment) is easy to get wrong
+            try:
+                self.serving.validate()
+            except ValueError as e:
+                raise DeepSpeedConfigError(f"serving: {e}") from e
 
     def to_dict(self):
         d = dataclass_to_dict(self)

@@ -6,15 +6,17 @@ config schema, and that path must work in dependency-free tooling jobs.
 """
 
 from .config import ServingConfig
+from .paging.config import PagingConfig
 
-__all__ = ["ServingConfig", "ServingEngine", "Request", "FifoScheduler",
-           "ServingMetrics"]
+__all__ = ["ServingConfig", "PagingConfig", "ServingEngine", "Request",
+           "FifoScheduler", "ServingMetrics", "PagedKVManager"]
 
 _LAZY = {
     "ServingEngine": ".engine",
     "Request": ".request",
     "FifoScheduler": ".scheduler",
     "ServingMetrics": ".metrics",
+    "PagedKVManager": ".paging.manager",
 }
 
 

@@ -8,6 +8,8 @@ importable without jax (the ds_tpu_lint job runs dependency-free).
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
+from .paging.config import PagingConfig
+
 
 @dataclass
 class ServingConfig:
@@ -38,6 +40,18 @@ class ServingConfig:
     metrics_interval: int = 50       # engine iterations between monitor
                                      # flushes (never per-step host syncs)
     seed: int = 0
+    paging: Optional[PagingConfig] = None
+                                     # block-paged KV cache (serving/paging/):
+                                     # absent or enabled=False keeps the
+                                     # contiguous slot pool — the default
+                                     # path, bit-identical to a build without
+                                     # the paging subsystem
+
+    def __post_init__(self):
+        # nested-block plumbing: runtime/config.py's dict_to_dataclass is
+        # shallow, so {"serving": {"paging": {...}}} arrives here as a dict
+        if isinstance(self.paging, dict):
+            self.paging = PagingConfig(**self.paging)
 
     def validate(self):
         if self.num_slots < 1:
@@ -47,6 +61,10 @@ class ServingConfig:
         if self.prefill_bucket < 1:
             raise ValueError(
                 f"prefill_bucket must be >= 1, got {self.prefill_bucket}")
+        if self.max_queue is not None and self.max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1 (or null for unbounded), got "
+                f"{self.max_queue}")
         if self.default_max_new_tokens < 1:
             raise ValueError("default_max_new_tokens must be >= 1, got "
                              f"{self.default_max_new_tokens}")
@@ -61,7 +79,14 @@ class ServingConfig:
         if self.metrics_interval < 1:
             raise ValueError(
                 f"metrics_interval must be >= 1, got {self.metrics_interval}")
+        if self.paging is not None:
+            self.paging.validate(self.cache_len)
         return self
+
+    @property
+    def paged(self) -> bool:
+        """True when the block-paged KV cache is configured AND enabled."""
+        return self.paging is not None and self.paging.enabled
 
     @property
     def cache_len(self) -> int:

@@ -22,6 +22,16 @@ readback is pipelined: the host reads step k's tokens while the device
 runs step k+1 (``pipeline_depth``), so streaming never serializes
 device and host. Metrics derive from those already-read tokens plus
 host scheduler state — no extra per-step syncs (PR-2 rule).
+
+Paged mode (``serving.paging`` block, serving/paging/): the slot rows
+are replaced by a global page pool + per-slot page tables, admission
+gates on free PAGES instead of free slots, shared prompt prefixes are
+referenced copy-free from a radix cache, and long prompts prefill in
+page-aligned chunks interleaved between decode iterations. The slot
+API, the compile-once discipline (ONE paged decode program, one chunk
+prefill per chunk bucket), and token-exactness vs ``generate()`` are
+all preserved; with paging absent or disabled this module's original
+code paths run untouched — bit-identical to the pre-paging engine.
 """
 
 from collections import deque
@@ -41,6 +51,7 @@ from .config import ServingConfig
 from .request import Request
 from .scheduler import FifoScheduler
 from .metrics import ServingMetrics
+from .paging.manager import _chunk_prefill_jit, _paged_decode_jit
 
 
 def _admit_impl(module, params, cache, state, prompt, prompt_len, slot,
@@ -154,13 +165,23 @@ class ServingEngine:
                 f"model's max_seq_len {model_max}")
 
         n = self.config.num_slots
-        self._cache = init_cache(module, params, n, self.config.cache_len)
-        # normalize cache_index to per-row form ([b]-shaped) up front:
-        # init_cache creates the scalar form, and a tree whose index shape
-        # flips after the first decode would cost every jit a second
-        # specialization (the "decode compiles once" contract)
-        self._cache = set_cache_index(self._cache,
-                                      jnp.zeros((n,), jnp.int32))
+        if self.config.paged:
+            # block-paged KV: the manager owns the page pool, allocator,
+            # prefix cache, and page tables; no contiguous slot rows exist
+            from .paging.manager import PagedKVManager
+            self._paged = PagedKVManager(module, params, self.config)
+            self._cache = None
+            self._prefill_tasks = deque()   # (slot, req, [chunk plans])
+        else:
+            self._paged = None
+            self._cache = init_cache(module, params, n,
+                                     self.config.cache_len)
+            # normalize cache_index to per-row form ([b]-shaped) up front:
+            # init_cache creates the scalar form, and a tree whose index
+            # shape flips after the first decode would cost every jit a
+            # second specialization (the "decode compiles once" contract)
+            self._cache = set_cache_index(self._cache,
+                                          jnp.zeros((n,), jnp.int32))
         self._state = {
             "lengths": jnp.zeros((n,), jnp.int32),
             "last_token": jnp.zeros((n,), jnp.int32),
@@ -216,6 +237,10 @@ class ServingEngine:
         req = Request(prompt, max_new_tokens, request_id, on_token=on_token,
                       deadline_steps=deadline_steps)
         req.submitted_iteration = self._iteration
+        # the p95-TTFT-under-load population: requests that arrived while
+        # others were already waiting or every slot was occupied
+        req.submitted_under_load = bool(
+            self.scheduler.depth or not self._free)
         self._seq += 1
         try:
             self.scheduler.add(req)
@@ -244,6 +269,13 @@ class ServingEngine:
                     "active": self._state["active"].at[slot].set(False),
                     "remaining": self._state["remaining"].at[slot].set(0),
                 }
+                if self._paged is not None:
+                    # drop any unfinished prefill chunks and return the
+                    # slot's page references (prefix-published pages stay
+                    # alive through the tree's own reference)
+                    self._prefill_tasks = deque(
+                        t for t in self._prefill_tasks if t[0] != slot)
+                    self._paged.release_slot(slot)
                 self._slot_req[slot] = None
                 self._free.append(slot)
                 req._cancelled(self._iteration)
@@ -280,11 +312,16 @@ class ServingEngine:
     # -- engine loop -------------------------------------------------------
     def advance(self):
         """One engine iteration: expire overdue queued requests, admit
-        into free slots, dispatch one decode over the slot batch, harvest
-        readbacks beyond the pipeline depth. Safe to call when idle
-        (no-op)."""
+        into free slots (paged mode: reserve pages + run at most
+        ``max_chunks_per_iter`` prefill chunks), dispatch one decode over
+        the slot batch, harvest readbacks beyond the pipeline depth. Safe
+        to call when idle (no-op)."""
         self._expire_queued()
-        self._admit_ready()
+        if self._paged is not None:
+            self._admit_ready_paged()
+            self._run_prefill_chunks()
+        else:
+            self._admit_ready()
         dispatched = self._dispatch_decode()
         # keep at most pipeline_depth dispatches in flight; drain fully
         # when nothing new was dispatched (tail of the workload)
@@ -293,7 +330,9 @@ class ServingEngine:
             self._harvest_one()
         busy = sum(r is not None for r in self._slot_req)
         self.metrics.sample(self.scheduler.depth, busy,
-                            self.config.num_slots, self._iteration)
+                            self.config.num_slots, self._iteration,
+                            paged=(self._paged.stats()
+                                   if self._paged is not None else None))
         if self._iteration % self.config.metrics_interval == 0:
             self.metrics.flush()
 
@@ -304,6 +343,17 @@ class ServingEngine:
         for req in self.scheduler.expire(self._iteration):
             req._timed_out(self._iteration)
             self.metrics.on_timeout(req)
+
+    def _req_rng(self, req):
+        """Stable per-request rng fold: python hash() is salted per
+        process and would break sampled-output reproducibility across
+        runs."""
+        if isinstance(req.request_id, int):
+            fold = req.request_id
+        else:
+            import zlib
+            fold = zlib.crc32(repr(req.request_id).encode())
+        return jax.random.fold_in(self._rng, fold % (2**31))
 
     def _admit_ready(self):
         while self._free:
@@ -316,14 +366,7 @@ class ServingEngine:
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :n] = req.prompt
             greedy, has_k, has_p, t, k, p = self._mode
-            # stable per-request fold: python hash() is salted per process
-            # and would break sampled-output reproducibility across runs
-            if isinstance(req.request_id, int):
-                fold = req.request_id
-            else:
-                import zlib
-                fold = zlib.crc32(repr(req.request_id).encode())
-            rng = jax.random.fold_in(self._rng, fold % (2**31))
+            rng = self._req_rng(req)
             with _span("serving/admit"):
                 self._cache, self._state, tok, done = _admit_jit(
                     self.module, self.params, self._cache, self._state,
@@ -335,17 +378,113 @@ class ServingEngine:
             self.metrics.on_admit()
             self._pending.append(("admit", slot, req, tok, done))
 
+    # -- paged admission + chunked prefill ---------------------------------
+    def _admit_ready_paged(self):
+        """Admit queued requests while pages cover them. Admission gates
+        on free PAGES, not free slots: a page-starved queue head stays
+        queued (strict FIFO) until running requests release pages or the
+        prefix cache evicts — slots are cheap metadata in paged mode, so
+        the pool is the real admission resource."""
+        while self._free:
+            req = self.scheduler.peek()
+            if req is None:
+                return
+            slot = self._free[0]
+            shared = self._paged.try_admit(slot, req.prompt,
+                                           req.max_new_tokens)
+            if shared is None:
+                return                      # page-starved: head waits
+            self.scheduler.next_request()   # actually pop it
+            self._free.popleft()
+            self._slot_req[slot] = req
+            req._admitted(slot, self._iteration)
+            self.metrics.on_admit(shared_tokens=shared)
+            self._prefill_tasks.append(
+                (slot, req, self._plan_chunks(req, shared)))
+
+    def _plan_chunks(self, req, shared_tokens: int):
+        """Split the non-shared prompt tail into page-aligned chunks:
+        full ``chunk_tokens`` chunks, then one tail chunk padded to the
+        smallest page multiple covering the remainder — so chunk widths
+        (the only prefill jit axis) come from a bounded bucket set.
+        Always at least one chunk: the prefix match caps at the last
+        prompt token, whose logits seed sampling."""
+        p_len = int(req.prompt.shape[0])
+        page = self._paged.page_len
+        cap = self._paged.chunk_tokens
+        chunks, start = [], shared_tokens
+        while start < p_len:
+            remaining = p_len - start
+            width = cap if remaining >= cap else -(-remaining // page) * page
+            chunks.append((start, width))
+            start += width
+        return chunks
+
+    def _run_prefill_chunks(self):
+        """Run at most ``max_chunks_per_iter`` prefill chunks this
+        iteration, FIFO across admitted-but-unprefilled requests — the
+        chunked-prefill contract: a long prompt never stalls the decode
+        batch by more than this many chunks per decode dispatch."""
+        budget = self.config.paging.max_chunks_per_iter
+        while budget > 0 and self._prefill_tasks:
+            slot, req, chunks = self._prefill_tasks[0]
+            start, width = chunks.pop(0)
+            self._dispatch_chunk(slot, req, start, width,
+                                 is_last=not chunks)
+            if not chunks:
+                self._prefill_tasks.popleft()
+            budget -= 1
+
+    def _dispatch_chunk(self, slot: int, req, start: int, width: int,
+                        is_last: bool):
+        """Prefill one page-aligned chunk of one request. Mid-chunks only
+        fill pages; the LAST chunk also samples the first token (pipelined
+        like a contiguous admit) and publishes the prompt's full pages to
+        the prefix cache. Same program either way — ``is_last`` is a
+        traced flag, not a jit specialization."""
+        p_len = int(req.prompt.shape[0])
+        real = min(start + width, p_len) - start
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :real] = req.prompt[start:start + real]
+        greedy, has_k, has_p, t, k, p = self._mode
+        mgr = self._paged
+        with _span("serving/prefill_chunk",
+                   {"slot": slot, "start": start, "tokens": real,
+                    "last": bool(is_last)}):
+            mgr.pool, self._state, tok, done = _chunk_prefill_jit(
+                self.module, self.params, mgr.pool, self._state,
+                mgr.page_table[slot], jnp.asarray(padded),
+                jnp.int32(start), jnp.int32(p_len), jnp.int32(slot),
+                jnp.int32(req.max_new_tokens), jnp.asarray(is_last),
+                self._req_rng(req), self._eos, t, k, p,
+                self._param_transform, greedy, has_k, has_p)
+        self.metrics.on_prefill_chunk(real)
+        if is_last:
+            # pages below the prompt's full-page boundary are immutable
+            # from here (decode appends strictly past them): publish them
+            # for copy-free reuse by later identical prefixes
+            mgr.publish(slot, req.prompt)
+            self._pending.append(("admit", slot, req, tok, done))
+
     def _dispatch_decode(self) -> bool:
         if all(r is None for r in self._slot_req):
             return False
         greedy, has_k, has_p, t, k, p = self._mode
         snapshot = list(self._slot_req)
+        rng = jax.random.fold_in(self._rng, 2**31)
         with _span("serving/decode_iter"):
-            self._cache, self._state, toks, done = _decode_iter_jit(
-                self.module, self.params, self._cache, self._state,
-                jax.random.fold_in(self._rng, 2**31),
-                jnp.int32(self._iteration), self._eos, t, k, p,
-                self._param_transform, greedy, has_k, has_p)
+            if self._paged is not None:
+                mgr = self._paged
+                mgr.pool, self._state, toks, done = _paged_decode_jit(
+                    self.module, self.params, mgr.pool, mgr.page_table,
+                    self._state, rng, jnp.int32(self._iteration),
+                    self._eos, t, k, p, self._param_transform, greedy,
+                    has_k, has_p)
+            else:
+                self._cache, self._state, toks, done = _decode_iter_jit(
+                    self.module, self.params, self._cache, self._state,
+                    rng, jnp.int32(self._iteration), self._eos, t, k, p,
+                    self._param_transform, greedy, has_k, has_p)
         busy = sum(r is not None for r in snapshot)
         self.metrics.on_decode_dispatch(busy, self.config.num_slots)
         self._pending.append(("decode", snapshot, toks, done))
@@ -382,6 +521,10 @@ class ServingEngine:
     def _finish(self, slot: int, req: Request):
         req._finished(self._iteration)
         self.metrics.on_finish(req)
+        if self._paged is not None:
+            # return the slot's page references; prefix-published pages
+            # survive through the radix tree's own refcount
+            self._paged.release_slot(slot)
         self._slot_req[slot] = None
         self._free.append(slot)
 

@@ -69,12 +69,25 @@ class ServingMetrics:
         self.prefills = 0
         self.decode_iterations = 0
         self.wasted_slot_steps = 0     # inactive slots carried through decode
+        # paged mode: the prefill-FLOPs ledger — computed counts prompt
+        # tokens that actually ran through a prefill program (chunked),
+        # reused counts tokens satisfied copy-free from the prefix cache.
+        # Their sum over admitted requests equals total prompt tokens, so
+        # reused/total IS the recomputation skipped by prefix sharing.
+        self.prefill_chunks = 0
+        self.prefill_tokens_computed = 0
+        self.prefill_tokens_reused = 0
+        self.paged_stats: Optional[dict] = None   # latest manager.stats()
         self.ttft_s = deque(maxlen=self.history_window)
         self.ttft_steps = deque(maxlen=self.history_window)
+        # under-load slice: only completions whose request arrived while
+        # others waited or all slots were busy (request.submitted_under_load)
+        self.ttft_steps_under_load = deque(maxlen=self.history_window)
         self.latency_s = deque(maxlen=self.history_window)
         self.queue_depth_sum = 0
         self.queue_depth_max = 0
         self.occupancy_sum = 0.0
+        self.busy_slots_max = 0        # peak concurrent admitted requests
         self.samples = 0
         self.started_at: Optional[float] = None
         self._events = []
@@ -85,9 +98,14 @@ class ServingMetrics:
             self.started_at = time.perf_counter()
         self.requests_submitted += 1
 
-    def on_admit(self):
+    def on_admit(self, shared_tokens: int = 0):
         self.requests_admitted += 1
         self.prefills += 1
+        self.prefill_tokens_reused += shared_tokens
+
+    def on_prefill_chunk(self, tokens_computed: int):
+        self.prefill_chunks += 1
+        self.prefill_tokens_computed += tokens_computed
 
     def on_decode_dispatch(self, busy_slots: int, num_slots: int):
         self.decode_iterations += 1
@@ -111,17 +129,23 @@ class ServingMetrics:
             self.ttft_s.append(request.ttft_s)
         if (request.first_token_iteration is not None
                 and request.submitted_iteration is not None):
-            self.ttft_steps.append(request.first_token_iteration
-                                   - request.submitted_iteration)
+            steps = (request.first_token_iteration
+                     - request.submitted_iteration)
+            self.ttft_steps.append(steps)
+            if getattr(request, "submitted_under_load", False):
+                self.ttft_steps_under_load.append(steps)
         if request.latency_s is not None:
             self.latency_s.append(request.latency_s)
 
     def sample(self, queue_depth: int, busy_slots: int, num_slots: int,
-               iteration: int):
+               iteration: int, paged: Optional[dict] = None):
         self.queue_depth_sum += queue_depth
         self.queue_depth_max = max(self.queue_depth_max, queue_depth)
         self.occupancy_sum += busy_slots / max(1, num_slots)
+        self.busy_slots_max = max(self.busy_slots_max, busy_slots)
         self.samples += 1
+        if paged is not None:
+            self.paged_stats = paged    # host allocator arithmetic only
         if self.monitor is not None and getattr(self.monitor, "enabled",
                                                 False):
             self._events.extend([
@@ -133,6 +157,13 @@ class ServingMetrics:
                 ("serving/requests_finished", self.requests_finished,
                  iteration),
             ])
+            if paged is not None:
+                self._events.append(("serving/page_utilization",
+                                     paged["page_utilization"], iteration))
+                if "prefix_hit_rate" in paged:
+                    self._events.append(("serving/prefix_hit_rate",
+                                         paged["prefix_hit_rate"],
+                                         iteration))
             if len(self._events) >= 4 * self.interval:
                 self.flush()
 
@@ -169,9 +200,24 @@ class ServingMetrics:
             "queue_depth_max": self.queue_depth_max,
             "slot_occupancy_mean": (self.occupancy_sum / self.samples
                                     if self.samples else 0.0),
+            "concurrent_requests_peak": self.busy_slots_max,
         }
+        if self.prefill_chunks or self.prefill_tokens_reused:
+            total = self.prefill_tokens_computed + self.prefill_tokens_reused
+            out["prefill_chunks"] = self.prefill_chunks
+            out["prefill_tokens_computed"] = self.prefill_tokens_computed
+            out["prefill_tokens_reused"] = self.prefill_tokens_reused
+            out["prefill_recompute_skipped_frac"] = (
+                self.prefill_tokens_reused / total if total else 0.0)
+        if self.paged_stats is not None:
+            # latest allocator/prefix-tree view (page_utilization,
+            # prefix_hit_rate, ...) — the PR-5 registry collector exports
+            # these as gauges via this snapshot
+            out.update(self.paged_stats)
         for name, vals in (("ttft_s", self.ttft_s),
                            ("ttft_steps", self.ttft_steps),
+                           ("ttft_steps_under_load",
+                            self.ttft_steps_under_load),
                            ("latency_s", self.latency_s)):
             if vals:
                 out[f"{name}_p50"] = _percentile(vals, 50)

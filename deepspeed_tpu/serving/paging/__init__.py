@@ -1,0 +1,34 @@
+"""Block-paged KV cache for the serving engine.
+
+Fixed-size pages (``page_len`` tokens, K^T layout) in a global pool
+``[num_pages, h, d, page_len]`` per attention unit; per-slot page tables
+are dense ``[num_slots, max_pages]`` int32 arrays, so admissions and
+frees change DATA, never compiled shapes — decode stays one compiled
+program. A host-side radix tree shares full prompt-prefix pages
+(refcounted, copy-free), and long prompts prefill in page-aligned
+chunks interleaved between decode iterations (chunked prefill).
+
+Lazy exports (PEP 562) mirror ``serving/__init__``: ``PagingConfig``
+stays importable without jax (the ``serving.paging`` config sub-block
+rides the same stdlib-only contract as ``ServingConfig``).
+"""
+
+from .config import PagingConfig
+
+__all__ = ["PagingConfig", "PageAllocator", "PrefixCache", "PagedKVManager",
+           "NULL_PAGE"]
+
+_LAZY = {
+    "PageAllocator": ".allocator",
+    "NULL_PAGE": ".allocator",
+    "PrefixCache": ".prefix",
+    "PagedKVManager": ".manager",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(_LAZY[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

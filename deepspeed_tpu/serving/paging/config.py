@@ -1,0 +1,84 @@
+"""Paged-KV configuration (the ``serving.paging`` sub-block).
+
+Stdlib-only (same contract as ``serving/config.py``): ``runtime/config.py``
+reaches this dataclass through ``ServingConfig``, and that import path must
+stay jax-free for the dependency-free tooling jobs (ds_tpu_lint in CI).
+
+Reference frame: vLLM-style block paging applied under the TPU
+compile-once discipline — pages are fixed-size, the page table is a dense
+``[num_slots, max_pages]`` int32 array, and every paged program keeps
+static shapes so decode still compiles exactly once (see
+docs/serving.md, "Paged KV cache").
+"""
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class PagingConfig:
+    """Block-paged KV cache knobs.
+
+    The pool holds ``num_pages`` pages of ``page_len`` tokens each (K^T
+    layout, one pool per attention unit). Page 0 is reserved as the null
+    page: unowned page-table entries point at it and masked/inactive
+    writes land there, so scatters never need a branch.
+    """
+    enabled: bool = True
+    page_len: int = 128              # tokens per page (128 = the Pallas
+                                     # tiling quantum; smaller only for
+                                     # CPU-backend tests)
+    num_pages: Optional[int] = None  # pool size INCLUDING the null page;
+                                     # None = num_slots * (cache_len /
+                                     # page_len) + 1 (memory parity with
+                                     # the contiguous slot pool)
+    enable_prefix_cache: bool = True  # radix-tree sharing of full prompt-
+                                      # prefix pages (system prompts)
+    prefill_chunk: Optional[int] = None  # tokens prefilled per engine
+                                     # iteration (must be a page_len
+                                     # multiple); None = page_len. Long
+                                     # prompts interleave with decode at
+                                     # this granularity.
+    max_chunks_per_iter: int = 1     # prefill chunks run between two
+                                     # decode dispatches (1 = decode never
+                                     # stalls more than one chunk)
+
+    def validate(self, cache_len: int):
+        """Validate against the owning ServingConfig's slot capacity."""
+        if self.page_len < 1:
+            raise ValueError(
+                f"serving.paging.page_len must be >= 1, got {self.page_len}")
+        if cache_len % self.page_len != 0:
+            raise ValueError(
+                f"serving.paging.page_len ({self.page_len}) must divide the "
+                f"slot capacity cache_len ({cache_len}) so page tables tile "
+                "it exactly")
+        chunk = self.chunk_tokens
+        if chunk < self.page_len or chunk % self.page_len != 0:
+            raise ValueError(
+                f"serving.paging.prefill_chunk ({chunk}) must be a positive "
+                f"multiple of page_len ({self.page_len}) — chunk starts must "
+                "stay page-aligned for the page scatter")
+        if self.max_chunks_per_iter < 1:
+            raise ValueError(
+                "serving.paging.max_chunks_per_iter must be >= 1, got "
+                f"{self.max_chunks_per_iter}")
+        max_pages = cache_len // self.page_len
+        if self.num_pages is not None and self.num_pages < max_pages + 1:
+            raise ValueError(
+                f"serving.paging.num_pages ({self.num_pages}) cannot hold "
+                f"even one full-length request: need >= {max_pages} usable "
+                "pages plus the reserved null page")
+        return self
+
+    @property
+    def chunk_tokens(self) -> int:
+        """The prefill chunk size (``prefill_chunk`` or one page)."""
+        return (self.prefill_chunk if self.prefill_chunk is not None
+                else self.page_len)
+
+    def pool_pages(self, num_slots: int, cache_len: int) -> int:
+        """Total pool pages including the reserved null page."""
+        if self.num_pages is not None:
+            return self.num_pages
+        return num_slots * (cache_len // self.page_len) + 1

@@ -1,0 +1,304 @@
+"""Paged-KV manager: the device page pool + host page-table ownership.
+
+This is the memory model swap under the live engine. Instead of one
+``[num_slots, h, d, cache_len]`` row per slot, every layer's K/V lives
+in a global pool ``[num_pages, h, d, page_len]`` and each slot holds a
+dense int32 page table ``[num_slots, max_pages]``. HBM now scales with
+*realized* context (pages actually allocated) instead of
+``num_slots * max_len`` — the density lever DeepSpeed-Inference
+(arXiv:2207.00032) attributes serving-at-scale wins to, applied under
+the TPU compile-once discipline:
+
+- the page table is a fixed-shape array operand, so admissions and
+  frees change DATA, never compiled shapes;
+- decode gathers each slot's pages into the classic contiguous view
+  inside the jitted program (``inference/cache.py gather_pages``), runs
+  the unchanged attention path, then scatters the step's K/V token back
+  to its page — ONE compiled decode program, ever;
+- prefill runs in page-aligned chunks through a single gathered row,
+  one jit specialization per chunk-length bucket, interleaved between
+  decode iterations by the engine (chunked prefill).
+
+Allocation policy: a request's full token budget
+(``prompt + max_new_tokens``) is allocated at admission. Conservative
+on purpose — no decode-time page faults, no preemption machinery, fully
+deterministic — while keeping the density win (budgets are realized
+request sizes, not ``max_len``). Prefix-cache hits shrink the
+allocation further: shared pages are referenced, not copied.
+"""
+
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...inference.cache import (cache_max_len, cache_page_len,
+                                extract_token_kv, gather_pages,
+                                init_page_pool, scatter_chunk_pages,
+                                scatter_token_pages, set_cache_index)
+from ...inference.generation import _sample_impl
+from ...observability.trace import span as _span
+from ...utils.logging import log_dist
+from .allocator import NULL_PAGE, PageAllocator
+from .prefix import PrefixCache
+
+
+def _token_tree(vars_out, cache, idx):
+    """The step's K/V to scatter: the module's published "kv_token"
+    collection when present (models/layers.py), else sliced from the
+    post-apply cache view. The choice is structural — decided at trace
+    time from the tree, never from runtime values."""
+    tok = vars_out.get("kv_token")
+    has_tok = tok is not None and len(jax.tree.leaves(tok)) > 0
+    if has_tok:
+        return tok
+    return extract_token_kv(cache, idx)
+
+
+def _chunk_tree_from_cache(cache, start, chunk):
+    """Fallback chunk K/V: slice ``[start, start + chunk)`` from the
+    post-apply row view when no kv_token collection was published."""
+
+    def walk(node):
+        if isinstance(node, dict) and "cached_key" in node:
+            return {"k": jax.lax.dynamic_slice_in_dim(
+                        node["cached_key"], start, chunk, axis=-1),
+                    "v": jax.lax.dynamic_slice_in_dim(
+                        node["cached_value"], start, chunk, axis=-1)}
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    try:
+        from flax.core import unfreeze
+        cache = unfreeze(cache)
+    except ImportError:
+        pass
+    return walk(cache)
+
+
+def _paged_decode_iter_impl(module, params, pool, page_table, state, rng, it,
+                            eos_id, t, k, p, param_transform, greedy, has_k,
+                            has_p):
+    """One masked decode step over the full slot batch, paged twin of
+    engine._decode_iter_impl: gather pages -> contiguous view -> the
+    unchanged attention path -> scatter the new token's K/V back to each
+    active slot's tail page. Inactive slots write the null page."""
+    lengths = state["lengths"]
+    active = state["active"]
+    cache = gather_pages(pool, page_table)
+    s_max = cache_max_len(cache)
+    idx_w = jnp.minimum(lengths, s_max - 1)
+    cache = set_cache_index(cache, idx_w)
+    p_ = param_transform(params) if param_transform is not None else params
+    logits, vars_out = module.apply(
+        {"params": p_, "cache": cache}, state["last_token"][:, None],
+        decode=True, positions=idx_w[:, None],
+        mutable=["cache", "kv_token"])
+    nxt = _sample_impl(logits[:, -1, :], jax.random.fold_in(rng, it),
+                       t, k, p, greedy, has_k, has_p)
+
+    page_len = cache_page_len(pool)
+    page_idx = idx_w // page_len
+    phys = jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
+    phys = jnp.where(active, phys, NULL_PAGE)
+    tok = _token_tree(vars_out, vars_out["cache"], idx_w)
+    pool = scatter_token_pages(pool, tok, phys, idx_w % page_len)
+
+    remaining = jnp.where(active, state["remaining"] - 1, state["remaining"])
+    done = active & ((nxt == eos_id) | (remaining <= 0))
+    new_state = {
+        "lengths": jnp.where(active, lengths + 1, lengths),
+        "last_token": jnp.where(active, nxt, state["last_token"]),
+        "active": active & ~done,
+        "remaining": remaining,
+    }
+    out_tok = jnp.where(active, nxt, -1)
+    return pool, new_state, out_tok, done
+
+
+_paged_decode_jit = jax.jit(_paged_decode_iter_impl,
+                            static_argnums=(0, 11, 12, 13, 14),
+                            donate_argnums=(2, 4))
+
+
+def _chunk_prefill_impl(module, params, pool, state, ptab_row, chunk_ids,
+                        chunk_start, end_pos, slot, max_new, is_last, rng,
+                        eos_id, t, k, p, param_transform, greedy, has_k,
+                        has_p):
+    """Prefill one page-aligned chunk of one request through its slot's
+    gathered row view and scatter the chunk's K/V into its pages.
+
+    ``chunk_ids`` is ``[1, chunk]`` (right-padded to a page multiple,
+    ``chunk_start`` page-aligned, ``chunk_start + chunk <= cache_len``
+    by construction — see PagingConfig.validate). Earlier chunks and any
+    shared prefix pages are already in the pool, so the dense cache path
+    attends over them exactly as a whole-prompt prefill would. The first
+    token is sampled every call but only published when ``is_last`` —
+    one compiled program per chunk bucket, mid/last selected by a traced
+    flag, not a specialization."""
+    row = gather_pages(pool, ptab_row[None], scalar_index=True)
+    row = set_cache_index(row, chunk_start)
+    positions = chunk_start + jnp.arange(chunk_ids.shape[1])
+    p_ = param_transform(params) if param_transform is not None else params
+    logits, vars_out = module.apply(
+        {"params": p_, "cache": row}, chunk_ids, decode=True,
+        positions=positions, mutable=["cache", "kv_token"])
+
+    chunk = chunk_ids.shape[1]
+    page_len = cache_page_len(pool)
+    tok_tree = vars_out.get("kv_token")
+    if tok_tree is None or len(jax.tree.leaves(tok_tree)) == 0:
+        tok_tree = _chunk_tree_from_cache(vars_out["cache"], chunk_start,
+                                          chunk)
+    run = jax.lax.dynamic_slice(ptab_row, (chunk_start // page_len,),
+                                (chunk // page_len,))
+    pool = scatter_chunk_pages(pool, tok_tree, run)
+
+    last_idx = jnp.clip(end_pos - 1 - chunk_start, 0, chunk - 1)
+    last = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1,
+                                        axis=1)[:, 0]             # [1, vocab]
+    tok = _sample_impl(last, rng, t, k, p, greedy, has_k, has_p)[0]
+    remaining = max_new - 1
+    done = (tok == eos_id) | (remaining <= 0)
+
+    def sel(new, old):
+        return jnp.where(is_last, new, old)
+
+    state = {
+        "lengths": state["lengths"].at[slot].set(
+            sel(end_pos, state["lengths"][slot])),
+        "last_token": state["last_token"].at[slot].set(
+            sel(tok, state["last_token"][slot])),
+        "active": state["active"].at[slot].set(
+            sel(~done, state["active"][slot])),
+        "remaining": state["remaining"].at[slot].set(
+            sel(remaining, state["remaining"][slot])),
+    }
+    return pool, state, tok, done
+
+
+_chunk_prefill_jit = jax.jit(_chunk_prefill_impl,
+                             static_argnums=(0, 16, 17, 18, 19),
+                             donate_argnums=(2, 3))
+
+
+class PagedKVManager:
+    """Host-side owner of the pool, the allocator, the prefix cache, and
+    the per-slot page tables. The engine calls it between jitted
+    dispatches; it never forces a device sync (page-table updates are
+    async ``.at[].set`` dispatches, stamped with trace spans)."""
+
+    def __init__(self, module, params, config):
+        pcfg = config.paging
+        self.config = pcfg
+        self.page_len = pcfg.page_len
+        self.cache_len = config.cache_len
+        self.max_pages = config.cache_len // self.page_len
+        self.num_pages = pcfg.pool_pages(config.num_slots, config.cache_len)
+        self.chunk_tokens = pcfg.chunk_tokens
+        self.pool = init_page_pool(module, params, self.num_pages,
+                                   self.page_len)
+        self.allocator = PageAllocator(self.num_pages)
+        self.prefix = (PrefixCache(self.page_len, self.allocator)
+                       if pcfg.enable_prefix_cache else None)
+        self.page_table = jnp.full((config.num_slots, self.max_pages),
+                                   NULL_PAGE, jnp.int32)
+        self._slot_pages: List[Optional[List[int]]] = \
+            [None] * config.num_slots
+        log_dist(
+            f"paged KV: {self.num_pages - 1} usable pages x "
+            f"{self.page_len} tokens "
+            f"(= {(self.num_pages - 1) * self.page_len // self.cache_len} "
+            f"full-length rows), prefill chunk {self.chunk_tokens}, "
+            f"prefix cache "
+            f"{'on' if self.prefix is not None else 'off'}", ranks=[0])
+
+    # -- admission ---------------------------------------------------------
+    def pages_for(self, prompt_len: int, max_new: int) -> int:
+        """Worst-case pages for a request's full token budget."""
+        return -(-(prompt_len + max_new) // self.page_len)
+
+    def try_admit(self, slot: int, prompt: np.ndarray, max_new: int):
+        """Allocate (and prefix-match) pages for one request. Returns the
+        shared token count on success, or None when the pool cannot
+        cover the request even after prefix-cache eviction — the caller
+        leaves the request queued (admission gates on free pages)."""
+        prompt_len = int(prompt.shape[0])
+        shared: List[int] = []
+        if self.prefix is not None:
+            shared = self.prefix.match(prompt)
+            if shared:
+                # pin the matched run BEFORE any eviction below: once
+                # deeper leaves are gone the matched nodes themselves
+                # become evictable, and an unpinned page could be freed
+                # and re-handed out as a private page — aliased twice in
+                # this slot's table, or a crash on the late retain
+                self.allocator.retain(shared)
+        need = self.pages_for(prompt_len, max_new) - len(shared)
+        private = self.allocator.alloc(need)
+        if private is None and self.prefix is not None:
+            self.prefix.evict(need)
+            private = self.allocator.alloc(need)
+        if private is None:
+            if shared:
+                self.allocator.release(shared)
+            return None
+        if self.prefix is not None:
+            self.prefix.note_admitted(len(shared))
+        pages = shared + private
+        self._slot_pages[slot] = pages
+        row = np.full((self.max_pages,), NULL_PAGE, np.int32)
+        row[:len(pages)] = pages
+        with _span("serving/page_table_copy", {"slot": slot,
+                                               "pages": len(pages)}):
+            self.page_table = self.page_table.at[slot].set(row)
+        return len(shared) * self.page_len
+
+    def publish(self, slot: int, prompt: np.ndarray) -> int:
+        """Insert the prompt's full pages into the prefix cache once its
+        prefill completed (pages are immutable from here: decode appends
+        strictly past the prompt's full-page region)."""
+        if self.prefix is None:
+            return 0
+        pages = self._slot_pages[slot]
+        n_full = int(prompt.shape[0]) // self.page_len
+        return self.prefix.insert(prompt, pages[:n_full])
+
+    def release_slot(self, slot: int):
+        """Return a finished/cancelled slot's page references and null
+        its table row (stale entries must not alias pages a future owner
+        allocates)."""
+        pages = self._slot_pages[slot]
+        if pages is None:
+            return
+        self._slot_pages[slot] = None
+        self.allocator.release(pages)
+        with _span("serving/page_table_copy", {"slot": slot, "pages": 0}):
+            self.page_table = self.page_table.at[slot].set(
+                jnp.full((self.max_pages,), NULL_PAGE, jnp.int32))
+
+    # -- accounting --------------------------------------------------------
+    def pool_bytes(self) -> int:
+        """Resident K/V bytes of the pool (all attention units)."""
+        return sum(int(leaf.size) * leaf.dtype.itemsize
+                   for leaf in jax.tree.leaves(self.pool)
+                   if getattr(leaf, "ndim", 0) >= 4)
+
+    def stats(self) -> dict:
+        usable = self.allocator.usable_pages
+        out = {
+            "pages_total": usable,
+            "pages_in_use": self.allocator.pages_in_use,
+            "page_utilization": self.allocator.pages_in_use / max(1, usable),
+            "page_len": self.page_len,
+            "pool_tokens": usable * self.page_len,
+            "full_length_rows_equivalent":
+                usable * self.page_len // self.cache_len,
+        }
+        if self.prefix is not None:
+            out.update(self.prefix.stats())
+            out["prefix_hit_rate"] = (self.prefix.hits
+                                      / max(1, self.prefix.lookups))
+        return out

@@ -36,6 +36,11 @@ class Request:
         self.status = QUEUED
         self.tokens: List[int] = []               # generated tokens, in order
         self.slot: Optional[int] = None
+        # stamped by the engine at submit: True when the request arrived
+        # while others were already waiting or every slot was busy — the
+        # population the p95-TTFT-under-load gauge aggregates (an idle
+        # server's instant TTFTs would wash the load signal out)
+        self.submitted_under_load = False
         # host wall-clock stamps (time.perf_counter)
         self.submitted_at = time.perf_counter()
         self.admitted_at: Optional[float] = None

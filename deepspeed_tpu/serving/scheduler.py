@@ -52,6 +52,13 @@ class FifoScheduler:
             return None
         return self._queue.popleft()
 
+    def peek(self) -> Optional[Request]:
+        """The queue head WITHOUT popping it. The paged engine admits in
+        two phases — reserve pages for the head, then pop — so a
+        page-starved head stays queued (admission gates on free pages,
+        not free slots) and FIFO order is preserved while it waits."""
+        return self._queue[0] if self._queue else None
+
     def expire(self, iteration: int) -> List[Request]:
         """Remove queued requests whose deadline passed the engine clock
         (deterministic: the iteration count, not wall time). Callers

@@ -137,6 +137,37 @@ def test_bench_serving_writes_artifact(tmp_path):
     assert all(p["ttft_steps"] is not None for p in art["per_request"])
 
 
+def test_bench_serving_paged_prefix_adversarial(tmp_path):
+    """`ds_tpu_bench serving --paged --scenario prefix-adversarial`: the
+    paged engine serves the shared-prefix + long-prompt trace and the
+    artifact embeds the paging accounting block (page utilization,
+    prefix hit rate, TTFT-under-load, density vs full-length rows)."""
+    out = tmp_path / "BENCH_serving.json"
+    r = _run([os.path.join(BIN, "ds_tpu_bench"), "serving",
+              "--paged", "--page-len", "16", "--prefill-chunk", "16",
+              "--scenario", "prefix-adversarial",
+              "--shared-prefix-len", "32", "--long-prompt-len", "64",
+              "--num-requests", "8", "--num-slots", "3", "--max-len", "96",
+              "--prefill-bucket", "16", "--min-prompt", "3", "--max-prompt",
+              "8", "--min-output", "2", "--max-output", "4", "--d-model",
+              "32", "--n-layers", "1", "--vocab-size", "64",
+              "--out", str(out)], timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    art = json.loads(out.read_text())
+    assert art["aggregate"]["requests_finished"] == 8
+    assert art["config"]["paging"]["enabled"]
+    assert art["trace"]["scenario"] == "prefix-adversarial"
+    pg = art["paging"]
+    for key in ("page_utilization", "prefix_hit_rate", "pool_bytes",
+                "contiguous_bytes_equivalent", "concurrent_requests_peak",
+                "density_gain_vs_full_rows",
+                "prefill_recompute_skipped_frac"):
+        assert key in pg, key
+    assert pg["prefix_hits"] >= 1              # the shared prefix got reused
+    kinds = {p["kind"] for p in art["per_request"]}
+    assert "shared_prefix" in kinds and "long" in kinds
+
+
 def test_trace_windowed_capture(tmp_path):
     """`ds_tpu_trace` runs a short training loop and writes a valid
     Chrome-trace JSON (windowed capture) + the metrics snapshot."""

@@ -70,6 +70,9 @@ class TestServingConfig:
             ServingConfig(prefill_bucket=0).validate()
         with pytest.raises(ValueError, match="pipeline_depth"):
             ServingConfig(pipeline_depth=-1).validate()
+        with pytest.raises(ValueError, match="max_queue"):
+            ServingConfig(max_queue=0).validate()
+        ServingConfig(max_queue=None).validate()   # unbounded stays legal
 
     def test_deepspeed_config_block(self):
         from deepspeed_tpu.runtime.config import DeepSpeedConfig
