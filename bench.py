@@ -134,9 +134,22 @@ def _train_bench(preset, config_extra, micro, gas, steps, np, jax, jnp, ds,
     tokens_per_sec = global_batch * SEQ / dt
     per_chip = tokens_per_sec / n_chips
     tflops = 6 * mcfg.num_params() * per_chip / 1e12
+    # the HBM accountant's attribution + a live memory_stats read (real
+    # hardware exposes it; null on backends without the query) — the
+    # train-side ``memory`` block next to the throughput numbers
+    from deepspeed_tpu.observability.memory import get_accountant
+    acct = get_accountant()
+    acct.sample_live()
+    mem_report = acct.report()
+    memory = {"by_subsystem": {tag: info["bytes"] for tag, info
+                               in mem_report["by_subsystem"].items()},
+              "static_total_bytes": mem_report["static_total_bytes"],
+              "hbm_bytes_in_use": (mem_report["live"] or {}).get(
+                  "bytes_in_use")}
     return {"tokens_per_sec_per_chip": round(per_chip, 1),
             "model_tflops_per_chip": round(tflops, 1),
             "step_ms": round(dt * 1e3, 1),
+            "memory": memory,
             "loss": round(float(loss), 3)}
 
 

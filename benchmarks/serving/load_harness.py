@@ -245,9 +245,11 @@ def run_benchmark(args):
             # resident-vs-transient honesty (docs/serving.md): the
             # density claim prices the page pool, but each jitted decode
             # step also gathers a contiguous [num_slots, cache_len] view
-            # as XLA-managed scratch — reported, not hidden
-            "decode_gather_transient_bytes": int(
-                bytes_per_token * cfg.num_slots * cfg.cache_len),
+            # as XLA-managed scratch — derived by the HBM accountant
+            # from the pool's own leaf shapes (observability/memory.py),
+            # no longer hand arithmetic
+            "decode_gather_transient_bytes":
+                mgr.decode_gather_transient_bytes(),
             "prefill_tokens_computed": agg.get("prefill_tokens_computed", 0),
             "prefill_tokens_reused": agg.get("prefill_tokens_reused", 0),
             "prefill_recompute_skipped_frac": agg.get(
@@ -293,6 +295,10 @@ def run_benchmark(args):
                   "scenario": args.scenario, **knobs},
         "aggregate": agg,
         "perf": perf,
+        # the HBM accountant's serving attribution (params, KV pool,
+        # slot state) + the derived gather-transient figure — the
+        # ``memory`` block next to the PR-5 ``perf`` block
+        "memory": engine.memory_report(),
         "per_request": per_request,
     }
     if paging_block is not None:

@@ -22,6 +22,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..observability.programs import track_program
+
 
 def init_cache(module, params, batch_size: int, max_len: int):
     """Allocate the KV cache by shape-only init (no FLOPs burned)."""
@@ -44,13 +46,17 @@ def _prefill_impl(module, params, cache, input_ids, positions,
     return logits, vars_out["cache"]
 
 
-_prefill = jax.jit(_prefill_impl, static_argnums=(0, 5))
+_prefill = track_program(
+    "inference/prefill", jax.jit(_prefill_impl, static_argnums=(0, 5)),
+    subsystem="inference")
 # generate() flows the cache linearly, so its entry copy can be donated —
 # at serving scale the cache is GB-class and the duplicate costs real HBM
 # headroom. Callers that deliberately REUSE a cache across calls (bench's
 # percentile sampling, tests) use the non-donating _prefill/_decode_loop.
-_prefill_donating = jax.jit(_prefill_impl, static_argnums=(0, 5),
-                            donate_argnums=(2,))
+_prefill_donating = track_program(
+    "inference/prefill_donating",
+    jax.jit(_prefill_impl, static_argnums=(0, 5), donate_argnums=(2,)),
+    subsystem="inference")
 
 
 def _sampling_mode(temperature, top_k, top_p):
@@ -130,11 +136,14 @@ def _decode_loop_impl(module, params, cache, last_token, start_pos,
     return jnp.transpose(tokens), cache
 
 
-_decode_jit = jax.jit(_decode_loop_impl,
-                      static_argnums=(0, 5, 10, 11, 12, 13))
-_decode_jit_donating = jax.jit(_decode_loop_impl,
-                               static_argnums=(0, 5, 10, 11, 12, 13),
-                               donate_argnums=(2,))
+_decode_jit = track_program(
+    "inference/decode_loop",
+    jax.jit(_decode_loop_impl, static_argnums=(0, 5, 10, 11, 12, 13)),
+    subsystem="inference")
+_decode_jit_donating = track_program(
+    "inference/decode_loop_donating",
+    jax.jit(_decode_loop_impl, static_argnums=(0, 5, 10, 11, 12, 13),
+            donate_argnums=(2,)), subsystem="inference")
 
 
 def _ragged_decode_loop_impl(module, params, cache, last_token, start_pos,
@@ -162,9 +171,10 @@ def _ragged_decode_loop_impl(module, params, cache, last_token, start_pos,
     return jnp.transpose(tokens), cache
 
 
-_ragged_decode_jit_donating = jax.jit(
-    _ragged_decode_loop_impl, static_argnums=(0, 5, 10, 11, 12, 13),
-    donate_argnums=(2,))
+_ragged_decode_jit_donating = track_program(
+    "inference/ragged_decode_loop",
+    jax.jit(_ragged_decode_loop_impl, static_argnums=(0, 5, 10, 11, 12, 13),
+            donate_argnums=(2,)), subsystem="inference")
 
 
 def _decode_loop(module, params, cache, last_token, start_pos,

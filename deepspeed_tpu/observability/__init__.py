@@ -20,20 +20,33 @@ obeys the no-per-step-host-sync rule (ds_tpu_lint TS002 gates this
 package at zero findings).
 """
 
-from .config import ObservabilityConfig
+from .config import MemoryConfig, ObservabilityConfig
+from .memory import (MemoryAccountant, device_memory_stats,
+                     estimate_forward_memory_bytes, format_memory_report,
+                     get_accountant, is_oom_error, oom_forensics,
+                     tree_bytes, write_oom_forensics)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
                       get_registry)
 from .perf import (CHIP_PEAK_TFLOPS, PerfAccountant, detect_chip,
                    resolve_peak_flops)
+from .programs import (ProgramRegistry, TrackedProgram,
+                       format_program_table, get_program_registry,
+                       track_program)
 from .trace import (DeviceProbe, Tracer, activate, active_tracer,
                     chrome_trace_events, deactivate, format_summary, span,
                     summarize, summarize_trace_file, write_chrome_trace)
 
 __all__ = [
-    "ObservabilityConfig", "Observability",
+    "ObservabilityConfig", "MemoryConfig", "Observability",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "CHIP_PEAK_TFLOPS", "PerfAccountant", "detect_chip",
     "resolve_peak_flops",
+    "MemoryAccountant", "get_accountant", "tree_bytes",
+    "device_memory_stats", "estimate_forward_memory_bytes",
+    "format_memory_report", "is_oom_error", "oom_forensics",
+    "write_oom_forensics",
+    "ProgramRegistry", "TrackedProgram", "format_program_table",
+    "get_program_registry", "track_program",
     "DeviceProbe", "Tracer", "activate", "active_tracer",
     "chrome_trace_events", "deactivate", "format_summary", "span",
     "summarize", "summarize_trace_file", "write_chrome_trace",
@@ -57,10 +70,15 @@ class Observability:
         self.probe = DeviceProbe(config.probe_interval)
         self.perf = PerfAccountant(window=config.perf_window,
                                    peak_flops=resolve_peak_flops(config))
+        # the process-wide accountant (train + serve share one table);
+        # this bundle's config block tunes it
+        self.memory = get_accountant()
+        self.memory.config = config.memory
         self.metrics_interval = (config.metrics_interval
                                  if config.metrics_interval is not None
                                  else max(1, int(steps_per_print)))
         self._window_open = False
+        self._dropped_exported = 0
 
     def window_contains(self, step: int) -> bool:
         cfg = self.config
@@ -88,9 +106,18 @@ class Observability:
 
     def end_step(self, step: int, sync_value=None, tokens=None):
         """Post-step hook: device probe on its bounded cadence, then the
-        wall-clock step-time sample. No other host sync happens here."""
-        self.probe.maybe_block(sync_value, step)
+        wall-clock step-time sample, then (on the same bounded cadence —
+        zero additional syncs) one live memory sample. No other host
+        sync happens here."""
+        waited = self.probe.maybe_block(sync_value, step)
         self.perf.on_step(tokens)
+        mem_cfg = self.config.memory
+        if mem_cfg.enabled:
+            if mem_cfg.poll_interval > 0:
+                if step % mem_cfg.poll_interval == 0:
+                    self.memory.sample_live(step)
+            elif waited is not None:      # ride the probe cadence
+                self.memory.sample_live(step)
 
     def close(self):
         """Release the module tracer if this bundle holds it."""
@@ -103,12 +130,25 @@ class Observability:
         return summarize(self.tracer.events)
 
     def write_trace(self, path: str) -> str:
+        self._export_dropped()
         meta = {"dropped_events": self.tracer.dropped}
         return write_chrome_trace(self.tracer.events, path, metadata=meta)
 
+    def _export_dropped(self):
+        """Sync the tracer's eviction count into the registry counter
+        (``trace/spans_dropped_total``) — counters are monotonic, so
+        only the delta since the last export is added."""
+        delta = self.tracer.dropped - self._dropped_exported
+        if delta > 0:
+            self.registry.counter("trace/spans_dropped_total").inc(delta)
+            self._dropped_exported = self.tracer.dropped
+
     def snapshot(self) -> dict:
-        """Registry snapshot + perf summary + probe counters, JSON-able
-        (the ``ds_tpu_trace --metrics-out`` / ``ds_tpu_report`` payload)."""
+        """Registry snapshot + perf summary + probe counters + memory
+        attribution + the compiled-program table, JSON-able (the
+        ``ds_tpu_trace --metrics-out`` / ``ds_tpu_report`` payload)."""
+        self._export_dropped()
+        top = self.config.memory.top_buffers
         return {
             "registry": self.registry.snapshot(),
             "perf": self.perf.summary(),
@@ -117,4 +157,6 @@ class Observability:
                       "last_wait_s": self.probe.last_wait_s},
             "trace": {"events_buffered": len(self.tracer.events),
                       "events_dropped": self.tracer.dropped},
+            "memory": self.memory.report(top),
+            "programs": get_program_registry().table(),
         }

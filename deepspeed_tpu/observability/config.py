@@ -5,8 +5,38 @@ wire the block into ``DeepSpeedConfig``, and that module must stay
 importable without jax (the ds_tpu_lint job runs dependency-free).
 """
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Optional
+
+
+@dataclass
+class MemoryConfig:
+    """``observability.memory`` sub-block (docs/observability.md,
+    "Memory accounting"): the HBM accountant + compiled-program
+    registry knobs. Static attribution is shape metadata only; live
+    polling is a host-side ``device.memory_stats()`` query gated to a
+    bounded cadence — neither adds a per-step host sync."""
+    enabled: bool = True             # static attribution + live sampling
+    poll_interval: int = 0           # live memory_stats cadence in steps;
+                                     # 0 = ride the DeviceProbe cadence
+                                     # (one sample per probe fire)
+    top_buffers: int = 8             # buffers listed in reports/forensics
+    oom_forensics: bool = True       # dump attribution + program table
+                                     # when a dispatch dies of allocation
+                                     # failure (RESOURCE_EXHAUSTED)
+    oom_dump_path: Optional[str] = None
+                                     # forensics JSON path; None =
+                                     # ./oom_forensics.json
+
+    def __post_init__(self):
+        if self.poll_interval < 0:
+            raise ValueError(
+                f"observability.memory.poll_interval must be >= 0, got "
+                f"{self.poll_interval}")
+        if self.top_buffers < 1:
+            raise ValueError(
+                f"observability.memory.top_buffers must be >= 1, got "
+                f"{self.top_buffers}")
 
 
 @dataclass
@@ -44,8 +74,14 @@ class ObservabilityConfig:
                                      # None = look up `chip` / the detected
                                      # device kind in perf.CHIP_PEAK_TFLOPS
     chip: Optional[str] = None       # chip-table key override ("tpu-v4", ...)
+    memory: MemoryConfig = field(default_factory=MemoryConfig)
+                                     # HBM accountant / program registry
+                                     # sub-block (accepts a plain dict)
 
     def __post_init__(self):
+        if isinstance(self.memory, dict):
+            # dict_to_dataclass is shallow: the nested block arrives raw
+            self.memory = MemoryConfig(**self.memory)
         if self.trace_start_step < 0:
             raise ValueError(f"observability.trace_start_step must be >= 0, "
                              f"got {self.trace_start_step}")

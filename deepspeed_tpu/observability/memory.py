@@ -1,0 +1,325 @@
+"""HBM accountant: static subsystem attribution + bounded live polling.
+
+Two views of device memory, combined in one object:
+
+- **Static attribution** (the shape walker): every long-lived buffer
+  tree an engine owns — params, optimizer state, the KV page pool /
+  slot cache, gradient accumulation buffers — is tagged to a subsystem
+  via ``account()``. Byte counts come from leaf shape/dtype metadata
+  only (concrete arrays and abstract ``ShapeDtypeStruct`` trees alike),
+  so accounting never reads device data and costs nothing on the step
+  path. The ZeRO-Infinity residency planning this feeds (arXiv
+  2104.07857) needs exactly this breakdown: who holds the HBM, by
+  design, before any allocator is consulted.
+
+- **Live polling**: ``sample_live()`` reads
+  ``device.memory_stats()`` — a host-side runtime query, not a device
+  sync — and publishes ``mem/hbm_used`` / ``mem/hbm_limit`` /
+  ``mem/hbm_peak`` gauges plus a Chrome-trace counter track when a
+  tracer is active. Callers gate it to the existing ``DeviceProbe``
+  cadence (or ``observability.memory.poll_interval``), so the step path
+  gains ZERO new host syncs — the TS002 gate and the probe-count tests
+  keep it that way. Backends without the query (the CPU test backend)
+  detect as unsupported once and every later call is a cheap no-op.
+
+Gauges (docs/observability.md, "Memory accounting"):
+``mem/by_subsystem/<tag>``, ``mem/static_total``, ``mem/hbm_used``,
+``mem/hbm_limit``, ``mem/hbm_peak``, ``mem/kv_pool_resident``,
+``mem/decode_gather_transient``.
+
+On allocation failure the engine calls ``oom_forensics()`` — the last
+live snapshot, the static attribution, the compiled-program table, and
+the top attributed buffers, dumped as JSON next to the run so the
+post-mortem starts with names instead of a bare RESOURCE_EXHAUSTED.
+
+Stdlib-only at module level (the dependency-free tooling contract of
+this package): jax/numpy import inside functions.
+"""
+
+import json
+import time
+from typing import Dict, Optional
+
+from .metrics import get_registry
+from .trace import active_tracer
+
+
+def _leaf_bytes(leaf) -> int:
+    """Byte size from shape/dtype metadata (0 for unshaped leaves) —
+    static reads only, never a device access."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    import numpy as np
+    n = 1
+    for dim in shape:
+        n *= int(dim)
+    return n * np.dtype(dtype).itemsize
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of every shaped leaf in a pytree. Works on concrete
+    arrays AND abstract ShapeDtypeStruct trees (the engine passes its
+    ``_param_shapes``), so the count never touches the device."""
+    import jax
+    return sum(_leaf_bytes(leaf) for leaf in jax.tree.leaves(tree))
+
+
+def device_memory_stats(index: int = 0) -> Optional[dict]:
+    """``memory_stats()`` of one local device, or None when the backend
+    does not expose it (CPU) or jax is absent. A host-side runtime
+    query — no device computation is forced."""
+    try:
+        import jax
+        device = jax.local_devices()[index]
+    except (ImportError, RuntimeError, IndexError):
+        return None
+    stats_fn = getattr(device, "memory_stats", None)
+    if stats_fn is None:
+        return None
+    try:
+        stats = stats_fn()
+    except (RuntimeError, NotImplementedError):
+        return None
+    return dict(stats) if stats else None
+
+
+def estimate_forward_memory_bytes(n_params, batch: int, seq: int, *,
+                                  d_model: int = 0, n_heads: int = 0,
+                                  vocab_size: int = 0, dtype_bytes: int = 4,
+                                  mlp_ratio: int = 4) -> float:
+    """Static estimate of one dense-transformer forward's device
+    footprint, comparable to XLA's ``memory_analysis()`` total
+    (argument + output + temp bytes):
+
+        args    = N·s                        (the param leaves)
+        io      = B·T·4 + B·T·V·s            (token ids + logits)
+        workset = B·T·d·s·6 + B·h·T²·s + B·T·r·d·s
+
+    The working-set term models the tensors LIVE at the widest point of
+    one layer (residual stream copies, qkv, the attention score matrix,
+    the MLP hidden) — deliberately NOT the sum over layers, because
+    XLA's buffer assignment reuses scratch across serial layers, so temp
+    does not scale with depth. The unit test holds this within 2x of
+    ``jit(forward).lower().compile().memory_analysis()`` on the
+    gpt2/gptj/bloom reference configs (the FLOPs-estimator test
+    pattern). ``n_params`` may come from an abstract shape tree."""
+    params = float(n_params) * dtype_bytes
+    io = batch * seq * 4 + batch * seq * vocab_size * dtype_bytes
+    workset = (batch * seq * d_model * dtype_bytes * 6
+               + batch * n_heads * seq * seq * dtype_bytes
+               + batch * seq * mlp_ratio * d_model * dtype_bytes)
+    return params + io + workset
+
+
+class MemoryAccountant:
+    """Process-wide static attribution + bounded live sampling.
+
+    One accountant serves every engine in the process (train + serve),
+    mirroring the shared metrics registry — ``get_accountant()`` is the
+    canonical instance. ``account()`` replaces by (subsystem, name), so
+    re-initializing an engine re-states its footprint instead of
+    double-counting."""
+
+    def __init__(self, registry=None, config=None):
+        self.registry = registry if registry is not None else get_registry()
+        self.config = config
+        # subsystem tag -> {buffer name -> bytes}
+        self._static: Dict[str, Dict[str, int]] = {}
+        self.last_live: Optional[dict] = None
+        self.live_samples = 0
+        self._live_unsupported = False
+
+    # -- static attribution ------------------------------------------------
+    def account(self, subsystem: str, tree=None, *,
+                num_bytes: Optional[int] = None,
+                name: Optional[str] = None) -> int:
+        """Attribute a resident buffer (tree) to ``subsystem``. Returns
+        the byte count. Pass either a pytree (shape-walked) or an
+        explicit ``num_bytes``. Re-accounting the same (subsystem,
+        name) replaces the previous figure."""
+        if num_bytes is None:
+            num_bytes = tree_bytes(tree)
+        buffers = self._static.setdefault(subsystem, {})
+        buffers[name or subsystem] = int(num_bytes)
+        self._publish_static(subsystem)
+        return int(num_bytes)
+
+    def discard(self, subsystem: str) -> None:
+        """Drop a subsystem's attribution (a torn-down engine)."""
+        if self._static.pop(subsystem, None) is not None:
+            self.registry.gauge(f"mem/by_subsystem/{subsystem}").set(0)
+            self.registry.gauge("mem/static_total").set(self.static_total())
+
+    def _publish_static(self, subsystem: str) -> None:
+        total = sum(self._static[subsystem].values())
+        self.registry.gauge(f"mem/by_subsystem/{subsystem}").set(total)
+        self.registry.gauge("mem/static_total").set(self.static_total())
+
+    def subsystem_bytes(self, subsystem: str) -> int:
+        return sum(self._static.get(subsystem, {}).values())
+
+    def static_total(self) -> int:
+        return sum(sum(buffers.values())
+                   for buffers in self._static.values())
+
+    def top_buffers(self, n: int = 8):
+        """The ``n`` largest attributed buffers as
+        ``[{"subsystem", "name", "bytes"}, ...]`` (the OOM-forensics
+        headline list)."""
+        rows = [{"subsystem": tag, "name": name, "bytes": b}
+                for tag, buffers in self._static.items()
+                for name, b in buffers.items()]
+        rows.sort(key=lambda r: -r["bytes"])
+        return rows[:max(1, int(n))]
+
+    # -- live sampling -------------------------------------------------------
+    def sample_live(self, step: Optional[int] = None) -> Optional[dict]:
+        """One ``memory_stats()`` read (host runtime query; the caller
+        gates the cadence). Publishes the ``mem/hbm_*`` gauges and a
+        counter track on the active tracer. Returns the snapshot, or
+        None on backends without the query (detected once, then
+        free)."""
+        if self._live_unsupported:
+            return None
+        stats = device_memory_stats()
+        if stats is None:
+            self._live_unsupported = True
+            return None
+        used = stats.get("bytes_in_use")
+        limit = stats.get("bytes_limit")
+        peak = stats.get("peak_bytes_in_use")
+        reg = self.registry
+        if used is not None:
+            reg.gauge("mem/hbm_used").set(int(used))
+        if limit is not None:
+            reg.gauge("mem/hbm_limit").set(int(limit))
+        if peak is not None:
+            reg.gauge("mem/hbm_peak").set(int(peak))
+        self.live_samples += 1
+        self.last_live = {"step": step, "sampled_at_unix": time.time(),
+                          **stats}
+        tracer = active_tracer()
+        if tracer is not None and used is not None:
+            record = getattr(tracer, "record_counter", None)
+            if record is not None:
+                record("mem/hbm_used", int(used))
+        return self.last_live
+
+    # -- reporting -----------------------------------------------------------
+    def report(self, top: int = 8) -> dict:
+        """JSON-able accountant state: per-subsystem static attribution
+        (with per-buffer detail), the static total, the last live
+        snapshot, and the top attributed buffers."""
+        return {
+            "by_subsystem": {tag: {"bytes": sum(buffers.values()),
+                                   "buffers": dict(buffers)}
+                             for tag, buffers in sorted(self._static.items())},
+            "static_total_bytes": self.static_total(),
+            "live": self.last_live,
+            "live_samples": self.live_samples,
+            "top_buffers": self.top_buffers(top),
+        }
+
+    def reset(self) -> None:
+        self._static.clear()
+        self.last_live = None
+        self.live_samples = 0
+        self._live_unsupported = False
+
+
+_DEFAULT_ACCOUNTANT: Optional[MemoryAccountant] = None
+
+
+def get_accountant() -> MemoryAccountant:
+    """The process-wide shared accountant (train + serve report into the
+    same table, like the shared metrics registry)."""
+    global _DEFAULT_ACCOUNTANT
+    if _DEFAULT_ACCOUNTANT is None:
+        _DEFAULT_ACCOUNTANT = MemoryAccountant()
+    return _DEFAULT_ACCOUNTANT
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory",
+                "OUT_OF_MEMORY", "Resource exhausted")
+
+
+def is_oom_error(err: BaseException) -> bool:
+    """Heuristic: does this exception look like a device allocation
+    failure? XLA surfaces OOM as RESOURCE_EXHAUSTED XlaRuntimeErrors."""
+    msg = str(err)
+    return any(marker in msg for marker in _OOM_MARKERS)
+
+
+def oom_forensics(reason: str = "", accountant=None,
+                  program_table: Optional[dict] = None,
+                  top: int = 8) -> dict:
+    """Assemble the allocation-failure post-mortem: a fresh live sample
+    attempt (the failed allocation often leaves stats readable), the
+    last good snapshot, the static attribution, the ``top`` largest
+    attributed buffers (``observability.memory.top_buffers``), and the
+    compiled-program table."""
+    acct = accountant if accountant is not None else get_accountant()
+    last = acct.last_live
+    fresh = acct.sample_live()
+    if program_table is None:
+        from .programs import get_program_registry
+        program_table = get_program_registry().table()
+    return {
+        "reason": reason,
+        "captured_at_unix": time.time(),
+        "live_at_failure": fresh,
+        "last_live_snapshot": last,
+        "memory": acct.report(top),
+        "programs": program_table,
+    }
+
+
+def write_oom_forensics(path: str, report: dict) -> str:
+    with open(path, "w") as f:
+        json.dump(report, f, indent=1, default=str)
+    return path
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for scale, suffix in ((1e12, "TB"), (1e9, "GB"), (1e6, "MB"),
+                          (1e3, "KB")):
+        if abs(n) >= scale:
+            return f"{n / scale:.2f}{suffix}"
+    return f"{n:.0f}B"
+
+
+def format_memory_report(report: dict) -> str:
+    """Render an accountant ``report()`` as the ``ds_tpu_mem`` text
+    section (``ds_tpu_trace --memory`` / ``ds_tpu_report``)."""
+    by_sub = report.get("by_subsystem") or {}
+    if not by_sub and report.get("live") is None:
+        return "(no memory attribution recorded)"
+    width = max([len("subsystem")] + [len(t) for t in by_sub])
+    lines = [f"{'subsystem':<{width}}  {'resident':>10}  buffers"]
+    for tag, info in by_sub.items():
+        names = ", ".join(sorted(info.get("buffers", {})))
+        lines.append(f"{tag:<{width}}  {_fmt_bytes(info['bytes']):>10}  "
+                     f"{names}")
+    lines.append(f"{'TOTAL (static)':<{width}}  "
+                 f"{_fmt_bytes(report.get('static_total_bytes')):>10}")
+    live = report.get("live")
+    if live:
+        used = live.get("bytes_in_use")
+        limit = live.get("bytes_limit")
+        peak = live.get("peak_bytes_in_use")
+        lines.append(f"live: used={_fmt_bytes(used)} "
+                     f"limit={_fmt_bytes(limit)} peak={_fmt_bytes(peak)} "
+                     f"(step {live.get('step')})")
+    else:
+        lines.append("live: unavailable on this backend "
+                     "(device.memory_stats() unsupported)")
+    return "\n".join(lines)

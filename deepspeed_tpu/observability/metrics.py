@@ -13,6 +13,7 @@ job, ``ds_tpu_report`` on a login node).
 """
 
 import json
+import time
 from collections import deque
 from typing import Callable, Dict, Optional
 
@@ -97,6 +98,7 @@ class MetricsRegistry:
         self._gauges: Dict[str, Gauge] = {}
         self._hists: Dict[str, Histogram] = {}
         self._collectors: Dict[str, Callable[[], dict]] = {}
+        self._snapshot_seq = 0
 
     def _check_free(self, name, own):
         for kind, table in (("counter", self._counters),
@@ -131,8 +133,15 @@ class MetricsRegistry:
         self._collectors[name] = fn
 
     def snapshot(self) -> dict:
-        """JSON-able state of every instrument (plus collector polls)."""
+        """JSON-able state of every instrument (plus collector polls).
+        The ``meta`` header stamps a monotonic capture sequence number
+        and wall-clock/monotonic times so two snapshots of the same
+        process diff meaningfully (which came first, how far apart)."""
+        self._snapshot_seq += 1
         out = {
+            "meta": {"capture_seq": self._snapshot_seq,
+                     "captured_at_unix": time.time(),
+                     "captured_at_monotonic_s": time.monotonic()},
             "counters": {n: c.value for n, c in sorted(self._counters.items())},
             "gauges": {n: g.value for n, g in sorted(self._gauges.items())
                        if g.value is not None},
@@ -180,6 +189,7 @@ class MetricsRegistry:
         self._gauges.clear()
         self._hists.clear()
         self._collectors.clear()
+        self._snapshot_seq = 0
 
 
 _DEFAULT_REGISTRY: Optional[MetricsRegistry] = None

@@ -109,7 +109,11 @@ class _Span:
 class Tracer:
     """Bounded span recorder. Events are ``(name, t0_ns, dur_ns, tid,
     args)`` tuples in a ring buffer; the oldest drop first (``dropped``
-    counts evictions, surfaced in the trace metadata)."""
+    counts evictions, surfaced in the trace metadata, the
+    ``trace/spans_dropped_total`` registry counter, and the
+    ``format_summary`` footer). Counter samples (memory tracks) ride the
+    same buffer with ``dur_ns=None`` and export as Chrome-trace "C"
+    events."""
 
     def __init__(self, max_events: int = 100_000, annotate_device: bool = True):
         self.events = deque(maxlen=max(1, int(max_events)))
@@ -131,6 +135,13 @@ class Tracer:
         if len(self.events) == self.events.maxlen:
             self.dropped += 1
         self.events.append((name, t0_ns, dur_ns, tid, args))
+
+    def record_counter(self, name, value):
+        """One counter-track sample (a Chrome-trace "C" event): the
+        instantaneous ``value`` under series ``name`` — memory gauges on
+        the same timeline as the spans."""
+        self._record(name, time.perf_counter_ns(), None,
+                     threading.get_ident(), {"value": float(value)})
 
     def clear(self):
         self.events.clear()
@@ -179,6 +190,14 @@ def chrome_trace_events(events):
     pid = os.getpid()
     out = []
     for name, t0_ns, dur_ns, tid, args in events:
+        if dur_ns is None:
+            # counter-track sample (Tracer.record_counter): a "C" event
+            # whose args hold the series value — Perfetto renders these
+            # as the memory-counter tracks
+            out.append({"name": name, "ph": "C", "ts": t0_ns / 1e3,
+                        "pid": pid, "tid": tids.setdefault(tid, len(tids)),
+                        "args": dict(args) if args else {}})
+            continue
         ev = {"name": name, "ph": "X", "ts": t0_ns / 1e3, "dur": dur_ns / 1e3,
               "pid": pid, "tid": tids.setdefault(tid, len(tids))}
         if args:
@@ -218,24 +237,34 @@ def summarize(events):
     mean_ms, p50_ms, p95_ms, max_ms}}, ordered by total time."""
     per = {}
     for name, _t0, dur_ns, _tid, _args in events:
+        if dur_ns is None:      # counter samples have no duration
+            continue
         per.setdefault(name, []).append(dur_ns / 1e6)
     stats = {name: _phase_stats(durs) for name, durs in per.items()}
     return dict(sorted(stats.items(), key=lambda kv: -kv[1]["total_ms"]))
 
 
-def format_summary(summary) -> str:
-    """Render a summarize() dict as the per-phase text table."""
+def format_summary(summary, dropped: int = 0) -> str:
+    """Render a summarize() dict as the per-phase text table.
+    ``dropped`` (a Tracer's eviction count) prints as a footer so a
+    truncated capture is never silently read as complete."""
     if not summary:
-        return "(no trace spans recorded)"
-    width = max(len("phase"), max(len(n) for n in summary))
-    lines = [f"{'phase':<{width}}  {'count':>6}  {'total ms':>10}  "
-             f"{'mean ms':>9}  {'p50 ms':>9}  {'p95 ms':>9}  {'max ms':>9}"]
-    for name, s in summary.items():
-        lines.append(f"{name:<{width}}  {s['count']:>6}  "
-                     f"{s['total_ms']:>10.2f}  {s['mean_ms']:>9.3f}  "
-                     f"{s['p50_ms']:>9.3f}  {s['p95_ms']:>9.3f}  "
-                     f"{s['max_ms']:>9.3f}")
-    return "\n".join(lines)
+        table = "(no trace spans recorded)"
+    else:
+        width = max(len("phase"), max(len(n) for n in summary))
+        lines = [f"{'phase':<{width}}  {'count':>6}  {'total ms':>10}  "
+                 f"{'mean ms':>9}  {'p50 ms':>9}  {'p95 ms':>9}  "
+                 f"{'max ms':>9}"]
+        for name, s in summary.items():
+            lines.append(f"{name:<{width}}  {s['count']:>6}  "
+                         f"{s['total_ms']:>10.2f}  {s['mean_ms']:>9.3f}  "
+                         f"{s['p50_ms']:>9.3f}  {s['p95_ms']:>9.3f}  "
+                         f"{s['max_ms']:>9.3f}")
+        table = "\n".join(lines)
+    if dropped:
+        table += (f"\n({dropped} spans dropped — ring buffer full; raise "
+                  "observability.trace_buffer_events or narrow the window)")
+    return table
 
 
 def summarize_trace_file(path):

@@ -33,6 +33,7 @@ from jax.sharding import PartitionSpec as P, NamedSharding
 from .. import comm as dist
 from ..comm.mesh import DENSE_DP_AXES
 from ..models.layers import set_activation_rules
+from ..observability.programs import track_program
 from ..observability.trace import span as _span
 from ..utils.logging import logger, log_dist
 from ..utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
@@ -154,6 +155,22 @@ class DeepSpeedEngine:
             from ..observability import Observability
             self.observability = Observability(
                 config.observability, steps_per_print=config.steps_per_print)
+
+        # ---- HBM accounting (observability/memory.py) ----------------
+        # attribute this engine's long-lived buffers to subsystems in
+        # the process-wide accountant (mem/by_subsystem/* gauges, the
+        # ds_tpu_mem report sections, OOM forensics). Shape metadata
+        # only — never a device read, and init-time only. On by default
+        # even without an observability block; observability.memory
+        # {"enabled": false} turns off attribution, live sampling, AND
+        # the OOM forensics hook together.
+        self._memory_cfg = (config.observability.memory
+                            if config.observability is not None else None)
+        self._memory_enabled = (self._memory_cfg is None
+                                or self._memory_cfg.enabled)
+        self._grad_buffers_accounted = False
+        if self._memory_enabled:
+            self._account_static_memory()
 
         # ---- resilience (runtime/resilience/, docs/resilience.md) ----
         # divergence sentinel + rollback, preemption emergency save, and
@@ -893,7 +910,8 @@ class DeepSpeedEngine:
 
     def _native_offload_batch(self, batch, scaler, rng, extra):
         if "grad_step" not in self._compiled:
-            self._compiled["grad_step"] = self._make_grad_step()
+            self._compiled["grad_step"] = track_program(
+                "train/grad_step", self._make_grad_step(), subsystem="train")
         grads, new_scaler, metrics = self._compiled["grad_step"](
             self.params, scaler, batch, rng, extra)
         # ds-tpu: lint-ok[TS002] — the host-side cpu_adam step needs the
@@ -977,16 +995,24 @@ class DeepSpeedEngine:
         # the fwd / bwd / optimizer split lives in the device profile
         # (named_scope above) and in the split calling convention
         with _span("fwd_bwd_step"):
-            if self.native_offload is not None:
-                new_scaler, metrics = self._native_offload_batch(
-                    batch, scaler, rng, extra)
-            else:
-                if "train_step" not in self._compiled:
-                    self._compiled["train_step"] = self._make_train_step()
-                step_fn = self._compiled["train_step"]
-                self.params, self.optimizer_state, new_scaler, metrics = \
-                    step_fn(self.params, self.optimizer_state, scaler,
-                            batch, rng, extra)
+            try:
+                if self.native_offload is not None:
+                    new_scaler, metrics = self._native_offload_batch(
+                        batch, scaler, rng, extra)
+                else:
+                    if "train_step" not in self._compiled:
+                        self._compiled["train_step"] = track_program(
+                            "train/train_step", self._make_train_step(),
+                            subsystem="train")
+                    step_fn = self._compiled["train_step"]
+                    self.params, self.optimizer_state, new_scaler, metrics = \
+                        step_fn(self.params, self.optimizer_state, scaler,
+                                batch, rng, extra)
+            except Exception as err:
+                # allocation failures get a forensics dump (attribution
+                # + program table) before the error propagates
+                self._note_dispatch_failure(err)
+                raise
         if self.fp16_enabled:
             self.loss_scale_state = new_scaler
             self._accumulate_skipped(metrics["skipped"])
@@ -1151,9 +1177,10 @@ class DeepSpeedEngine:
                         lambda sh, off: None if off else sh,
                         grad_out, self._offload_mask,
                         is_leaf=lambda x: isinstance(x, NamedSharding))
-            self._compiled["fwd_grads"] = jax.jit(
-                fwd, out_shardings=None if grad_out is None
-                else (None, grad_out))
+            self._compiled["fwd_grads"] = track_program(
+                "train/fwd_grads",
+                jax.jit(fwd, out_shardings=None if grad_out is None
+                        else (None, grad_out)), subsystem="train")
         if (self.curriculum_scheduler is not None
                 and self.curriculum_scheduler.config.curriculum_type == "seqlen"):
             seqlen = self.curriculum_scheduler.update_difficulty(
@@ -1179,8 +1206,12 @@ class DeepSpeedEngine:
         scale = (self.loss_scale_state or init_loss_scale(1.0)).scale
         self.timers(FORWARD_GLOBAL_TIMER).start()
         with _span("fwd"):
-            loss, grads = self._compiled["fwd_grads"](self.params, batch, rng,
-                                                      scale, extra)
+            try:
+                loss, grads = self._compiled["fwd_grads"](
+                    self.params, batch, rng, scale, extra)
+            except Exception as err:
+                self._note_dispatch_failure(err)
+                raise
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         self._pending_grads = grads
         self._last_loss = loss
@@ -1207,6 +1238,13 @@ class DeepSpeedEngine:
             else:
                 self._accum_grads = jax.tree.map(jnp.add, self._accum_grads,
                                                  scaled)
+        if self._memory_enabled and not self._grad_buffers_accounted:
+            # the parity path's host-persistent accumulation buffer is a
+            # real resident allocation — tag it once (shape walk only)
+            self._grad_buffers_accounted = True
+            from ..observability.memory import get_accountant
+            get_accountant().account("train/gradient_buffers",
+                                     self._accum_grads)
         self._pending_grads = None
         self._accum_count += 1
         self.micro_steps += 1
@@ -1294,10 +1332,13 @@ class DeepSpeedEngine:
                     new_scaler, skipped = scaler, jnp.int32(0)
                 return new_params, new_opt, new_scaler, gnorm, skipped
 
-            self._compiled["apply_grads"] = jax.jit(
-                apply_step, donate_argnums=(0, 1, 3),
-                out_shardings=(self.param_shardings, self.opt_shardings,
-                               None, None, None))
+            self._compiled["apply_grads"] = track_program(
+                "train/apply_grads",
+                jax.jit(apply_step, donate_argnums=(0, 1, 3),
+                        out_shardings=(self.param_shardings,
+                                       self.opt_shardings,
+                                       None, None, None)),
+                subsystem="train")
 
         self.params, self.optimizer_state, new_scaler, gnorm, skipped = \
             self._compiled["apply_grads"](self.params, self.optimizer_state,
@@ -1332,8 +1373,11 @@ class DeepSpeedEngine:
                     finite, new_scaler = jnp.bool_(True), scaler
                 return grads, gnorm, finite, new_scaler
 
-            self._compiled["prep_native"] = jax.jit(
-                prep, out_shardings=(self.grad_shardings, None, None, None))
+            self._compiled["prep_native"] = track_program(
+                "train/prep_native",
+                jax.jit(prep, out_shardings=(self.grad_shardings,
+                                             None, None, None)),
+                subsystem="train")
 
         grads, gnorm, finite, new_scaler = self._compiled["prep_native"](
             self._accum_grads, scaler)
@@ -1352,9 +1396,12 @@ class DeepSpeedEngine:
         self._sync_activation_quantization()
         if "eval" not in self._compiled:
             model, loss_fn = self.module, self._loss_fn
-            self._compiled["eval"] = jax.jit(
-                lambda p, b, e: loss_fn(model, p, b, jax.random.PRNGKey(0),
-                                        False, **e))
+            self._compiled["eval"] = track_program(
+                "train/eval",
+                jax.jit(lambda p, b, e: loss_fn(model, p, b,
+                                                jax.random.PRNGKey(0),
+                                                False, **e)),
+                subsystem="train")
         batch = self._place_batch(batch, with_gas_dim=False)
         return self._compiled["eval"](self.params, batch, loss_kwargs)
 
@@ -1422,6 +1469,11 @@ class DeepSpeedEngine:
         obs = getattr(self, "observability", None)
         if obs is not None:
             obs.close()   # release the module-global tracer if held
+        from ..observability.memory import get_accountant
+        acct = get_accountant()
+        for tag in ("train/params", "train/optimizer_state",
+                    "train/gradient_buffers"):
+            acct.discard(tag)   # a destroyed engine's buffers release
         res = getattr(self, "resilience", None)
         if res is not None:
             self.resilience = None
@@ -1615,6 +1667,52 @@ class DeepSpeedEngine:
             reg.gauge(f"train/{key}").set(value)
         reg.flush_to_monitor(self.monitor, self.global_samples)
 
+    def _account_static_memory(self):
+        """Tag this engine's long-lived device buffers in the process
+        HBM accountant (observability/memory.py). Params come from the
+        abstract shape tree, optimizer state from leaf metadata — no
+        device data is ever read. The fused path's gradients are XLA
+        scratch (visible via the program registry's temp_bytes, not
+        here); the parity path's host-persistent accumulation buffer is
+        accounted when first materialized in backward()."""
+        from ..observability.memory import get_accountant
+        acct = get_accountant()
+        acct.account("train/params", self._param_shapes)
+        opt_state = getattr(self, "optimizer_state", None)
+        if opt_state is not None:
+            acct.account("train/optimizer_state", opt_state)
+
+    def _note_dispatch_failure(self, err):
+        """Allocation-failure forensics: when a dispatch dies of device
+        OOM, dump the accountant's attribution + the compiled-program
+        table + the last live snapshot (observability/memory.py), then
+        record the event on the resilience emergency path. Every other
+        error passes through untouched — the caller re-raises either
+        way."""
+        from ..observability.memory import (is_oom_error, oom_forensics,
+                                            write_oom_forensics)
+        if not is_oom_error(err):
+            return
+        mem_cfg = self._memory_cfg
+        if not self._memory_enabled or (mem_cfg is not None
+                                        and not mem_cfg.oom_forensics):
+            return
+        report = oom_forensics(
+            reason=f"step {self.global_steps + 1}: {type(err).__name__}",
+            top=mem_cfg.top_buffers if mem_cfg is not None else 8)
+        path = (mem_cfg.oom_dump_path
+                if mem_cfg is not None and mem_cfg.oom_dump_path
+                else "oom_forensics.json")
+        try:
+            write_oom_forensics(path, report)
+            logger.error(
+                f"device allocation failure at step {self.global_steps + 1} "
+                f"— OOM forensics (attribution + program table) -> {path}")
+        except OSError as e:
+            logger.error(f"OOM forensics dump failed: {e}")
+        if self.resilience is not None:
+            self.resilience.on_allocation_failure(path)
+
     def dump_trace(self, path: str) -> str:
         """Write captured spans as Chrome-trace JSON (load in Perfetto /
         chrome://tracing). Requires the ``observability`` block; see
@@ -1631,7 +1729,11 @@ class DeepSpeedEngine:
         prints)."""
         if self.observability is None:
             from ..observability import get_registry
-            return {"registry": get_registry().snapshot()}
+            from ..observability.memory import get_accountant
+            from ..observability.programs import get_program_registry
+            return {"registry": get_registry().snapshot(),
+                    "memory": get_accountant().report(),
+                    "programs": get_program_registry().table()}
         return self.observability.snapshot()
 
     def _write_monitor(self, metrics):

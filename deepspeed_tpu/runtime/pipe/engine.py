@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P, NamedSharding
 
 from ... import comm as dist
+from ...observability.programs import track_program
 from ...observability.trace import span as _span
 from ...utils.jax_compat import shard_map
 from ...utils.logging import log_dist
@@ -306,17 +307,23 @@ class PipelineEngine(DeepSpeedEngine):
         with _span("data"):
             dev_batch = self._place_batch(batch, with_gas_dim=False)
         if "train_step" not in self._compiled:
-            self._compiled["train_step"] = self._make_train_step()
+            self._compiled["train_step"] = track_program(
+                "pipe/train_step", self._make_train_step(),
+                subsystem="pipe")
         scaler = self.loss_scale_state or init_loss_scale(1.0)
         rng = jax.random.fold_in(self.rng, self.global_steps + 1)
         self.tput_timer.start()
         if self.resilience is not None:
             self.resilience.on_step_start()
         with _span("fwd_bwd_step"):
-            self.params, self.optimizer_state, new_scaler, metrics = \
-                self._compiled["train_step"](self.params,
-                                             self.optimizer_state,
-                                             scaler, dev_batch, rng)
+            try:
+                self.params, self.optimizer_state, new_scaler, metrics = \
+                    self._compiled["train_step"](self.params,
+                                                 self.optimizer_state,
+                                                 scaler, dev_batch, rng)
+            except Exception as err:
+                self._note_dispatch_failure(err)   # OOM forensics dump
+                raise
         if self.fp16_enabled:
             self.loss_scale_state = new_scaler
             self._accumulate_skipped(metrics["skipped"])
@@ -334,9 +341,11 @@ class PipelineEngine(DeepSpeedEngine):
 
     def eval_batch(self, batch):
         if "eval" not in self._compiled:
-            self._compiled["eval"] = jax.jit(
-                lambda p, b: self._pipe_loss(p, b, jax.random.PRNGKey(0),
-                                             train=False))
+            self._compiled["eval"] = track_program(
+                "pipe/eval",
+                jax.jit(lambda p, b: self._pipe_loss(
+                    p, b, jax.random.PRNGKey(0), train=False)),
+                subsystem="pipe")
         return self._compiled["eval"](self.params, batch)
 
     # forward/backward/step split is not meaningful when the pipeline is a

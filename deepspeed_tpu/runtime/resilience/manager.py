@@ -86,6 +86,17 @@ class ResilienceManager:
         if eng.global_steps % self.config.divergence.check_interval == 0:
             self._host_check()
 
+    def on_allocation_failure(self, forensics_path: str) -> None:
+        """Device OOM during a dispatch (the engine already wrote the
+        memory-forensics dump — observability/memory.py): record the
+        event on the emergency path so the recovery timeline shows the
+        allocation failure alongside rollbacks and preemptions."""
+        self._emit("resilience/oom_forensics", 1.0,
+                   self.engine.global_steps)
+        logger.error(
+            f"resilience: device allocation failure at step "
+            f"{self.engine.global_steps}; forensics at {forensics_path}")
+
     # -- divergence / rollback ---------------------------------------------
     def _host_check(self) -> None:
         consec = self.sentinel.read_consecutive()

@@ -45,6 +45,8 @@ from ..inference.generation import (init_cache, _prefill_impl, _sample_impl,
                                     _sampling_mode)
 from ..inference.cache import (cache_max_len, make_row_cache, set_cache_index,
                                write_cache_row)
+from ..observability.memory import get_accountant
+from ..observability.programs import track_program
 from ..observability.trace import span as _span
 from ..utils.logging import log_dist
 from .config import ServingConfig
@@ -84,8 +86,10 @@ def _admit_impl(module, params, cache, state, prompt, prompt_len, slot,
     return cache, state, tok, done
 
 
-_admit_jit = jax.jit(_admit_impl, static_argnums=(0, 13, 14, 15, 16),
-                     donate_argnums=(2, 3))
+_admit_jit = track_program(
+    "serving/admit",
+    jax.jit(_admit_impl, static_argnums=(0, 13, 14, 15, 16),
+            donate_argnums=(2, 3)), subsystem="serving")
 
 
 def _decode_iter_impl(module, params, cache, state, rng, it, eos_id,
@@ -123,9 +127,10 @@ def _decode_iter_impl(module, params, cache, state, rng, it, eos_id,
     return vars_out["cache"], new_state, out_tok, done
 
 
-_decode_iter_jit = jax.jit(_decode_iter_impl,
-                           static_argnums=(0, 10, 11, 12, 13),
-                           donate_argnums=(2, 3))
+_decode_iter_jit = track_program(
+    "serving/decode_iter",
+    jax.jit(_decode_iter_impl, static_argnums=(0, 10, 11, 12, 13),
+            donate_argnums=(2, 3)), subsystem="serving")
 
 
 class ServingEngine:
@@ -206,9 +211,63 @@ class ServingEngine:
         self._pending = deque()           # in-flight readbacks, FIFO
         self._iteration = 0
         self._seq = 0
+        self._account_memory()
         log_dist(f"serving engine: {n} slots x {self.config.cache_len} "
                  f"tokens, prefill buckets {self.config.bucket_lengths()}",
                  ranks=[0])
+
+    def _account_memory(self):
+        """Tag the engine's resident device buffers in the process HBM
+        accountant (observability/memory.py) and publish the serving
+        memory gauges. Shape metadata only — no device reads. The paged
+        decode's contiguous gather scratch is derived from the pool's
+        own leaf shapes (the figure the PR-6 artifact hand-computed)."""
+        acct = get_accountant()
+        acct.account("serving/params", self.params)
+        if self._paged is not None:
+            acct.account("serving/kv_pool",
+                         num_bytes=self._paged.pool_bytes(),
+                         name="page_pool")
+            acct.account("serving/kv_pool", self._paged.page_table,
+                         name="page_table")
+            transient = self._paged.decode_gather_transient_bytes()
+            acct.registry.gauge("mem/decode_gather_transient").set(transient)
+        else:
+            acct.account("serving/kv_pool", self._cache, name="slot_cache")
+        acct.account("serving/state", self._state)
+        acct.registry.gauge("mem/kv_pool_resident").set(
+            acct.subsystem_bytes("serving/kv_pool"))
+
+    def memory_report(self) -> dict:
+        """Serving-side memory block (the BENCH_serving artifact embeds
+        this next to the ``perf`` block): subsystem attribution plus the
+        derived KV-pool resident / decode-gather transient figures."""
+        acct = get_accountant()
+        out = {
+            "by_subsystem": {
+                tag: info["bytes"]
+                for tag, info in acct.report()["by_subsystem"].items()
+                if tag.startswith("serving/")},
+            "kv_pool_resident_bytes": acct.subsystem_bytes("serving/kv_pool"),
+        }
+        if self._paged is not None:
+            out["decode_gather_transient_bytes"] = \
+                self._paged.decode_gather_transient_bytes()
+        return out
+
+    def close(self):
+        """Release this engine's accountant attribution (the serving
+        mirror of ``DeepSpeedEngine.destroy()``): a torn-down engine's
+        KV pool and weights must not linger in ``mem/*`` gauges or a
+        later OOM forensics dump. Explicit like destroy() — a newer
+        serving engine re-states the ``serving/*`` tags, so an implicit
+        ``__del__`` could wipe its successor's figures. Idempotent."""
+        acct = get_accountant()
+        for tag in ("serving/params", "serving/kv_pool", "serving/state"):
+            acct.discard(tag)
+        acct.registry.gauge("mem/kv_pool_resident").set(0)
+        if self._paged is not None:
+            acct.registry.gauge("mem/decode_gather_transient").set(0)
 
     # -- client API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -367,7 +426,10 @@ class ServingEngine:
             padded[0, :n] = req.prompt
             greedy, has_k, has_p, t, k, p = self._mode
             rng = self._req_rng(req)
-            with _span("serving/admit"):
+            # request_id in the span args: a trace capture can rebuild
+            # per-request latency (admit -> decode iterations -> harvest)
+            with _span("serving/admit", {"request_id": req.request_id,
+                                         "prompt_len": n}):
                 self._cache, self._state, tok, done = _admit_jit(
                     self.module, self.params, self._cache, self._state,
                     jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
@@ -449,7 +511,8 @@ class ServingEngine:
         greedy, has_k, has_p, t, k, p = self._mode
         mgr = self._paged
         with _span("serving/prefill_chunk",
-                   {"slot": slot, "start": start, "tokens": real,
+                   {"slot": slot, "request_id": req.request_id,
+                    "start": start, "tokens": real,
                     "last": bool(is_last)}):
             mgr.pool, self._state, tok, done = _chunk_prefill_jit(
                 self.module, self.params, mgr.pool, self._state,
@@ -471,8 +534,12 @@ class ServingEngine:
             return False
         greedy, has_k, has_p, t, k, p = self._mode
         snapshot = list(self._slot_req)
+        busy = sum(r is not None for r in snapshot)
         rng = jax.random.fold_in(self._rng, 2**31)
-        with _span("serving/decode_iter"):
+        # active request count on the span: trace captures show how full
+        # each decode dispatch ran (the SLO-reconstruction groundwork)
+        with _span("serving/decode_iter", {"active_requests": busy,
+                                           "iteration": self._iteration}):
             if self._paged is not None:
                 mgr = self._paged
                 mgr.pool, self._state, toks, done = _paged_decode_jit(
@@ -485,7 +552,6 @@ class ServingEngine:
                     self.module, self.params, self._cache, self._state,
                     rng, jnp.int32(self._iteration), self._eos, t, k, p,
                     self._param_transform, greedy, has_k, has_p)
-        busy = sum(r is not None for r in snapshot)
         self.metrics.on_decode_dispatch(busy, self.config.num_slots)
         self._pending.append(("decode", snapshot, toks, done))
         self._iteration += 1
@@ -496,7 +562,10 @@ class ServingEngine:
         dispatched >= pipeline_depth iterations ago) and stream its
         tokens/completions to their requests."""
         entry = self._pending.popleft()
-        with _span("serving/harvest"):
+        with _span("serving/harvest",
+                   {"kind": entry[0],
+                    "active_requests": sum(r is not None
+                                           for r in self._slot_req)}):
             if entry[0] == "admit":
                 _, slot, req, tok, done = entry
                 if req.done:     # cancelled between dispatch and readback

@@ -38,6 +38,7 @@ from ...inference.cache import (cache_max_len, cache_page_len,
                                 init_page_pool, scatter_chunk_pages,
                                 scatter_token_pages, set_cache_index)
 from ...inference.generation import _sample_impl
+from ...observability.programs import track_program
 from ...observability.trace import span as _span
 from ...utils.logging import log_dist
 from .allocator import NULL_PAGE, PageAllocator
@@ -118,9 +119,10 @@ def _paged_decode_iter_impl(module, params, pool, page_table, state, rng, it,
     return pool, new_state, out_tok, done
 
 
-_paged_decode_jit = jax.jit(_paged_decode_iter_impl,
-                            static_argnums=(0, 11, 12, 13, 14),
-                            donate_argnums=(2, 4))
+_paged_decode_jit = track_program(
+    "serving/paged_decode",
+    jax.jit(_paged_decode_iter_impl, static_argnums=(0, 11, 12, 13, 14),
+            donate_argnums=(2, 4)), subsystem="serving")
 
 
 def _chunk_prefill_impl(module, params, pool, state, ptab_row, chunk_ids,
@@ -179,9 +181,10 @@ def _chunk_prefill_impl(module, params, pool, state, ptab_row, chunk_ids,
     return pool, state, tok, done
 
 
-_chunk_prefill_jit = jax.jit(_chunk_prefill_impl,
-                             static_argnums=(0, 16, 17, 18, 19),
-                             donate_argnums=(2, 3))
+_chunk_prefill_jit = track_program(
+    "serving/chunk_prefill",
+    jax.jit(_chunk_prefill_impl, static_argnums=(0, 16, 17, 18, 19),
+            donate_argnums=(2, 3)), subsystem="serving")
 
 
 class PagedKVManager:
@@ -285,6 +288,22 @@ class PagedKVManager:
         return sum(int(leaf.size) * leaf.dtype.itemsize
                    for leaf in jax.tree.leaves(self.pool)
                    if getattr(leaf, "ndim", 0) >= 4)
+
+    def decode_gather_transient_bytes(self) -> int:
+        """Bytes of the contiguous ``[num_slots, h, d, cache_len]`` view
+        each jitted decode step gathers as XLA-managed scratch — derived
+        from the pool's own leaf shapes (the figure the PR-6 bench
+        artifact hand-computed; resident-vs-transient honesty in
+        docs/serving.md). Per attention unit: one page's bytes times
+        ``num_slots * max_pages``."""
+        num_slots = int(self.page_table.shape[0])
+        total = 0
+        for leaf in jax.tree.leaves(self.pool):
+            if getattr(leaf, "ndim", 0) >= 4:
+                pages_dim = int(leaf.shape[leaf.ndim - 4])
+                per_page = int(leaf.size) // pages_dim * leaf.dtype.itemsize
+                total += per_page * num_slots * self.max_pages
+        return total
 
     def stats(self) -> dict:
         usable = self.allocator.usable_pages

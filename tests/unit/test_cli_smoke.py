@@ -166,6 +166,13 @@ def test_bench_serving_paged_prefix_adversarial(tmp_path):
     assert pg["prefix_hits"] >= 1              # the shared prefix got reused
     kinds = {p["kind"] for p in art["per_request"]}
     assert "shared_prefix" in kinds and "long" in kinds
+    # the memory block rides next to perf, and the gather-transient
+    # figure is the accountant-derived one (same value both places)
+    mem = art["memory"]
+    assert mem["kv_pool_resident_bytes"] > 0
+    assert mem["decode_gather_transient_bytes"] \
+        == pg["decode_gather_transient_bytes"] > 0
+    assert "serving/kv_pool" in mem["by_subsystem"]
 
 
 def test_trace_windowed_capture(tmp_path):
@@ -192,6 +199,28 @@ def test_trace_windowed_capture(tmp_path):
     assert "train/tokens_per_sec" in snap["registry"]["gauges"]
     assert snap["perf"]["steps_measured"] >= 1
     assert "trace_summary" in snap
+    # the metrics snapshot embeds the memory + program blocks and the
+    # diffable capture stamp (ISSUE 7 satellites)
+    assert snap["registry"]["meta"]["capture_seq"] >= 1
+    assert snap["memory"]["by_subsystem"]["train/params"]["bytes"] > 0
+    # split mode drives the parity-path programs
+    assert snap["programs"]["train/fwd_grads"]["compiles"] == 1
+
+
+def test_trace_memory_sections(tmp_path):
+    """`ds_tpu_trace --memory` prints the ds_tpu_mem attribution +
+    compiled-program tables with per-program XLA analysis."""
+    r = _run([os.path.join(BIN, "ds_tpu_trace"), "--steps", "4",
+              "--mode", "fused",
+              "--batch-size", "4", "--seq-len", "16", "--vocab-size", "64",
+              "--d-model", "32", "--n-layers", "1", "--quiet", "--memory",
+              "--out", str(tmp_path / "trace.json"), "--cpu", "1"],
+             timeout=300)
+    assert r.returncode == 0, r.stdout[-800:] + r.stderr[-800:]
+    assert "ds_tpu_mem: memory attribution" in r.stdout
+    assert "train/params" in r.stdout
+    assert "ds_tpu_mem: compiled programs" in r.stdout
+    assert "train/train_step" in r.stdout
 
 
 def test_bench_trace_attaches_capture(tmp_path):
