@@ -23,11 +23,61 @@ not a framework property.
 """
 
 import json
+import os
+import signal
 import sys
 import time
 
 REF_ZERO3_OFFLOAD_TFLOPS = 49.5   # docs/_posts/2021-03-08-zero3-offload.md
 SEQ = 1024
+NORTH_STAR_METRIC = "gpt2_1p3b_zero_offload_train_tokens_per_sec_per_chip"
+PARTIAL_ARTIFACT_PATH = "BENCH_partial.json"
+
+
+def failure_artifact(reason, extra=None):
+    """The partial BENCH artifact emitted when the harness cannot finish
+    (timeout SIGTERM, unreachable backend, crash): same schema as the
+    success artifact so downstream parsing is uniform, ``failed: true``
+    plus the reason, and whatever sub-benches completed under ``extra``
+    — BENCH_r03..r05 left NO trace of why they died; this leaves one."""
+    return {
+        "metric": NORTH_STAR_METRIC,
+        "value": None,
+        "unit": "tokens/s/chip",
+        "vs_baseline": None,
+        "failed": True,
+        "reason": reason,
+        "extra": dict(extra) if extra else {},
+    }
+
+
+def emit_failure(reason, extra=None):
+    """Print the partial artifact to stdout (the BENCH capture channel)
+    AND to a sidecar file — a SIGKILL 10s after SIGTERM can still tear
+    the stdout pipe, but the sidecar survives."""
+    artifact = failure_artifact(reason, extra)
+    line = json.dumps(artifact)
+    print(line, flush=True)
+    try:
+        with open(PARTIAL_ARTIFACT_PATH, "w") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass   # read-only cwd: the stdout line is still the artifact
+    return artifact
+
+
+def install_failure_handlers(extra):
+    """SIGTERM/SIGINT (the ``timeout -k`` kill path) emit the partial
+    artifact before dying. ``extra`` is the LIVE dict main() fills in —
+    whatever finished before the signal is preserved in the artifact."""
+    def _on_signal(signum, frame):
+        emit_failure(f"killed by signal {signum} "
+                     f"({signal.Signals(signum).name}) — harness timeout "
+                     "or external stop before the run completed", extra)
+        os._exit(0)   # the artifact IS the result; mirror the
+        #               unreachable-backend path's exit-0 convention
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _on_signal)
 
 
 def _interleaved_ms(np, fns, args, reps, trials=5):
@@ -125,12 +175,17 @@ def _train_bench(preset, config_extra, micro, gas, steps, np, jax, jnp, ds,
     for _ in range(2):
         loss = engine.train_batch(batch)
     _fetch(engine.params)
+    # goodput over the MEASURED window only (warmup compiles would
+    # otherwise dominate the compile fraction of a 3-step bench)
+    from deepspeed_tpu.observability.goodput import reset_ledger
+    ledger = reset_ledger()
     t0 = time.time()
     for _ in range(steps):
         loss = engine.train_batch(batch)
     _ = np.asarray(loss)
     _fetch(engine.params)
     dt = (time.time() - t0) / steps
+    goodput = ledger.breakdown()
     tokens_per_sec = global_batch * SEQ / dt
     per_chip = tokens_per_sec / n_chips
     tflops = 6 * mcfg.num_params() * per_chip / 1e12
@@ -150,6 +205,9 @@ def _train_bench(preset, config_extra, micro, gas, steps, np, jax, jnp, ds,
             "model_tflops_per_chip": round(tflops, 1),
             "step_ms": round(dt * 1e3, 1),
             "memory": memory,
+            "goodput": {k: goodput[k] for k in
+                        ("wall_s", "fractions", "goodput_fraction",
+                         "badput_fraction") if k in goodput},
             "loss": round(float(loss), 3)}
 
 
@@ -661,13 +719,9 @@ def _device_watchdog(probe_timeout_s=None, interval_s=None, window_s=None):
                          "in-process backend init then hung (flap "
                          "between probe and init)" if init_hangs else
                          "; tunnel down?"))
-            print(json.dumps({
-                "metric":
-                    "gpt2_1p3b_zero_offload_train_tokens_per_sec_per_chip",
-                "value": None, "unit": "tokens/s/chip", "vs_baseline": None,
-                "error": "accelerator backend unreachable for the whole "
+            emit_failure("accelerator backend unreachable for the whole "
                          f"{window_s}s probe window ({detail}) — no "
-                         "measurements taken"}))
+                         "measurements taken")
             raise SystemExit(0)
         print(f"# probe {attempt}: backend unreachable; retrying in "
               f"{interval_s}s ({int(remaining)}s left in window)",
@@ -676,14 +730,17 @@ def _device_watchdog(probe_timeout_s=None, interval_s=None, window_s=None):
 
 
 def main():
+    extra = {}
+    # a SIGTERM (timeout -k) landing anywhere past this point — probe
+    # window, imports, mid-bench — leaves a partial artifact with every
+    # completed sub-bench instead of nothing (the BENCH_r03..r05 lesson)
+    install_failure_handlers(extra)
     _device_watchdog()
     import numpy as np
     import jax
     import jax.numpy as jnp
     import deepspeed_tpu as ds
     import deepspeed_tpu.models as models
-
-    extra = {}
 
     def run(name, fn, *a, **kw):
         try:
@@ -731,4 +788,12 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except SystemExit:
+        raise           # the watchdog already emitted its artifact
+    except BaseException as e:
+        # crash anywhere (backend import, driver bug): the artifact
+        # records WHY instead of leaving an empty capture
+        emit_failure(f"harness crashed: {type(e).__name__}: {e}")
+        raise

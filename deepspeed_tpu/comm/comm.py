@@ -24,6 +24,8 @@ import time
 from enum import Enum
 from typing import Optional
 
+from ..observability.metrics import get_registry, record_traced_collective
+from ..observability.trace import span as _span
 from ..utils.logging import logger, log_dist
 from .mesh import (MESH_AXES, MeshSpec, build_mesh, get_global_mesh,
                    peek_global_mesh, set_global_mesh,
@@ -137,6 +139,63 @@ def barrier(group=None, name="ds_barrier"):
 
 
 # ---------------------------------------------------------------------------
+# Collective accounting (docs/observability.md, "Collective accounting").
+#
+# In-jit collectives execute inside XLA programs — host-timing one would
+# require a per-op sync (exactly what TS002 forbids). Instead every
+# wrapper records AT TRACE TIME: op, axis, dtype, and payload bytes go
+# into a trace span (``comm/<op>``, carried in the span args) and the
+# process tally in observability/metrics.py (``comm/traced_bytes/...``
+# counters, keyed op:axis so ICI-bound model/fsdp traffic separates from
+# DCN-bound data traffic). TrackedProgram diffs the tally around a
+# compiling dispatch, turning the static record into a per-program
+# bytes-moved-per-call estimate and a cumulative executed-traffic
+# counter. Achieved bytes/sec is measurable only where a wall clock is
+# honest — the host-path ops below, via the comms logger + the
+# ``comm/host_bytes_per_s`` histogram.
+# ---------------------------------------------------------------------------
+
+def _group_label(group) -> str:
+    """Stable axis label for tally keys and span args ("all" = whole
+    mesh; tuples join with '+')."""
+    if group is None:
+        return "all"
+    if isinstance(group, str):
+        return group
+    return "+".join(str(g) for g in group)
+
+
+def _payload_nbytes(tensor) -> int:
+    """Payload bytes from STATIC shape/dtype metadata — works on traced
+    values (aval shapes are python ints), never reads device data."""
+    shape = getattr(tensor, "shape", None)
+    dtype = getattr(tensor, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    try:
+        itemsize = dtype.itemsize
+    except AttributeError:
+        import numpy as np
+        itemsize = np.dtype(dtype).itemsize
+    return n * int(itemsize)
+
+
+def _note_collective(op: str, group, tensor, nbytes: Optional[int] = None):
+    """Record one collective (trace-time) and return the ``comm/<op>``
+    span to wrap the lax call — the span's wall time is TRACE time (a
+    compile-cost signal), its args are the payload record."""
+    if nbytes is None:
+        nbytes = _payload_nbytes(tensor)
+    axis = _group_label(group)
+    record_traced_collective(op, axis, nbytes)
+    return _span(f"comm/{op}", {"axis": axis, "bytes": int(nbytes),
+                                "dtype": str(getattr(tensor, "dtype", "?"))})
+
+
+# ---------------------------------------------------------------------------
 # In-jit collectives (call inside shard_map with the axis bound).
 # ---------------------------------------------------------------------------
 
@@ -193,21 +252,26 @@ def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group=None):
     """lax.psum/pmean/... over a mesh axis (reference: comm.py:500)."""
     import jax
     axis = _axis(group)
-    if op == ReduceOp.SUM:
-        return jax.lax.psum(tensor, axis)
-    if op == ReduceOp.AVG:
-        return jax.lax.pmean(tensor, axis)
-    if op == ReduceOp.MAX:
-        return jax.lax.pmax(tensor, axis)
-    if op == ReduceOp.MIN:
-        return jax.lax.pmin(tensor, axis)
-    if op == ReduceOp.PRODUCT:
-        # No lax product-reduce primitive: gather the factors and multiply.
-        # (Correct for zeros/negatives, unlike exp(psum(log)).)
+    if op not in (ReduceOp.SUM, ReduceOp.AVG, ReduceOp.MAX, ReduceOp.MIN,
+                  ReduceOp.PRODUCT):
+        # validate BEFORE recording: a rejected op must not inflate the
+        # traced-bytes tally (or a compiling program's attribution)
+        raise ValueError(f"Unsupported reduce op {op}")
+    with _note_collective("all_reduce", group, tensor):
+        if op == ReduceOp.SUM:
+            return jax.lax.psum(tensor, axis)
+        if op == ReduceOp.AVG:
+            return jax.lax.pmean(tensor, axis)
+        if op == ReduceOp.MAX:
+            return jax.lax.pmax(tensor, axis)
+        if op == ReduceOp.MIN:
+            return jax.lax.pmin(tensor, axis)
+        # PRODUCT: no lax product-reduce primitive — gather the factors
+        # and multiply. (Correct for zeros/negatives, unlike
+        # exp(psum(log)).)
         import jax.numpy as jnp
         gathered = jax.lax.all_gather(tensor, axis, axis=0, tiled=False)
         return jnp.prod(gathered, axis=0)
-    raise ValueError(f"Unsupported reduce op {op}")
 
 
 def inference_all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group="model"):
@@ -221,25 +285,31 @@ def all_gather(tensor, group=None, axis: int = 0, tiled: bool = True):
     semantics); ``tiled=False`` stacks a new leading dim.
     """
     import jax
-    return jax.lax.all_gather(tensor, _axis(group), axis=axis, tiled=tiled)
+    with _note_collective("all_gather", group, tensor):
+        return jax.lax.all_gather(tensor, _axis(group), axis=axis,
+                                  tiled=tiled)
 
 
 def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, group=None, scatter_dimension: int = 0):
     """lax.psum_scatter (reference: reduce_scatter_fn comm.py:256)."""
     import jax
     assert op in (ReduceOp.SUM, ReduceOp.AVG)
-    out = jax.lax.psum_scatter(tensor, _axis(group),
-                               scatter_dimension=scatter_dimension, tiled=True)
-    if op == ReduceOp.AVG:
-        out = out / axis_size(_axis(group))
+    with _note_collective("reduce_scatter", group, tensor):
+        out = jax.lax.psum_scatter(tensor, _axis(group),
+                                   scatter_dimension=scatter_dimension,
+                                   tiled=True)
+        if op == ReduceOp.AVG:
+            out = out / axis_size(_axis(group))
     return out
 
 
 def all_to_all_single(tensor, group=None, split_axis: int = 0, concat_axis: int = 0):
     """lax.all_to_all (reference: all_to_all_single comm.py:355)."""
     import jax
-    return jax.lax.all_to_all(tensor, _axis(group), split_axis=split_axis,
-                              concat_axis=concat_axis, tiled=True)
+    with _note_collective("all_to_all", group, tensor):
+        return jax.lax.all_to_all(tensor, _axis(group),
+                                  split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
 
 
 def broadcast(tensor, src: int = 0, group=None):
@@ -251,15 +321,17 @@ def broadcast(tensor, src: int = 0, group=None):
     import jax
     import jax.numpy as jnp
     axis = _axis(group)
-    idx = jax.lax.axis_index(axis)
-    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
-    return jax.lax.psum(masked, axis)
+    with _note_collective("broadcast", group, tensor):
+        idx = jax.lax.axis_index(axis)
+        masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+        return jax.lax.psum(masked, axis)
 
 
 def ppermute(tensor, perm, group):
     """Neighbor exchange (pipeline p2p / ring attention building block)."""
     import jax
-    return jax.lax.ppermute(tensor, _axis(group), perm)
+    with _note_collective("ppermute", group, tensor):
+        return jax.lax.ppermute(tensor, _axis(group), perm)
 
 
 def send_recv_next(tensor, group):
@@ -358,11 +430,22 @@ def log_summary():
 
 
 def timed_host_op(name, fn, tensor, *args, **kwargs):
-    """Run a host-path op with wall-clock timing into the comms logger."""
+    """Run a host-path op with wall-clock timing into the comms logger
+    AND the shared registry (``comm/host_bytes_per_s`` histogram +
+    ``comm/host_bytes_total`` counter) — the achieved-bandwidth side of
+    the collective accounting; only host-path ops can be wall-timed
+    honestly (their ``block_until_ready`` is the benchmark's own sync,
+    not a step-path one)."""
     if _COMMS_LOGGER is None:
         return fn(tensor, *args, **kwargs)
     t0 = time.time()
     out = fn(tensor, *args, **kwargs)
     out.block_until_ready()
-    _COMMS_LOGGER.append(name, time.time() - t0, tensor.size * tensor.dtype.itemsize)
+    elapsed = time.time() - t0
+    nbytes = tensor.size * tensor.dtype.itemsize
+    _COMMS_LOGGER.append(name, elapsed, nbytes)
+    reg = get_registry()
+    reg.counter("comm/host_bytes_total").inc(int(nbytes))
+    if elapsed > 0:
+        reg.histogram("comm/host_bytes_per_s").observe(nbytes / elapsed)
     return out

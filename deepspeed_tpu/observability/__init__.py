@@ -20,13 +20,19 @@ obeys the no-per-step-host-sync rule (ds_tpu_lint TS002 gates this
 package at zero findings).
 """
 
-from .config import MemoryConfig, ObservabilityConfig
+from .config import ExportConfig, MemoryConfig, ObservabilityConfig
+from .export import (TelemetryServer, build_statusz, prometheus_name,
+                     render_prometheus)
+from .goodput import (CATEGORIES as GOODPUT_TAXONOMY, GoodputLedger,
+                      classify_spans, format_goodput, get_ledger,
+                      reset_ledger)
 from .memory import (MemoryAccountant, device_memory_stats,
                      estimate_forward_memory_bytes, format_memory_report,
                      get_accountant, is_oom_error, oom_forensics,
                      tree_bytes, write_oom_forensics)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
-                      get_registry)
+                      collective_tally, diff_snapshots,
+                      format_snapshot_diff, get_registry)
 from .perf import (CHIP_PEAK_TFLOPS, PerfAccountant, detect_chip,
                    resolve_peak_flops)
 from .programs import (ProgramRegistry, TrackedProgram,
@@ -37,8 +43,13 @@ from .trace import (DeviceProbe, Tracer, activate, active_tracer,
                     summarize, summarize_trace_file, write_chrome_trace)
 
 __all__ = [
-    "ObservabilityConfig", "MemoryConfig", "Observability",
+    "ObservabilityConfig", "MemoryConfig", "ExportConfig", "Observability",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "GoodputLedger", "GOODPUT_TAXONOMY", "classify_spans", "format_goodput",
+    "get_ledger", "reset_ledger",
+    "TelemetryServer", "build_statusz", "prometheus_name",
+    "render_prometheus",
+    "collective_tally", "diff_snapshots", "format_snapshot_diff",
     "CHIP_PEAK_TFLOPS", "PerfAccountant", "detect_chip",
     "resolve_peak_flops",
     "MemoryAccountant", "get_accountant", "tree_bytes",
@@ -74,6 +85,12 @@ class Observability:
         # this bundle's config block tunes it
         self.memory = get_accountant()
         self.memory.config = config.memory
+        # arm the process-wide goodput ledger so the engine's timed()
+        # call sites record (goodput.py; host clock reads only). NOT
+        # cached on self: reset_ledger() (bench measurement windows)
+        # rebinds the module global, and a snapshot must read whatever
+        # ledger the timed() sites are currently feeding.
+        get_ledger().start()
         self.metrics_interval = (config.metrics_interval
                                  if config.metrics_interval is not None
                                  else max(1, int(steps_per_print)))
@@ -152,6 +169,7 @@ class Observability:
         return {
             "registry": self.registry.snapshot(),
             "perf": self.perf.summary(),
+            "goodput": get_ledger().breakdown(),
             "probe": {"interval": self.probe.interval,
                       "host_reads": self.probe.host_reads,
                       "last_wait_s": self.probe.last_wait_s},

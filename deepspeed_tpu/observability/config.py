@@ -40,6 +40,27 @@ class MemoryConfig:
 
 
 @dataclass
+class ExportConfig:
+    """``observability.export`` sub-block (docs/observability.md,
+    "Telemetry endpoint"): the live /metrics + /healthz + /statusz HTTP
+    server. Served from a daemon thread off the hot path; every value it
+    reads is a host float/int, so a scrape can never add a device sync.
+    Binds loopback by default — widening ``host`` publishes program
+    shapes and run metadata to the network (see the security caveats in
+    the docs)."""
+    enabled: bool = False
+    host: str = "127.0.0.1"          # bind address; 0.0.0.0 is opt-in
+    port: int = 9799                 # 0 = ephemeral (the bound port is
+                                     # logged and exposed on the server)
+
+    def __post_init__(self):
+        if not (0 <= self.port <= 65535):
+            raise ValueError(
+                f"observability.export.port must be in [0, 65535], got "
+                f"{self.port}")
+
+
+@dataclass
 class ObservabilityConfig:
     """Unified observability knobs (docs/observability.md).
 
@@ -77,11 +98,16 @@ class ObservabilityConfig:
     memory: MemoryConfig = field(default_factory=MemoryConfig)
                                      # HBM accountant / program registry
                                      # sub-block (accepts a plain dict)
+    export: ExportConfig = field(default_factory=ExportConfig)
+                                     # live /metrics + /statusz endpoint
+                                     # sub-block (accepts a plain dict)
 
     def __post_init__(self):
         if isinstance(self.memory, dict):
             # dict_to_dataclass is shallow: the nested block arrives raw
             self.memory = MemoryConfig(**self.memory)
+        if isinstance(self.export, dict):
+            self.export = ExportConfig(**self.export)
         if self.trace_start_step < 0:
             raise ValueError(f"observability.trace_start_step must be >= 0, "
                              f"got {self.trace_start_step}")

@@ -1,0 +1,236 @@
+"""Live telemetry endpoint: /metrics (Prometheus), /healthz, /statusz.
+
+The PR-5/7 observability layers end in JSON artifacts — fine for
+post-hoc analysis, useless for an operator watching a live run. This
+module serves the SAME snapshot payload over HTTP from a daemon thread:
+
+- ``/metrics``  — Prometheus text exposition format (version 0.0.4)
+  rendered from the registry snapshot + goodput/perf blocks, scrapeable
+  by any Prometheus-compatible collector;
+- ``/healthz``  — liveness: 200 ``ok`` while the thread serves;
+- ``/statusz``  — the operator page as JSON: goodput breakdown, the
+  compiled-program table, memory attribution, serving queue/slot state.
+
+Threading contract: the handler calls ``snapshot_fn`` (engine
+``metrics_snapshot``) on the SERVER thread while the training/serving
+thread mutates host dicts. Every value involved is a host float/int —
+the endpoint NEVER touches the device (no ``device_get``, no
+``block_until_ready``), so a scrape cannot add a host sync to the step
+path; a rare concurrent-mutation ``RuntimeError`` during dict iteration
+is retried once and then reported as 503, never propagated into the
+run.
+
+Security: binds ``127.0.0.1`` by default — the payload includes program
+shapes and config-adjacent metadata, so exposing it beyond the host is
+an explicit operator decision (``observability.export.host``, see the
+caveats in docs/observability.md).
+
+Stdlib only (``http.server``), like every module in this package.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+_NAME_OK = set("abcdefghijklmnopqrstuvwxyz"
+               "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
+
+
+def prometheus_name(name: str, prefix: str = "ds_tpu_") -> str:
+    """Registry name -> Prometheus metric name: path separators and
+    every other illegal character become ``_``; the ``ds_tpu_`` prefix
+    namespaces the exposition."""
+    out = "".join(ch if ch in _NAME_OK else "_" for ch in str(name))
+    if out and out[0].isdigit():
+        out = "_" + out
+    return prefix + out
+
+
+def _fmt_value(v) -> Optional[str]:
+    """Prometheus sample value, or None for non-numeric payloads."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, (int, float)):
+        return repr(float(v))
+    return None
+
+
+def render_prometheus(snapshot: dict) -> str:
+    """Render an engine ``metrics_snapshot()`` (or a bare registry
+    snapshot) as Prometheus text exposition. Counters/gauges map
+    directly; histograms emit ``_count``/``_sum`` plus p50/p95 as
+    ``{quantile=...}`` samples (the summary convention); the ``goodput``
+    block emits ``ds_tpu_goodput_seconds``/``_fraction`` with a
+    ``category`` label and a ``kind`` label marking goodput vs badput;
+    ``perf`` and numeric ``collected.*`` values become gauges."""
+    reg = snapshot.get("registry", snapshot)
+    lines = []
+
+    def sample(name, value, labels=None, help_=None, type_=None):
+        val = _fmt_value(value)
+        if val is None:
+            return
+        if help_:
+            lines.append(f"# HELP {name} {help_}")
+        if type_:
+            lines.append(f"# TYPE {name} {type_}")
+        lab = ""
+        if labels:
+            inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+            lab = "{" + inner + "}"
+        lines.append(f"{name}{lab} {val}")
+
+    for name, value in (reg.get("counters") or {}).items():
+        sample(prometheus_name(name), value, type_="counter")
+    for name, value in (reg.get("gauges") or {}).items():
+        sample(prometheus_name(name), value, type_="gauge")
+    for name, summ in (reg.get("histograms") or {}).items():
+        base = prometheus_name(name)
+        lines.append(f"# TYPE {base} summary")
+        for q, key in (("0.5", "p50"), ("0.95", "p95")):
+            if summ.get(key) is not None:
+                sample(base, summ[key], labels={"quantile": q})
+        sample(base + "_count", summ.get("count", 0))
+        sample(base + "_sum", summ.get("sum", 0.0))
+    for coll_name, values in (reg.get("collected") or {}).items():
+        if not isinstance(values, dict):
+            continue
+        for key, value in values.items():
+            sample(prometheus_name(f"{coll_name}/{key}"), value,
+                   type_="gauge")
+    for key, value in (snapshot.get("perf") or {}).items():
+        sample(prometheus_name(f"perf/{key}"), value, type_="gauge")
+    goodput = snapshot.get("goodput") or {}
+    if goodput.get("fractions"):
+        from .goodput import GOODPUT_CATEGORIES
+        lines.append("# TYPE ds_tpu_goodput_fraction gauge")
+        lines.append("# TYPE ds_tpu_goodput_seconds gauge")
+        for cat, frac in goodput["fractions"].items():
+            kind = ("goodput" if cat in GOODPUT_CATEGORIES else "badput")
+            labels = {"category": cat, "kind": kind}
+            sample("ds_tpu_goodput_fraction", frac, labels=labels)
+            sample("ds_tpu_goodput_seconds",
+                   goodput["seconds"].get(cat, 0.0), labels=labels)
+        sample("ds_tpu_goodput_wall_seconds", goodput.get("wall_s"),
+               type_="gauge")
+    probe = snapshot.get("probe") or {}
+    if probe:
+        sample("ds_tpu_probe_host_reads", probe.get("host_reads"),
+               type_="counter")
+    return "\n".join(lines) + "\n"
+
+
+def build_statusz(snapshot: dict) -> dict:
+    """The /statusz payload: the operator-facing sections of a snapshot
+    (goodput breakdown, program table, memory attribution, serving
+    queue/slot state), plus the capture meta header."""
+    reg = snapshot.get("registry", snapshot)
+    collected = reg.get("collected") or {}
+    return {
+        "meta": reg.get("meta") or {},
+        "goodput": snapshot.get("goodput") or {},
+        "programs": snapshot.get("programs") or {},
+        "memory": snapshot.get("memory") or {},
+        "serving": collected.get("serving")
+        or snapshot.get("serving") or {},
+        "perf": snapshot.get("perf") or {},
+        "counters": reg.get("counters") or {},
+        "gauges": reg.get("gauges") or {},
+    }
+
+
+class TelemetryServer:
+    """Daemon-thread HTTP server over a snapshot callable.
+
+    ``snapshot_fn`` runs on the server thread per request and must stay
+    host-only (the engines' ``metrics_snapshot`` qualifies). ``port=0``
+    binds an ephemeral port; read the bound one from ``.port`` (the CLI
+    prints it). ``stop()`` shuts the thread down; engines call it from
+    ``destroy()``/``close()`` so a torn-down engine never serves stale
+    state."""
+
+    def __init__(self, snapshot_fn: Callable[[], dict],
+                 host: str = "127.0.0.1", port: int = 0):
+        self._snapshot_fn = snapshot_fn
+        self.host = host
+        self.requested_port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self) -> Optional[int]:
+        """The actually-bound port (resolves ``port=0``), None before
+        ``start()``."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def start(self) -> "TelemetryServer":
+        if self._httpd is not None:
+            return self
+        snapshot_fn = self._snapshot_fn
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass                     # no per-scrape stderr noise
+
+            def _reply(self, code, body, content_type):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _snapshot(self):
+                # host-dict reads can race a mutating step; one retry
+                # absorbs the transient, a repeat is a 503 (the scrape
+                # must never propagate into the run)
+                try:
+                    return snapshot_fn()
+                except RuntimeError:
+                    return snapshot_fn()
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        self._reply(200, "ok\n", "text/plain")
+                    elif path == "/metrics":
+                        body = render_prometheus(self._snapshot())
+                        self._reply(200, body,
+                                    "text/plain; version=0.0.4")
+                    elif path == "/statusz":
+                        body = json.dumps(build_statusz(self._snapshot()),
+                                          indent=1, default=str)
+                        self._reply(200, body + "\n", "application/json")
+                    else:
+                        self._reply(404, "not found\n", "text/plain")
+                except (RuntimeError, ValueError, TypeError) as e:
+                    self._reply(503, f"snapshot unavailable: {e}\n",
+                                "text/plain")
+
+        self._httpd = ThreadingHTTPServer((self.host, self.requested_port),
+                                          Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="ds-tpu-telemetry", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None

@@ -201,3 +201,140 @@ def get_registry() -> MetricsRegistry:
     if _DEFAULT_REGISTRY is None:
         _DEFAULT_REGISTRY = MetricsRegistry()
     return _DEFAULT_REGISTRY
+
+
+# ---------------------------------------------------------------------------
+# Collective-traffic tally (the comm/ instrumentation's host side)
+# ---------------------------------------------------------------------------
+#
+# comm.py wrappers record here AT TRACE TIME — once per compile, never
+# per executed step (in-jit collectives cannot be host-timed without a
+# sync, the TS002 rule). The process tally is keyed "op:axis" so the
+# registry separates ICI-bound (model/fsdp/...) from DCN-bound (data
+# across slices) traffic; TrackedProgram diffs the tally around a
+# compiling dispatch to attribute the traced bytes to that program
+# (programs.py), turning the static record into a per-call estimate.
+
+_COLLECTIVE_TALLY: Dict[str, int] = {}
+
+
+def record_traced_collective(op: str, axis: str, nbytes: int):
+    """One collective traced: bump the process tally and the registry's
+    bytes-by-collective counters. Host ints only — callable from inside
+    a jit trace (it runs at trace time, not at execution time)."""
+    key = f"{op}:{axis}"
+    _COLLECTIVE_TALLY[key] = _COLLECTIVE_TALLY.get(key, 0) + int(nbytes)
+    reg = get_registry()
+    reg.counter(f"comm/traced_calls/{key}").inc()
+    reg.counter(f"comm/traced_bytes/{key}").inc(int(nbytes))
+
+
+def collective_tally() -> Dict[str, int]:
+    """Snapshot of the cumulative traced-collective bytes by op:axis."""
+    return dict(_COLLECTIVE_TALLY)
+
+
+def diff_collective_tally(before: Dict[str, int]) -> Dict[str, int]:
+    """Per-key growth of the tally since ``before`` (a
+    ``collective_tally()`` snapshot) — what one compiling dispatch
+    traced."""
+    return {k: v - before.get(k, 0)
+            for k, v in _COLLECTIVE_TALLY.items()
+            if v - before.get(k, 0) > 0}
+
+
+# ---------------------------------------------------------------------------
+# Snapshot diffing (ds_tpu_report --diff)
+# ---------------------------------------------------------------------------
+
+def diff_snapshots(a: dict, b: dict) -> dict:
+    """Diff two metrics snapshots (engine ``metrics_snapshot()`` payloads
+    or bare registry snapshots): counters as deltas, gauges as
+    before -> after. Ordering comes from the ``meta`` capture stamps —
+    ``capture_seq`` when both snapshots came from one process, the
+    monotonic clock otherwise; when ``b`` predates ``a`` the inputs are
+    swapped and the result says so. ``elapsed_s`` (the monotonic delta)
+    turns counter deltas into rates where available."""
+    ra, rb = a.get("registry", a), b.get("registry", b)
+    ma, mb = ra.get("meta") or {}, rb.get("meta") or {}
+
+    def stamp(m):
+        # unix wall clock first: the only stamp meaningful ACROSS
+        # processes (a restarted run's capture_seq starts over at 1);
+        # capture_seq breaks same-process ties taken within one wall
+        # tick, monotonic breaks whatever is left
+        return (m.get("captured_at_unix") or 0.0,
+                m.get("capture_seq") or 0,
+                m.get("captured_at_monotonic_s") or 0.0)
+
+    swapped = stamp(mb) < stamp(ma)
+    if swapped:
+        ra, rb, ma, mb = rb, ra, mb, ma
+    # same-process pair (the seq advanced and the monotonic clock agrees)
+    # -> the monotonic delta is the precise elapsed; across processes the
+    # clocks share no epoch, so fall back to the unix wall delta
+    mono_a = ma.get("captured_at_monotonic_s")
+    mono_b = mb.get("captured_at_monotonic_s")
+    seq_a, seq_b = ma.get("capture_seq") or 0, mb.get("capture_seq") or 0
+    elapsed = None
+    if (mono_a is not None and mono_b is not None
+            and seq_b > seq_a and mono_b >= mono_a):
+        elapsed = mono_b - mono_a
+    elif (ma.get("captured_at_unix") is not None
+            and mb.get("captured_at_unix") is not None):
+        elapsed = mb["captured_at_unix"] - ma["captured_at_unix"]
+    counters = {}
+    ca, cb = ra.get("counters") or {}, rb.get("counters") or {}
+    for name in sorted(set(ca) | set(cb)):
+        before, after = ca.get(name, 0), cb.get(name, 0)
+        entry = {"before": before, "after": after, "delta": after - before}
+        if elapsed and elapsed > 0:
+            entry["per_s"] = entry["delta"] / elapsed
+        counters[name] = entry
+    gauges = {}
+    ga, gb = ra.get("gauges") or {}, rb.get("gauges") or {}
+    for name in sorted(set(ga) | set(gb)):
+        gauges[name] = {"before": ga.get(name), "after": gb.get(name)}
+    hists = {}
+    ha, hb = ra.get("histograms") or {}, rb.get("histograms") or {}
+    for name in sorted(set(ha) | set(hb)):
+        sa, sb = ha.get(name) or {}, hb.get(name) or {}
+        hists[name] = {
+            "count_delta": sb.get("count", 0) - sa.get("count", 0),
+            "sum_delta": sb.get("sum", 0.0) - sa.get("sum", 0.0),
+            "p50_before": sa.get("p50"), "p50_after": sb.get("p50"),
+            "p95_before": sa.get("p95"), "p95_after": sb.get("p95"),
+        }
+    return {
+        "meta": {"from": ma, "to": mb, "elapsed_s": elapsed,
+                 "swapped_inputs": swapped},
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": hists,
+    }
+
+
+def format_snapshot_diff(diff: dict) -> str:
+    """Text rendering of ``diff_snapshots`` (the ``ds_tpu_report --diff``
+    output): only moved counters, only changed gauges."""
+    meta = diff["meta"]
+    header = "snapshot diff"
+    if meta.get("elapsed_s") is not None:
+        header += f" over {meta['elapsed_s']:.3f}s"
+    if meta.get("swapped_inputs"):
+        header += " (inputs were newest-first; swapped)"
+    lines = [header, "counters (delta):"]
+    moved = {n: e for n, e in diff["counters"].items() if e["delta"]}
+    for name, e in moved.items():
+        rate = f"  ({e['per_s']:.3f}/s)" if "per_s" in e else ""
+        lines.append(f"  {name}: +{e['delta']}{rate}")
+    if not moved:
+        lines.append("  (none moved)")
+    lines.append("gauges (before -> after):")
+    changed = {n: g for n, g in diff["gauges"].items()
+               if g["before"] != g["after"]}
+    for name, g in changed.items():
+        lines.append(f"  {name}: {g['before']} -> {g['after']}")
+    if not changed:
+        lines.append("  (none changed)")
+    return "\n".join(lines)

@@ -28,8 +28,9 @@ this package): jax is imported inside the functions that need it.
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from .goodput import note_compile
 from .memory import _fmt_bytes, _leaf_bytes as _leaf_nbytes
-from .metrics import get_registry
+from .metrics import collective_tally, diff_collective_tally, get_registry
 
 
 class ProgramRecord:
@@ -37,6 +38,7 @@ class ProgramRecord:
 
     __slots__ = ("name", "subsystem", "calls", "compiles", "compile_wall_s",
                  "last_compile_wall_s", "arg_leaves", "arg_bytes",
+                 "collective_bytes", "collective_bytes_per_call",
                  "analysis", "analysis_error")
 
     def __init__(self, name: str, subsystem: Optional[str] = None):
@@ -48,6 +50,13 @@ class ProgramRecord:
         self.last_compile_wall_s: Optional[float] = None
         self.arg_leaves = 0            # shaped leaves in the last-compiled
         self.arg_bytes = 0             # input tree, and their total bytes
+        # collectives traced while this program compiled: {op:axis ->
+        # payload bytes}; every later execution of the program moves the
+        # same bytes, so the sum IS the static bytes-moved-per-call
+        # estimate (ICI vs DCN attributable from the axis names before
+        # hardware is reachable)
+        self.collective_bytes: dict = {}
+        self.collective_bytes_per_call = 0
         self.analysis: Optional[dict] = None
         self.analysis_error: Optional[str] = None
 
@@ -61,6 +70,9 @@ class ProgramRecord:
             "arg_leaves": self.arg_leaves,
             "arg_bytes": self.arg_bytes,
         }
+        if self.collective_bytes:
+            out["collective_bytes"] = dict(self.collective_bytes)
+            out["collective_bytes_per_call"] = self.collective_bytes_per_call
         if self.analysis is not None:
             out["analysis"] = dict(self.analysis)
         if self.analysis_error is not None:
@@ -76,13 +88,16 @@ class TrackedProgram:
     keep working on the tracked handle.
     """
 
-    __slots__ = ("_fn", "_size_fn", "record", "_last_avals")
+    __slots__ = ("_fn", "_size_fn", "record", "_last_avals",
+                 "_comm_counter")
 
     def __init__(self, fn: Callable, record: ProgramRecord):
         self._fn = fn
         self._size_fn = getattr(fn, "_cache_size", None)
         self.record = record
         self._last_avals: Optional[Tuple[tuple, dict]] = None
+        self._comm_counter = None      # set at compile when the program
+                                       # traced any collectives
 
     def __getattr__(self, name):
         return getattr(self._fn, name)
@@ -97,6 +112,7 @@ class TrackedProgram:
             self.record.calls += 1
             return self._fn(*args, **kwargs)
         before = size_fn()
+        comm_before = collective_tally()
         t0 = time.perf_counter()
         out = self._fn(*args, **kwargs)
         rec = self.record
@@ -110,6 +126,20 @@ class TrackedProgram:
             reg = get_registry()
             reg.counter("programs/compiles_total").inc()
             reg.histogram("programs/compile_wall_s").observe(wall)
+            # goodput: the containing timed("compute") site just paid
+            # this wall as compute — re-attribute it to compile
+            note_compile(wall)
+            # collectives traced during THIS dispatch belong to this
+            # program: the static per-call bytes-moved estimate
+            traced = diff_collective_tally(comm_before)
+            if traced:
+                rec.collective_bytes = traced
+                rec.collective_bytes_per_call = sum(traced.values())
+                self._comm_counter = reg.counter("comm/program_bytes_total")
+        if self._comm_counter is not None:
+            # cumulative EXECUTED traffic: per-call estimate x calls —
+            # one host int add per dispatch, no device work
+            self._comm_counter.inc(rec.collective_bytes_per_call)
         return out
 
     def _snapshot_args(self, args, kwargs):

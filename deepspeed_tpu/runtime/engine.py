@@ -33,6 +33,8 @@ from jax.sharding import PartitionSpec as P, NamedSharding
 from .. import comm as dist
 from ..comm.mesh import DENSE_DP_AXES
 from ..models.layers import set_activation_rules
+from ..observability.goodput import get_ledger as _goodput_ledger
+from ..observability.goodput import timed as _goodput
 from ..observability.programs import track_program
 from ..observability.trace import span as _span
 from ..utils.logging import logger, log_dist
@@ -155,6 +157,28 @@ class DeepSpeedEngine:
             from ..observability import Observability
             self.observability = Observability(
                 config.observability, steps_per_print=config.steps_per_print)
+
+        # ---- goodput ledger (observability/goodput.py) ---------------
+        # always-on like the HBM accountant: two host clock reads per
+        # instrumented phase, no device syncs. Starting the process
+        # ledger arms the _goodput() sites in the hot path below.
+        _goodput_ledger().start()
+
+        # ---- live telemetry endpoint (observability/export.py) -------
+        # /metrics (Prometheus) + /healthz + /statusz served from a
+        # daemon thread over metrics_snapshot() — host floats only, so a
+        # scrape never adds a device sync to the step path
+        self.telemetry = None
+        if (config.observability is not None
+                and config.observability.export.enabled):
+            from ..observability.export import TelemetryServer
+            exp = config.observability.export
+            self.telemetry = TelemetryServer(
+                self.metrics_snapshot, host=exp.host,
+                port=exp.port).start()
+            log_dist(f"telemetry endpoint: http://{exp.host}:"
+                     f"{self.telemetry.port}/metrics (+/healthz /statusz)",
+                     ranks=[0])
 
         # ---- HBM accounting (observability/memory.py) ----------------
         # attribute this engine's long-lived buffers to subsystems in
@@ -970,7 +994,7 @@ class DeepSpeedEngine:
         if obs is not None:
             obs.begin_step(self.global_steps + 1)
             self._tokens_per_step = _count_tokens(batch, cfg.train_batch_size)
-        with _span("data"):
+        with _span("data"), _goodput("data_stall"):
             batch = jax.tree.map(to_micro, batch)
             batch = self._place_batch(batch, with_gas_dim=True)
 
@@ -994,7 +1018,7 @@ class DeepSpeedEngine:
         # the fused jit is one program, so host-side it is one span;
         # the fwd / bwd / optimizer split lives in the device profile
         # (named_scope above) and in the split calling convention
-        with _span("fwd_bwd_step"):
+        with _span("fwd_bwd_step"), _goodput("compute"):
             try:
                 if self.native_offload is not None:
                     new_scaler, metrics = self._native_offload_batch(
@@ -1200,12 +1224,12 @@ class DeepSpeedEngine:
             # a parity-API optimizer step consumes gas microbatches
             self._tokens_per_step = _count_tokens(
                 batch, self.config.train_batch_size)
-        with _span("data"):
+        with _span("data"), _goodput("data_stall"):
             batch = self._place_batch(batch, with_gas_dim=False)
         rng = jax.random.fold_in(self.rng, self.micro_steps + 1)
         scale = (self.loss_scale_state or init_loss_scale(1.0)).scale
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        with _span("fwd"):
+        with _span("fwd"), _goodput("compute"):
             try:
                 loss, grads = self._compiled["fwd_grads"](
                     self.params, batch, rng, scale, extra)
@@ -1226,7 +1250,7 @@ class DeepSpeedEngine:
             raise RuntimeError("backward() called without a preceding forward()")
         gas = self.config.gradient_accumulation_steps
         self.timers(BACKWARD_GLOBAL_TIMER).start()
-        with _span("bwd"):
+        with _span("bwd"), _goodput("compute"):
             # accumulate in grad_accum_dtype (fp32 default) like the fused
             # path's buffer — summing many /gas-scaled microbatch grads in
             # bf16 rounds the small contributions away
@@ -1264,7 +1288,7 @@ class DeepSpeedEngine:
         if self.resilience is not None:
             self.resilience.on_step_start()
         scaler = self.loss_scale_state or init_loss_scale(1.0)
-        with _span("step"):
+        with _span("step"), _goodput("compute"):
             if self.native_offload is not None:
                 gnorm, new_scaler, skipped = self._native_offload_step(scaler)
             else:
@@ -1451,7 +1475,7 @@ class DeepSpeedEngine:
         (at the next save, or via ``wait_checkpoint()``)."""
         self._ensure_params_resident()
         from .checkpointing import save_engine_checkpoint
-        with _span("checkpoint_save"):
+        with _span("checkpoint_save"), _goodput("checkpoint_save"):
             return save_engine_checkpoint(self, save_dir, tag=tag,
                                           client_state=client_state,
                                           save_latest=save_latest,
@@ -1469,6 +1493,10 @@ class DeepSpeedEngine:
         obs = getattr(self, "observability", None)
         if obs is not None:
             obs.close()   # release the module-global tracer if held
+        telemetry = getattr(self, "telemetry", None)
+        if telemetry is not None:
+            self.telemetry = None
+            telemetry.stop()   # a destroyed engine must not serve stale state
         from ..observability.memory import get_accountant
         acct = get_accountant()
         for tag in ("train/params", "train/optimizer_state",
@@ -1732,6 +1760,7 @@ class DeepSpeedEngine:
             from ..observability.memory import get_accountant
             from ..observability.programs import get_program_registry
             return {"registry": get_registry().snapshot(),
+                    "goodput": _goodput_ledger().breakdown(),
                     "memory": get_accountant().report(),
                     "programs": get_program_registry().table()}
         return self.observability.snapshot()

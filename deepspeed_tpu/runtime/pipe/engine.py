@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P, NamedSharding
 
 from ... import comm as dist
+from ...observability.goodput import timed as _goodput
 from ...observability.programs import track_program
 from ...observability.trace import span as _span
 from ...utils.jax_compat import shard_map
@@ -304,7 +305,7 @@ class PipelineEngine(DeepSpeedEngine):
         if obs is not None:
             obs.begin_step(self.global_steps + 1)
             self._tokens_per_step = expect * int(ids.shape[1])
-        with _span("data"):
+        with _span("data"), _goodput("data_stall"):
             dev_batch = self._place_batch(batch, with_gas_dim=False)
         if "train_step" not in self._compiled:
             self._compiled["train_step"] = track_program(
@@ -315,7 +316,7 @@ class PipelineEngine(DeepSpeedEngine):
         self.tput_timer.start()
         if self.resilience is not None:
             self.resilience.on_step_start()
-        with _span("fwd_bwd_step"):
+        with _span("fwd_bwd_step"), _goodput("compute"):
             try:
                 self.params, self.optimizer_state, new_scaler, metrics = \
                     self._compiled["train_step"](self.params,

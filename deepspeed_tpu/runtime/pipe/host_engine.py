@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ... import comm as dist
+from ...observability.goodput import timed as _goodput
 from ...observability.trace import span as _span
 from ...utils.logging import log_dist
 from ..config import DeepSpeedConfig
@@ -348,7 +349,8 @@ class HostDrivenPipelineEngine:
                         # them into one program)
                         m = micro_of(s, t)
                         x = act_in[s][b]
-                        with _span("pipe/fwd", {"stage": s, "micro": m}):
+                        with _span("pipe/fwd", {"stage": s, "micro": m}), \
+                                _goodput("compute"):
                             if s == S - 1:
                                 loss = self._last_fwd_prog()(
                                     self.params[s], x, micro_ids[m])
@@ -360,7 +362,8 @@ class HostDrivenPipelineEngine:
                     elif isinstance(cmd, BackwardPass):
                         m = micro_of(s, t)
                         x = act_in[s][b]
-                        with _span("pipe/bwd", {"stage": s, "micro": m}):
+                        with _span("pipe/bwd", {"stage": s, "micro": m}), \
+                                _goodput("compute"):
                             if s == S - 1:
                                 dp, dx = self._last_bwd_prog()(
                                     self.params[s], x, micro_ids[m])
@@ -380,7 +383,7 @@ class HostDrivenPipelineEngine:
                         pass
                     elif isinstance(cmd, OptimizerStep):
                         if s == S - 1:   # run the step exactly once
-                            with _span("pipe/step"):
+                            with _span("pipe/step"), _goodput("compute"):
                                 self._take_step(grad_accum)
                             grad_accum = [None] * S
 

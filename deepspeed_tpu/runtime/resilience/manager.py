@@ -18,7 +18,9 @@ assert on the exact recovery sequence.
 
 from typing import List, Optional, Tuple
 
+from ...observability.goodput import timed as _goodput
 from ...observability.metrics import get_registry
+from ...observability.trace import span as _span
 from ...utils.logging import logger, log_dist
 from .faults import active_injector
 from .sentinel import DivergenceError, DivergenceSentinel
@@ -129,12 +131,17 @@ class ResilienceManager:
         logger.warning(f"resilience: rolling back ({reason}) — restoring "
                        f"from {load_dir} [rollback {self.rollbacks}/"
                        f"{cfg.max_rollbacks}]")
-        path = self._load_healthy(load_dir, reason)
-        if cfg.reseed_on_rollback:
-            import jax
-            # shift the rng stream so the resumed run draws a different
-            # data/dropout order and does not march into the same cliff
-            eng.rng = jax.random.fold_in(eng.rng, 0x5EED + self.rollbacks)
+        # the restore walk is badput: the span + goodput ledger attribute
+        # its wall clock to rollback_recovery, so a chaos-injected
+        # rollback is visible in /metrics and the goodput breakdown
+        with _span("rollback_recovery"), _goodput("rollback_recovery"):
+            path = self._load_healthy(load_dir, reason)
+            if cfg.reseed_on_rollback:
+                import jax
+                # shift the rng stream so the resumed run draws a
+                # different data/dropout order and does not march into
+                # the same cliff
+                eng.rng = jax.random.fold_in(eng.rng, 0x5EED + self.rollbacks)
         if self.sentinel is not None:   # rollback() is callable with the
             self.sentinel.reset()       # sentinel disabled (public API)
         self._emit("resilience/rollback", self.rollbacks, eng.global_steps)

@@ -45,6 +45,8 @@ from ..inference.generation import (init_cache, _prefill_impl, _sample_impl,
                                     _sampling_mode)
 from ..inference.cache import (cache_max_len, make_row_cache, set_cache_index,
                                write_cache_row)
+from ..observability.goodput import get_ledger as _goodput_ledger
+from ..observability.goodput import timed as _goodput
 from ..observability.memory import get_accountant
 from ..observability.programs import track_program
 from ..observability.trace import span as _span
@@ -212,6 +214,11 @@ class ServingEngine:
         self._iteration = 0
         self._seq = 0
         self._account_memory()
+        # arm the process goodput ledger (observability/goodput.py):
+        # dispatch/readback sites below classify as compute, the gaps
+        # between engine iterations surface as scheduler_idle
+        _goodput_ledger().start()
+        self.telemetry = None             # live endpoint; start_telemetry()
         log_dist(f"serving engine: {n} slots x {self.config.cache_len} "
                  f"tokens, prefill buckets {self.config.bucket_lengths()}",
                  ranks=[0])
@@ -262,12 +269,46 @@ class ServingEngine:
         later OOM forensics dump. Explicit like destroy() — a newer
         serving engine re-states the ``serving/*`` tags, so an implicit
         ``__del__`` could wipe its successor's figures. Idempotent."""
+        telemetry = self.telemetry
+        if telemetry is not None:
+            self.telemetry = None
+            telemetry.stop()   # never serve a torn-down engine's state
         acct = get_accountant()
         for tag in ("serving/params", "serving/kv_pool", "serving/state"):
             acct.discard(tag)
         acct.registry.gauge("mem/kv_pool_resident").set(0)
         if self._paged is not None:
             acct.registry.gauge("mem/decode_gather_transient").set(0)
+
+    # -- live telemetry ----------------------------------------------------
+    def metrics_snapshot(self) -> dict:
+        """JSON-able process state as seen from the serving side: the
+        shared registry (whose ``collected.serving`` block is this
+        engine's own metrics), the goodput breakdown, memory attribution
+        and the compiled-program table — the /statusz payload and the
+        serving analog of ``DeepSpeedEngine.metrics_snapshot``."""
+        from ..observability.metrics import get_registry
+        from ..observability.programs import get_program_registry
+        return {"registry": get_registry().snapshot(),
+                "goodput": _goodput_ledger().breakdown(),
+                "serving": self.metrics.snapshot(),
+                "memory": get_accountant().report(),
+                "programs": get_program_registry().table()}
+
+    def start_telemetry(self, port: int = 0, host: str = "127.0.0.1"):
+        """Serve /metrics + /healthz + /statusz for this engine from a
+        daemon thread (observability/export.py; ``bin/ds_tpu_serve
+        --metrics-port``). ``port=0`` binds an ephemeral port — read the
+        bound one from the returned server's ``.port``. Host-only reads;
+        a scrape never syncs the device."""
+        if self.telemetry is not None:
+            return self.telemetry
+        from ..observability.export import TelemetryServer
+        self.telemetry = TelemetryServer(self.metrics_snapshot, host=host,
+                                         port=port).start()
+        log_dist(f"serving telemetry: http://{host}:{self.telemetry.port}"
+                 "/metrics (+/healthz /statusz)", ranks=[0])
+        return self.telemetry
 
     # -- client API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -429,7 +470,8 @@ class ServingEngine:
             # request_id in the span args: a trace capture can rebuild
             # per-request latency (admit -> decode iterations -> harvest)
             with _span("serving/admit", {"request_id": req.request_id,
-                                         "prompt_len": n}):
+                                         "prompt_len": n}), \
+                    _goodput("compute"):
                 self._cache, self._state, tok, done = _admit_jit(
                     self.module, self.params, self._cache, self._state,
                     jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
@@ -513,7 +555,8 @@ class ServingEngine:
         with _span("serving/prefill_chunk",
                    {"slot": slot, "request_id": req.request_id,
                     "start": start, "tokens": real,
-                    "last": bool(is_last)}):
+                    "last": bool(is_last)}), \
+                _goodput("compute"):
             mgr.pool, self._state, tok, done = _chunk_prefill_jit(
                 self.module, self.params, mgr.pool, self._state,
                 mgr.page_table[slot], jnp.asarray(padded),
@@ -539,7 +582,8 @@ class ServingEngine:
         # active request count on the span: trace captures show how full
         # each decode dispatch ran (the SLO-reconstruction groundwork)
         with _span("serving/decode_iter", {"active_requests": busy,
-                                           "iteration": self._iteration}):
+                                           "iteration": self._iteration}), \
+                _goodput("compute"):
             if self._paged is not None:
                 mgr = self._paged
                 mgr.pool, self._state, toks, done = _paged_decode_jit(
@@ -565,7 +609,8 @@ class ServingEngine:
         with _span("serving/harvest",
                    {"kind": entry[0],
                     "active_requests": sum(r is not None
-                                           for r in self._slot_req)}):
+                                           for r in self._slot_req)}), \
+                _goodput("compute"):
             if entry[0] == "admit":
                 _, slot, req, tok, done = entry
                 if req.done:     # cancelled between dispatch and readback
@@ -612,4 +657,10 @@ class ServingEngine:
             from ..monitor.monitor import MonitorMaster
             master = MonitorMaster(ds_config)
             monitor = master if master.enabled else None
-        return cls(module, params, serving, monitor=monitor, **kwargs)
+        engine = cls(module, params, serving, monitor=monitor, **kwargs)
+        # the observability.export block lights the endpoint up for
+        # config-built serving engines, mirroring the training engine
+        obs = getattr(ds_config, "observability", None)
+        if obs is not None and obs.export.enabled:
+            engine.start_telemetry(port=obs.export.port, host=obs.export.host)
+        return engine

@@ -144,6 +144,13 @@ class ServingMetrics:
         self.occupancy_sum += busy_slots / max(1, num_slots)
         self.busy_slots_max = max(self.busy_slots_max, busy_slots)
         self.samples += 1
+        if self.registry is not None:
+            # live scheduler state as registry GAUGES (host ints from the
+            # scheduler, zero device reads): the SLO-admission data plane
+            # and the /metrics serving_queue_depth / serving_active_slots
+            # series — previously reachable only via internal state
+            self.registry.gauge("serving/queue_depth").set(queue_depth)
+            self.registry.gauge("serving/active_slots").set(busy_slots)
         if paged is not None:
             self.paged_stats = paged    # host allocator arithmetic only
         if self.monitor is not None and getattr(self.monitor, "enabled",

@@ -64,16 +64,18 @@ class TestServerSidePercentiles:
 
 
 class TestWatchdogEnvKnobs:
-    def test_window_env_is_read(self, monkeypatch):
+    def test_window_env_is_read(self, monkeypatch, tmp_path):
         # the watchdog must honor the env knobs tpu_watch.sh relies on;
         # with a zero-length window and the probe stubbed to fail it must
-        # emit the honest-null artifact and SystemExit(0) immediately.
+        # emit the honest partial artifact ({"failed": true, "reason":
+        # ...} — the BENCH_r03..r05 fix) and SystemExit(0) immediately.
         import json
         import subprocess
 
         monkeypatch.setenv("DS_TPU_BENCH_PROBE_WINDOW_S", "1")
         monkeypatch.setenv("DS_TPU_BENCH_PROBE_INTERVAL_S", "1")
         monkeypatch.setenv("DS_TPU_BENCH_PROBE_TIMEOUT_S", "1")
+        monkeypatch.chdir(tmp_path)   # the sidecar lands here, not in cwd
 
         def fail_run(*a, **kw):
             raise subprocess.TimeoutExpired(cmd="probe", timeout=1)
@@ -92,4 +94,9 @@ class TestWatchdogEnvKnobs:
                 and a[0].startswith("{")]
         art = json.loads(arts[-1])
         assert art["value"] is None
-        assert "unreachable" in art["error"]
+        assert art["failed"] is True
+        assert "unreachable" in art["reason"]
+        # the sidecar carries the same artifact for SIGKILL survivability
+        sidecar = json.loads(
+            (tmp_path / bench.PARTIAL_ARTIFACT_PATH).read_text())
+        assert sidecar == art

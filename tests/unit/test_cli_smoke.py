@@ -105,6 +105,50 @@ def test_serve_synthetic_demo(tmp_path):
     assert snap["tokens_generated"] >= 3
 
 
+def test_serve_metrics_port_endpoint(tmp_path):
+    """--metrics-port: the serving CLI announces its live telemetry
+    endpoint and still completes the workload (the endpoint itself is
+    scraped in-process by test_telemetry.py — a subprocess race against
+    a 3-request run would flake)."""
+    r = _run([os.path.join(BIN, "ds_tpu_serve"), "--synthetic", "3",
+              "--num-slots", "2", "--max-len", "48", "--prefill-bucket",
+              "16", "--max-new-tokens", "3", "--d-model", "32",
+              "--n-layers", "1", "--vocab-size", "64", "--quiet",
+              "--metrics-port", "0"], timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    telemetry = [l for l in r.stdout.splitlines()
+                 if l.startswith("# telemetry: http://127.0.0.1:")]
+    assert telemetry, r.stdout[-800:]
+    assert telemetry[0].endswith("/metrics")
+
+
+def test_report_diff_two_snapshots(tmp_path):
+    """ds_tpu_report --diff: counters as deltas, gauges before->after,
+    ordered by the meta capture stamps (stdlib path, no jax needed)."""
+    a = {"registry": {
+        "meta": {"capture_seq": 1, "captured_at_monotonic_s": 10.0},
+        "counters": {"serving/requests": 3}, "gauges": {"depth": 1},
+        "histograms": {}}}
+    b = {"registry": {
+        "meta": {"capture_seq": 2, "captured_at_monotonic_s": 12.5},
+        "counters": {"serving/requests": 8}, "gauges": {"depth": 4},
+        "histograms": {}}}
+    pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+    pa.write_text(json.dumps(a))
+    pb.write_text(json.dumps(b))
+    r = _run([os.path.join(BIN, "ds_tpu_report"), "--diff", str(pa),
+              str(pb)])
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "serving/requests: +5" in r.stdout
+    assert "depth: 1 -> 4" in r.stdout
+    assert "over 2.500s" in r.stdout
+    # missing file is a readable exit 2, not a traceback
+    r2 = _run([os.path.join(BIN, "ds_tpu_report"), "--diff", str(pa),
+               str(tmp_path / "missing.json")])
+    assert r2.returncode == 2
+    assert "no such snapshot" in r2.stderr
+
+
 def test_chaos_smoke_torn_scenario(tmp_path):
     """Fast chaos smoke (tier-1): the torn-save scenario must recover —
     the CLI exits 0 only when the fallback restored a verified tag —
