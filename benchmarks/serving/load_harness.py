@@ -12,6 +12,13 @@ steps — is bit-reproducible run-to-run and machine-to-machine; the
 wall-clock numbers (tokens/s, TTFT seconds) ride along for hardware
 comparisons. CPU-runnable end-to-end with tiny shapes (the CI smoke);
 real throughput numbers need a TPU window.
+
+QoS scenario pack (``--scenario diurnal|burst|adversarial-long-prompt``
++ ``--qos``): seeded priority-tagged traces replayed against the QoS
+engine (serving/qos.py). The artifact gains a ``qos`` block with
+per-class p50/p95 TTFT, shed rates, and the exact shed/preempted
+request-id sets — the regression surface for "same trace, same shed
+set" (tests/unit/test_serving_qos.py asserts it bit-exactly).
 """
 
 import argparse
@@ -19,6 +26,8 @@ import json
 from collections import deque
 
 import numpy as np
+
+QOS_SCENARIOS = ("diurnal", "burst", "adversarial-long-prompt")
 
 
 def make_trace(seed: int, num_requests: int, *, mean_interarrival: float = 2.0,
@@ -82,6 +91,66 @@ def make_trace(seed: int, num_requests: int, *, mean_interarrival: float = 2.0,
     return trace
 
 
+def make_qos_trace(scenario: str, seed: int, num_requests: int, *,
+                   vocab_size: int = 256, prompt_len_range=(4, 64),
+                   output_len_range=(4, 32), mean_interarrival: float = 2.0,
+                   long_prompt_len: int = 0,
+                   priority_mix=((2, 0.3), (1, 0.4), (0, 0.3))):
+    """Seeded QoS scenario traces on the decode-step clock (all
+    bit-reproducible per seed):
+
+    - ``diurnal`` — the arrival rate walks a repeating 4-phase "day"
+      (off-peak 4x mean inter-arrival -> shoulder -> peak 0.5x ->
+      shoulder), so the ladder must escalate into the peak and recover
+      out of it;
+    - ``burst`` — a quiet baseline punctured by same-step bursts of 8
+      requests (the admit-together stampede);
+    - ``adversarial-long-prompt`` — steady arrivals where the lowest
+      class carries ``long_prompt_len``-token prompts (near-max by
+      default) trying to monopolize prefill while high-priority short
+      requests need their TTFT SLO.
+
+    ``priority_mix`` is ((priority, fraction), ...); fractions are
+    cumulative-sampled from the seeded RNG so the class mix reproduces
+    exactly."""
+    if scenario not in QOS_SCENARIOS:
+        raise ValueError(f"unknown qos scenario {scenario!r}; pick one of "
+                         f"{QOS_SCENARIOS}")
+    r = np.random.RandomState(seed)
+    lowest = min(p for p, _ in priority_mix)
+    phase_len = max(1, num_requests // 8)
+    trace, step = [], 0
+    for i in range(num_requests):
+        if scenario == "diurnal":
+            scale = (4.0, 1.5, 0.5, 1.5)[(i // phase_len) % 4]
+            mean = max(mean_interarrival * scale, 1e-6)
+            step += int(r.geometric(min(1.0, 1.0 / mean)))
+        elif scenario == "burst":
+            if i % 8 == 0:       # quiet gap, then 8 land on ONE step
+                step += int(round(8 * mean_interarrival))
+        else:                    # adversarial-long-prompt: steady pressure
+            step += int(r.geometric(min(1.0, 1.0
+                                        / max(mean_interarrival, 1e-6))))
+        u = r.random_sample()
+        acc, prio = 0.0, priority_mix[-1][0]
+        for p, frac in priority_mix:
+            acc += frac
+            if u < acc:
+                prio = p
+                break
+        out = int(r.randint(output_len_range[0], output_len_range[1] + 1))
+        if scenario == "adversarial-long-prompt" and prio == lowest \
+                and long_prompt_len:
+            n = long_prompt_len
+        else:
+            n = int(r.randint(prompt_len_range[0], prompt_len_range[1] + 1))
+        prompt = r.randint(1, vocab_size, size=n).astype(np.int32)
+        trace.append({"id": i, "arrival_step": step, "priority": prio,
+                      "kind": f"prio{prio}", "prompt": prompt.tolist(),
+                      "max_new_tokens": out})
+    return trace
+
+
 def replay(engine, trace):
     """Feed ``trace`` through ``engine`` honoring arrival steps on the
     engine-iteration clock; returns the request handles in trace order.
@@ -100,7 +169,8 @@ def replay(engine, trace):
         while pending and pending[0]["arrival_step"] <= clock:
             t = pending.popleft()
             handles[t["id"]] = engine.submit(
-                t["prompt"], t["max_new_tokens"], request_id=t["id"])
+                t["prompt"], t["max_new_tokens"], request_id=t["id"],
+                priority=t.get("priority", 0))
         engine.advance()
     engine.metrics.flush()
     return [handles[t["id"]] for t in trace]
@@ -157,6 +227,19 @@ def _scenario_knobs(args):
     return knobs
 
 
+def _qos_config(args):
+    """The bench harness's ``serving.qos`` block: the shared three-band
+    builder (serving/qos.py standard_qos_config — one definition for the
+    CLI, the bench, and the library, so they cannot drift) driven by the
+    interactive SLO + preemption + ladder knobs from the CLI."""
+    from deepspeed_tpu.serving.qos import standard_qos_config
+    return standard_qos_config(
+        args.num_slots, ttft_slo_steps=args.interactive_slo_steps,
+        preempt_after_steps=args.preempt_after_steps,
+        shed_queue_depth=args.shed_queue_depth,
+        ladder_patience_steps=args.ladder_patience_steps)
+
+
 def run_benchmark(args):
     from deepspeed_tpu.serving import ServingConfig
     from deepspeed_tpu.serving.engine import ServingEngine
@@ -179,17 +262,31 @@ def run_benchmark(args):
             prefill_chunk=args.prefill_chunk,
             max_chunks_per_iter=args.max_chunks_per_iter,
             enable_prefix_cache=not args.no_prefix_cache)
+    qos_scenario = args.scenario in QOS_SCENARIOS
     cfg = ServingConfig(num_slots=args.num_slots, max_len=args.max_len,
                         prefill_bucket=args.prefill_bucket, seed=args.seed,
-                        paging=paging)
+                        paging=paging,
+                        qos=(_qos_config(args)
+                             if (args.qos or qos_scenario) else None))
     engine = ServingEngine(model, params, cfg)
-    knobs = _scenario_knobs(args)
-    trace = make_trace(
-        args.seed, args.num_requests,
-        mean_interarrival=args.mean_interarrival,
-        prompt_len_range=(args.min_prompt, args.max_prompt),
-        output_len_range=(args.min_output, args.max_output),
-        vocab_size=args.vocab_size, **knobs)
+    if qos_scenario:
+        knobs = {}
+        long_len = args.long_prompt_len or (args.max_len - args.max_output)
+        trace = make_qos_trace(
+            args.scenario, args.seed, args.num_requests,
+            vocab_size=args.vocab_size,
+            prompt_len_range=(args.min_prompt, args.max_prompt),
+            output_len_range=(args.min_output, args.max_output),
+            mean_interarrival=args.mean_interarrival,
+            long_prompt_len=long_len)
+    else:
+        knobs = _scenario_knobs(args)
+        trace = make_trace(
+            args.seed, args.num_requests,
+            mean_interarrival=args.mean_interarrival,
+            prompt_len_range=(args.min_prompt, args.max_prompt),
+            output_len_range=(args.min_output, args.max_output),
+            vocab_size=args.vocab_size, **knobs)
     handles = replay(engine, trace)
 
     # decode-side performance accounting (docs/observability.md): the
@@ -257,11 +354,39 @@ def run_benchmark(args):
             "ttft_steps_under_load_p95": agg.get("ttft_steps_under_load_p95"),
         }
 
+    # QoS accounting: per-class latency/shed breakdown plus the EXACT
+    # shed/preempted id sets — the bit-reproducibility regression surface
+    # (same seed, same trace -> same sets, asserted in tests)
+    qos_block = None
+    if cfg.qos_enabled:
+        class_names = sorted({k.split("/")[1] for k in agg
+                              if k.startswith("class/")})
+        qos_block = {
+            "level": agg.get("qos_level", 0),
+            "requests_shed": agg.get("requests_shed", 0),
+            "requests_preempted": agg.get("requests_preempted", 0),
+            "requests_resumed": agg.get("requests_resumed", 0),
+            "per_class": {
+                name: {key: agg.get(f"class/{name}/{key}")
+                       for key in ("submitted", "finished", "shed",
+                                   "preempted", "resumed", "shed_rate",
+                                   "ttft_steps_p50", "ttft_steps_p95")}
+                for name in class_names},
+            "shed_request_ids": sorted(
+                (h.request_id for h in handles if h.status == "shed"),
+                key=str),
+            "preempted_request_ids": sorted(
+                (h.request_id for h in handles if h.preemptions > 0),
+                key=str),
+        }
+
     per_request = []
     for t, h in zip(trace, handles):
         per_request.append({
             "id": t["id"], "arrival_step": t["arrival_step"],
             "kind": t.get("kind", "uniform"),
+            "priority": t.get("priority", 0),
+            "status": h.status,
             "prompt_len": len(t["prompt"]),
             "max_new_tokens": t["max_new_tokens"],
             "generated": len(h.output_tokens),
@@ -303,6 +428,8 @@ def run_benchmark(args):
     }
     if paging_block is not None:
         result["paging"] = paging_block
+    if qos_block is not None:
+        result["qos"] = qos_block
     return result
 
 
@@ -327,12 +454,30 @@ def build_parser():
     p.add_argument("--n-layers", type=int, default=2)
     p.add_argument("--n-heads", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--scenario", choices=["uniform", "prefix-adversarial"],
+    p.add_argument("--scenario",
+                   choices=["uniform", "prefix-adversarial",
+                            *QOS_SCENARIOS],
                    default="uniform",
                    help="prefix-adversarial: most requests share a seeded "
                         "system prompt and a minority carry near-max-len "
                         "prompts (fills in the four knobs below when left "
-                        "at 0)")
+                        "at 0). diurnal / burst / adversarial-long-prompt: "
+                        "the QoS scenario pack — priority-tagged seeded "
+                        "traces replayed against the QoS engine (implies "
+                        "--qos; artifact gains the per-class qos block)")
+    p.add_argument("--qos", action="store_true",
+                   help="enable the serving.qos block (automatic for the "
+                        "QoS scenario pack)")
+    p.add_argument("--shed-queue-depth", type=int, default=None,
+                   help="ladder overload threshold on queue depth "
+                        "(default 4x num_slots)")
+    p.add_argument("--interactive-slo-steps", type=int, default=32,
+                   help="interactive-class p95 TTFT SLO target (steps)")
+    p.add_argument("--preempt-after-steps", type=int, default=4,
+                   help="queued steps before an interactive head preempts")
+    p.add_argument("--ladder-patience-steps", type=int, default=4,
+                   help="consecutive overloaded iterations per ladder "
+                        "escalation")
     p.add_argument("--shared-prefix-len", type=int, default=0)
     p.add_argument("--shared-prefix-frac", type=float, default=0.0)
     p.add_argument("--long-prompt-len", type=int, default=0)
@@ -355,12 +500,18 @@ def build_parser():
                    help="chip peak TFLOP/s for the artifact's MFU field "
                         "(defaults to the detected chip's table entry; "
                         "null when unknown)")
-    p.add_argument("--out", default="BENCH_serving.json")
+    p.add_argument("--out", default=None,
+                   help="artifact path (default BENCH_serving.json, or "
+                        "BENCH_serving_qos.json for the QoS scenario pack)")
     return p
 
 
 def main(argv=None):
     args = build_parser().parse_args(argv)
+    if args.out is None:
+        args.out = ("BENCH_serving_qos.json"
+                    if args.scenario in QOS_SCENARIOS
+                    else "BENCH_serving.json")
     result = run_benchmark(args)
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)
@@ -372,6 +523,15 @@ def main(argv=None):
           f"ttft p50 {agg.get('ttft_steps_p50', '-')} steps; "
           f"occupancy {agg['slot_occupancy_mean']:.2f}; "
           f"artifact -> {args.out}")
+    qb = result.get("qos")
+    if qb is not None:
+        per_cls = " ".join(
+            f"{name}: p95 {c.get('ttft_steps_p95', '-')} steps, "
+            f"shed {(c.get('shed_rate') or 0.0):.0%}"
+            for name, c in sorted(qb["per_class"].items()))
+        print(f"  qos: level {qb['level']}, shed {qb['requests_shed']}, "
+              f"preempted {qb['requests_preempted']} "
+              f"(resumed {qb['requests_resumed']}) | {per_cls}")
     pg = result.get("paging")
     if pg is not None:
         gain = pg["density_gain_vs_full_rows"]

@@ -2,8 +2,13 @@ from .elasticity import (ElasticityError, ElasticityConfigError,
                          ElasticityIncompatibleWorldSize, ElasticityConfig,
                          compute_elastic_config, elasticity_enabled,
                          ensure_immutable_elastic_config)
+from .serving_autoscaler import (ServingAutoscaleConfig, ServingAutoscaler,
+                                 ACTION_HOLD, ACTION_SCALE_DOWN,
+                                 ACTION_SCALE_UP)
 
 __all__ = ["ElasticityError", "ElasticityConfigError",
            "ElasticityIncompatibleWorldSize", "ElasticityConfig",
            "compute_elastic_config", "elasticity_enabled",
-           "ensure_immutable_elastic_config"]
+           "ensure_immutable_elastic_config",
+           "ServingAutoscaleConfig", "ServingAutoscaler",
+           "ACTION_HOLD", "ACTION_SCALE_DOWN", "ACTION_SCALE_UP"]

@@ -1,0 +1,193 @@
+"""Serving autoscaling hooks — the elasticity stub revived for runtime.
+
+The original ``elasticity/elasticity.py`` is *static* batch-size
+algebra: pick a batch divisible by every admissible chip count, restart
+from checkpoint to rescale. This module is its serving-side complement:
+a rule-based recommender that reads the LIVE metrics registry gauges
+the PR-5/8 observability plane already publishes
+(``serving/queue_depth``, ``serving/active_slots``,
+``serving/slot_cap``) and recommends slot-pool / replica scaling.
+
+Two scale axes:
+
+- **in-process slots** — ``apply()`` drives
+  ``ServingEngine.set_slot_cap``: scale-up raises the admissible-slot
+  cap (up to the compiled ``num_slots`` — shapes never change), and
+  scale-down DRAINS capped slots through the QoS preemption path
+  (requests requeued with tokens retained, resumed in an admissible
+  slot) instead of dropping them.
+- **replicas** — when the process is already at ``num_slots`` and still
+  saturated, the recommendation carries a ``target_replicas`` hint for
+  the fleet layer (this module never spawns processes).
+
+Deterministic on purpose: every input is a host int sampled on the
+engine-iteration clock, streak counters provide hysteresis, and the
+same gauge sequence always yields the same decisions — the same
+bit-reproducibility contract as the QoS degradation ladder.
+
+Stdlib-only (plus the stdlib-only metrics registry): importable in
+dependency-free tooling jobs, and lint-clean under the zero-finding CI
+gate.
+"""
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..observability.metrics import get_registry
+
+ACTION_HOLD = "hold"
+ACTION_SCALE_UP = "scale_up"
+ACTION_SCALE_DOWN = "scale_down"
+
+
+@dataclass
+class ServingAutoscaleConfig:
+    """Knobs for the rule-based serving autoscaler."""
+    enabled: bool = True
+    min_slots: int = 1               # scale-down floor
+    max_replicas: int = 8            # replica-hint ceiling
+    queue_per_slot_high: float = 1.0  # queue_depth >= cap * this AND all
+                                      # admissible slots busy = pressure
+    occupancy_low: float = 0.375     # active/cap below this with an empty
+                                     # queue = idle capacity
+    patience: int = 3                # consecutive pressured/idle
+                                     # observations before acting
+
+    def validate(self) -> "ServingAutoscaleConfig":
+        if self.min_slots < 1:
+            raise ValueError(
+                f"autoscale.min_slots must be >= 1, got {self.min_slots}")
+        if self.max_replicas < 1:
+            raise ValueError(
+                f"autoscale.max_replicas must be >= 1, got "
+                f"{self.max_replicas}")
+        if self.queue_per_slot_high <= 0:
+            raise ValueError(
+                "autoscale.queue_per_slot_high must be > 0, got "
+                f"{self.queue_per_slot_high}")
+        if not 0.0 <= self.occupancy_low <= 1.0:
+            raise ValueError(
+                "autoscale.occupancy_low must be in [0, 1], got "
+                f"{self.occupancy_low}")
+        if self.patience < 1:
+            raise ValueError(
+                f"autoscale.patience must be >= 1, got {self.patience}")
+        return self
+
+
+class ServingAutoscaler:
+    """Registry-driven slot/replica recommender.
+
+    Usage (the serve loop owns the cadence — typically every
+    ``metrics_interval`` iterations)::
+
+        scaler = ServingAutoscaler(engine)
+        decision = scaler.observe()
+        if decision["action"] != "hold":
+            scaler.apply(decision)        # in-process slot cap only
+
+    ``engine=None`` runs it as a pure recommender over the registry
+    (e.g. a sidecar watching /metrics).
+    """
+
+    HISTORY = 64
+
+    def __init__(self, engine=None,
+                 config: Optional[ServingAutoscaleConfig] = None,
+                 registry=None):
+        self.engine = engine
+        self.config = (config or ServingAutoscaleConfig()).validate()
+        self.registry = registry if registry is not None else get_registry()
+        self._pressure_streak = 0
+        self._idle_streak = 0
+        self.decisions: List[dict] = []
+
+    # -- signal plumbing ---------------------------------------------------
+    def _gauge(self, name: str, default=0):
+        v = self.registry.gauge(name).value
+        return default if v is None else v
+
+    def _current(self):
+        queue_depth = int(self._gauge("serving/queue_depth"))
+        active = int(self._gauge("serving/active_slots"))
+        if self.engine is not None:
+            cap = self.engine.slot_cap
+            num_slots = self.engine.config.num_slots
+        else:
+            cap = int(self._gauge("serving/slot_cap", default=max(active, 1)))
+            num_slots = cap
+        return queue_depth, active, cap, num_slots
+
+    # -- the recommender ---------------------------------------------------
+    def observe(self) -> dict:
+        """One evaluation: read the live gauges, update the hysteresis
+        streaks, and return the current recommendation. Publishes the
+        targets back to the registry (``elasticity/*`` gauges) so
+        /metrics and /statusz show what the scaler wants next."""
+        cfg = self.config
+        queue_depth, active, cap, num_slots = self._current()
+        pressured = (active >= cap
+                     and queue_depth >= max(1, round(
+                         cap * cfg.queue_per_slot_high)))
+        idle = queue_depth == 0 and active <= cap * cfg.occupancy_low
+        if pressured:
+            self._pressure_streak += 1
+            self._idle_streak = 0
+        elif idle:
+            self._idle_streak += 1
+            self._pressure_streak = 0
+        else:
+            self._pressure_streak = 0
+            self._idle_streak = 0
+
+        action, target_slots, target_replicas, reason = (
+            ACTION_HOLD, cap, 1, "within thresholds")
+        if self._pressure_streak >= cfg.patience:
+            if cap < num_slots:
+                target_slots = min(num_slots, max(cap + 1, cap * 2))
+                action = ACTION_SCALE_UP
+                reason = (f"queue {queue_depth} with {active}/{cap} slots "
+                          "busy: raise the slot cap")
+            else:
+                # the process is maxed out: recommend fleet-level scale-out
+                # sized by the backlog (ceil of waiting+running per full
+                # replica), capped
+                want = -(-(queue_depth + active) // max(1, num_slots))
+                target_replicas = max(2, min(cfg.max_replicas, want))
+                action = ACTION_SCALE_UP
+                reason = (f"saturated at num_slots={num_slots} with queue "
+                          f"{queue_depth}: recommend {target_replicas} "
+                          "replicas")
+            self._pressure_streak = 0
+        elif self._idle_streak >= cfg.patience and cap > cfg.min_slots:
+            target_slots = max(cfg.min_slots, cap // 2)
+            action = ACTION_SCALE_DOWN
+            reason = (f"idle ({active}/{cap} busy, empty queue): halve the "
+                      "slot cap (drained via preemption)")
+            self._idle_streak = 0
+
+        decision = {"action": action, "slot_cap": cap,
+                    "target_slots": target_slots,
+                    "target_replicas": target_replicas,
+                    "queue_depth": queue_depth, "active_slots": active,
+                    "reason": reason}
+        self.decisions.append(decision)
+        del self.decisions[:-self.HISTORY]
+        self.registry.gauge("elasticity/slot_cap_target").set(target_slots)
+        self.registry.gauge("elasticity/replicas_target").set(
+            target_replicas)
+        self.registry.gauge("elasticity/scale_direction").set(
+            {ACTION_SCALE_DOWN: -1, ACTION_HOLD: 0, ACTION_SCALE_UP: 1}
+            [action])
+        return decision
+
+    def apply(self, decision: dict) -> dict:
+        """Apply the in-process part of a recommendation: move the
+        engine's slot cap (scale-down drains via the preemption path —
+        ``ServingEngine.set_slot_cap`` requeues active requests with
+        their tokens retained, never drops them). Replica targets are
+        hints for the fleet layer and are returned untouched."""
+        if self.engine is not None and decision["action"] != ACTION_HOLD:
+            applied = self.engine.set_slot_cap(decision["target_slots"])
+            decision = {**decision, "applied_slot_cap": applied}
+        return decision

@@ -134,6 +134,7 @@ def build_statusz(snapshot: dict) -> dict:
         "memory": snapshot.get("memory") or {},
         "serving": collected.get("serving")
         or snapshot.get("serving") or {},
+        "qos": snapshot.get("qos") or {},
         "perf": snapshot.get("perf") or {},
         "counters": reg.get("counters") or {},
         "gauges": reg.get("gauges") or {},

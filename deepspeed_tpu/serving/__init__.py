@@ -7,8 +7,10 @@ config schema, and that path must work in dependency-free tooling jobs.
 
 from .config import ServingConfig
 from .paging.config import PagingConfig
+from .qos import QosClass, QosConfig, QosController
 
-__all__ = ["ServingConfig", "PagingConfig", "ServingEngine", "Request",
+__all__ = ["ServingConfig", "PagingConfig", "QosClass", "QosConfig",
+           "QosController", "ServingEngine", "Request",
            "FifoScheduler", "ServingMetrics", "PagedKVManager"]
 
 _LAZY = {

@@ -9,6 +9,7 @@ from dataclasses import dataclass
 from typing import Optional, Tuple
 
 from .paging.config import PagingConfig
+from .qos import QosConfig
 
 
 @dataclass
@@ -46,12 +47,19 @@ class ServingConfig:
                                      # contiguous slot pool — the default
                                      # path, bit-identical to a build without
                                      # the paging subsystem
+    qos: Optional[QosConfig] = None  # priority classes / SLO shedding /
+                                     # degradation ladder / watchdog
+                                     # (serving/qos.py, docs/serving.md):
+                                     # absent or enabled=False keeps the
+                                     # pre-QoS FIFO engine untouched
 
     def __post_init__(self):
         # nested-block plumbing: runtime/config.py's dict_to_dataclass is
         # shallow, so {"serving": {"paging": {...}}} arrives here as a dict
         if isinstance(self.paging, dict):
             self.paging = PagingConfig(**self.paging)
+        if isinstance(self.qos, dict):
+            self.qos = QosConfig(**self.qos)
 
     def validate(self):
         if self.num_slots < 1:
@@ -81,12 +89,19 @@ class ServingConfig:
                 f"metrics_interval must be >= 1, got {self.metrics_interval}")
         if self.paging is not None:
             self.paging.validate(self.cache_len)
+        if self.qos is not None:
+            self.qos.validate()
         return self
 
     @property
     def paged(self) -> bool:
         """True when the block-paged KV cache is configured AND enabled."""
         return self.paging is not None and self.paging.enabled
+
+    @property
+    def qos_enabled(self) -> bool:
+        """True when the QoS layer is configured AND enabled."""
+        return self.qos is not None and self.qos.enabled
 
     @property
     def cache_len(self) -> int:

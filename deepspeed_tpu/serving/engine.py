@@ -32,8 +32,27 @@ API, the compile-once discipline (ONE paged decode program, one chunk
 prefill per chunk bucket), and token-exactness vs ``generate()`` are
 all preserved; with paging absent or disabled this module's original
 code paths run untouched — bit-identical to the pre-paging engine.
+
+QoS mode (``serving.qos`` block, serving/qos.py): requests carry a
+``priority``; a high-priority queue head past its class's
+``preempt_after_steps`` preempts the lowest-priority active request
+BACK TO THE QUEUE (device row masked via the cancel machinery,
+generated tokens retained — resumption re-prefills prompt + partial
+output, which the paged prefix cache serves page-granularly).
+Admission consults live step-clock signals against per-class SLO
+targets and sheds early with explicit ``shed`` status; a deterministic
+degradation ladder (shed lowest class -> shrink chunk budget -> refuse
+admits) runs on the decode-step clock so decisions replay bit-exactly.
+Fault containment: a hung-decode watchdog (armed around dispatch +
+readback, the resilience/preemption.py pattern), a RESOURCE_EXHAUSTED
+guard on admit/chunk-prefill that sheds the offender with an
+``oom_forensics`` dump, and ``recover()`` — requeue-and-re-prefill of
+every queued + active request over a rebuilt device state. With the
+block absent the pre-QoS FIFO engine runs untouched.
 """
 
+import os
+import threading
 from collections import deque
 from typing import Optional
 
@@ -47,12 +66,14 @@ from ..inference.cache import (cache_max_len, make_row_cache, set_cache_index,
                                write_cache_row)
 from ..observability.goodput import get_ledger as _goodput_ledger
 from ..observability.goodput import timed as _goodput
-from ..observability.memory import get_accountant
+from ..observability.memory import get_accountant, is_oom_error, oom_forensics
 from ..observability.programs import track_program
 from ..observability.trace import span as _span
 from ..utils.logging import log_dist
 from .config import ServingConfig
-from .request import Request
+from . import qos as qos_mod
+from .qos import QosController
+from .request import PREEMPTED, Request
 from .scheduler import FifoScheduler
 from .metrics import ServingMetrics
 from .paging.manager import _chunk_prefill_jit, _paged_decode_jit
@@ -172,29 +193,8 @@ class ServingEngine:
                 f"model's max_seq_len {model_max}")
 
         n = self.config.num_slots
-        if self.config.paged:
-            # block-paged KV: the manager owns the page pool, allocator,
-            # prefix cache, and page tables; no contiguous slot rows exist
-            from .paging.manager import PagedKVManager
-            self._paged = PagedKVManager(module, params, self.config)
-            self._cache = None
-            self._prefill_tasks = deque()   # (slot, req, [chunk plans])
-        else:
-            self._paged = None
-            self._cache = init_cache(module, params, n,
-                                     self.config.cache_len)
-            # normalize cache_index to per-row form ([b]-shaped) up front:
-            # init_cache creates the scalar form, and a tree whose index
-            # shape flips after the first decode would cost every jit a
-            # second specialization (the "decode compiles once" contract)
-            self._cache = set_cache_index(self._cache,
-                                          jnp.zeros((n,), jnp.int32))
-        self._state = {
-            "lengths": jnp.zeros((n,), jnp.int32),
-            "last_token": jnp.zeros((n,), jnp.int32),
-            "active": jnp.zeros((n,), bool),
-            "remaining": jnp.zeros((n,), jnp.int32),
-        }
+        self._paged = None
+        self._init_device_state()
         self._rng = rng if rng is not None else jax.random.PRNGKey(
             self.config.seed)
         self._mode = _sampling_mode(self.config.temperature,
@@ -213,6 +213,24 @@ class ServingEngine:
         self._pending = deque()           # in-flight readbacks, FIFO
         self._iteration = 0
         self._seq = 0
+        # QoS plane (serving/qos.py): priority preemption, SLO shedding,
+        # the degradation ladder, and the hung-decode watchdog. None when
+        # the block is absent — the FIFO engine runs untouched.
+        self._qos = (QosController(self.config.qos)
+                     if self.config.qos_enabled else None)
+        self._slot_cap = n                # admissible slots (autoscaling
+                                          # drains above the cap via the
+                                          # preemption path; compiled
+                                          # shapes never change)
+        self._preempts_this_iter = 0
+        self._watchdog = None
+        self._watchdog_report = None      # set by the watchdog thread;
+                                          # advance() runs recovery on it
+        self.on_watchdog_fatal = None     # escalation hook for a TRULY
+                                          # hung dispatch (flag never
+                                          # consumed); None = os._exit(70)
+        self.last_oom_forensics = None    # latest RESOURCE_EXHAUSTED dump
+        self._restart_watchdog()
         self._account_memory()
         # arm the process goodput ledger (observability/goodput.py):
         # dispatch/readback sites below classify as compute, the gaps
@@ -222,6 +240,93 @@ class ServingEngine:
         log_dist(f"serving engine: {n} slots x {self.config.cache_len} "
                  f"tokens, prefill buckets {self.config.bucket_lengths()}",
                  ranks=[0])
+
+    def _init_device_state(self):
+        """(Re)build the device-side cache/pool and slot-state arrays.
+        Called at construction and from ``recover()`` — shapes are
+        identical both times, so every compiled program stays cached."""
+        n = self.config.num_slots
+        if self.config.paged:
+            if self._paged is None:
+                # block-paged KV: the manager owns the page pool,
+                # allocator, prefix cache, and page tables; no contiguous
+                # slot rows exist
+                from .paging.manager import PagedKVManager
+                self._paged = PagedKVManager(self.module, self.params,
+                                             self.config)
+            else:
+                self._paged.reset()
+            self._cache = None
+            self._prefill_tasks = deque()   # (slot, req, prompt, max_new,
+                                            #  [chunk plans])
+        else:
+            self._paged = None
+            self._cache = init_cache(self.module, self.params, n,
+                                     self.config.cache_len)
+            # normalize cache_index to per-row form ([b]-shaped) up front:
+            # init_cache creates the scalar form, and a tree whose index
+            # shape flips after the first decode would cost every jit a
+            # second specialization (the "decode compiles once" contract)
+            self._cache = set_cache_index(self._cache,
+                                          jnp.zeros((n,), jnp.int32))
+        self._state = {
+            "lengths": jnp.zeros((n,), jnp.int32),
+            "last_token": jnp.zeros((n,), jnp.int32),
+            "active": jnp.zeros((n,), bool),
+            "remaining": jnp.zeros((n,), jnp.int32),
+        }
+
+    def _restart_watchdog(self):
+        """Arm (or re-arm after a fire) the hung-decode watchdog — the
+        resilience/preemption.py daemon-thread pattern with a recovery
+        abort_fn instead of a process abort: a fire flags the engine,
+        which runs ``recover()`` at the next advance() instead of dying."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+        qcfg = self.config.qos
+        if (self._qos is not None and qcfg.watchdog_timeout_s is not None):
+            from ..runtime.resilience.preemption import Watchdog
+            self._watchdog = Watchdog(
+                self, qcfg.watchdog_timeout_s,
+                abort_fn=self._on_watchdog_fire).start()
+
+    def _on_watchdog_fire(self, report: str):
+        """Watchdog-thread callback: record only — no device calls from a
+        foreign thread. The engine loop picks the flag up at its next
+        advance() and runs requeue-and-re-prefill recovery there.
+
+        That soft path only helps a SLOW dispatch (one that eventually
+        returns). A truly wedged one never reaches the next advance(), so
+        a second timeout window arms here: if the flag is still
+        unconsumed after another ``watchdog_timeout_s``, the dispatch is
+        hung for real and the escalation path runs —
+        ``on_watchdog_fatal(report)`` when the operator set one (the
+        serve CLI emits its partial snapshot there), else ``os._exit``
+        with the resilience watchdog's exit code so the fleet layer
+        restarts the process instead of waiting forever."""
+        self._watchdog_report = report
+        self.metrics.on_fault(
+            "watchdog",
+            f"decode dispatch stalled past "
+            f"{self.config.qos.watchdog_timeout_s}s", self._iteration)
+        timer = threading.Timer(self.config.qos.watchdog_timeout_s,
+                                self._watchdog_escalate, args=(report,))
+        timer.daemon = True
+        timer.start()
+
+    def _watchdog_escalate(self, report: str):
+        if self._watchdog_report is None:
+            return      # flag consumed: the dispatch completed and soft
+                        # recovery ran (or is about to) — nothing is hung
+        log_dist("serving: decode dispatch still hung one full watchdog "
+                 "window after the fire — escalating", ranks=[0])
+        if self.on_watchdog_fatal is not None:
+            self.on_watchdog_fatal(report)
+        else:
+            # the main thread is, by definition, stuck: mirror the
+            # resilience Watchdog's clean abort with its restartable code
+            os._exit(70)
 
     def _account_memory(self):
         """Tag the engine's resident device buffers in the process HBM
@@ -273,6 +378,9 @@ class ServingEngine:
         if telemetry is not None:
             self.telemetry = None
             telemetry.stop()   # never serve a torn-down engine's state
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         acct = get_accountant()
         for tag in ("serving/params", "serving/kv_pool", "serving/state"):
             acct.discard(tag)
@@ -289,11 +397,14 @@ class ServingEngine:
         serving analog of ``DeepSpeedEngine.metrics_snapshot``."""
         from ..observability.metrics import get_registry
         from ..observability.programs import get_program_registry
-        return {"registry": get_registry().snapshot(),
-                "goodput": _goodput_ledger().breakdown(),
-                "serving": self.metrics.snapshot(),
-                "memory": get_accountant().report(),
-                "programs": get_program_registry().table()}
+        out = {"registry": get_registry().snapshot(),
+               "goodput": _goodput_ledger().breakdown(),
+               "serving": self.metrics.snapshot(),
+               "memory": get_accountant().report(),
+               "programs": get_program_registry().table()}
+        if self._qos is not None:
+            out["qos"] = self._qos.snapshot()
+        return out
 
     def start_telemetry(self, port: int = 0, host: str = "127.0.0.1"):
         """Serve /metrics + /healthz + /statusz for this engine from a
@@ -313,18 +424,31 @@ class ServingEngine:
     # -- client API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                request_id=None, on_token=None,
-               deadline_steps: Optional[int] = None) -> Request:
+               deadline_steps: Optional[int] = None,
+               priority: int = 0) -> Request:
         """Queue one request; returns its live ``Request`` handle.
 
         ``deadline_steps`` is a queue TTL on the engine-iteration clock:
         a request still queued after that many iterations completes with
-        ``timeout`` status instead of waiting forever (default from
-        ``serving.default_deadline_steps``; None = no deadline). Once
-        admitted a request always runs to completion — shedding happens
-        at the queue, never mid-generation."""
+        ``timeout`` status instead of waiting forever (resolution order:
+        this argument, then the QoS class default, then
+        ``serving.default_deadline_steps``; None = no deadline).
+
+        ``priority`` (higher = more important) orders admission when the
+        ``serving.qos`` block is on; SLO-aware admission may return the
+        handle already in ``shed`` status instead of queueing it — an
+        explicit early refusal the client can retry elsewhere, instead
+        of a silent queue-TTL expiry. Admitted requests run to
+        completion unless priority preemption pushes them back to the
+        queue (tokens retained; they resume token-exactly under greedy
+        sampling)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if max_new_tokens is None:
             max_new_tokens = self.config.default_max_new_tokens
+        qos_cls = (self._qos.config.class_for(priority)
+                   if self._qos is not None else None)
+        if deadline_steps is None and qos_cls is not None:
+            deadline_steps = qos_cls.deadline_steps
         if deadline_steps is None:
             deadline_steps = self.config.default_deadline_steps
         try:
@@ -335,19 +459,32 @@ class ServingEngine:
         if request_id is None:
             request_id = self._seq
         req = Request(prompt, max_new_tokens, request_id, on_token=on_token,
-                      deadline_steps=deadline_steps)
+                      deadline_steps=deadline_steps, priority=priority)
+        if qos_cls is not None:
+            req.qos_class = qos_cls.name
         req.submitted_iteration = self._iteration
         # the p95-TTFT-under-load population: requests that arrived while
         # others were already waiting or every slot was occupied
         req.submitted_under_load = bool(
-            self.scheduler.depth or not self._free)
+            self.scheduler.depth or self._peek_free_slot() is None)
+        req._seq = self._seq
         self._seq += 1
+        if self._qos is not None:
+            ok, reason = self._qos.admit(
+                qos_cls,
+                class_ttft_p95=self.metrics.class_ttft_p95(qos_cls.name),
+                under_load=req.submitted_under_load)
+            if not ok:
+                self.metrics.on_submit(req)
+                req._shed(self._iteration, reason)
+                self.metrics.on_shed(req, reason)
+                return req
         try:
             self.scheduler.add(req)
         except RuntimeError:
             self.metrics.on_reject()
             raise
-        self.metrics.on_submit()
+        self.metrics.on_submit(req)
         return req
 
     def cancel(self, request_id) -> bool:
@@ -401,7 +538,8 @@ class ServingEngine:
 
     @property
     def num_free_slots(self) -> int:
-        return len(self._free)
+        """Free ADMISSIBLE slots (below the autoscaling slot cap)."""
+        return sum(1 for s in self._free if s < self._slot_cap)
 
     @property
     def iteration(self) -> int:
@@ -409,37 +547,88 @@ class ServingEngine:
         load harness schedules arrivals against."""
         return self._iteration
 
+    @property
+    def qos_level(self) -> Optional[int]:
+        """Current degradation-ladder level (None when QoS is off)."""
+        return self._qos.level if self._qos is not None else None
+
+    @property
+    def slot_cap(self) -> int:
+        """Admissible-slot cap (autoscaling; <= num_slots)."""
+        return self._slot_cap
+
     # -- engine loop -------------------------------------------------------
     def advance(self):
-        """One engine iteration: expire overdue queued requests, admit
-        into free slots (paged mode: reserve pages + run at most
-        ``max_chunks_per_iter`` prefill chunks), dispatch one decode over
-        the slot batch, harvest readbacks beyond the pipeline depth. Safe
-        to call when idle (no-op)."""
+        """One engine iteration: run any pending fault recovery, evaluate
+        the QoS ladder, expire overdue queued requests, admit into free
+        slots (preempting lower classes for an at-risk high-priority head;
+        paged mode: reserve pages + run at most ``max_chunks_per_iter``
+        prefill chunks), dispatch one decode over the slot batch, harvest
+        readbacks beyond the pipeline depth. Safe to call when idle
+        (no-op)."""
+        if self._watchdog_report is not None:
+            report, self._watchdog_report = self._watchdog_report, None
+            self.recover("hung decode dispatch", kind="watchdog",
+                         detail=report)
+        self._preempts_this_iter = 0
+        if self._qos is not None:
+            self._qos_tick()
         self._expire_queued()
-        if self._paged is not None:
-            self._admit_ready_paged()
-            self._run_prefill_chunks()
-        else:
-            self._admit_ready()
-        dispatched = self._dispatch_decode()
-        # keep at most pipeline_depth dispatches in flight; drain fully
-        # when nothing new was dispatched (tail of the workload)
-        target = self.config.pipeline_depth if dispatched else 0
-        while len(self._pending) > target:
-            self._harvest_one()
+        # the watchdog covers everything that can block on the device:
+        # admit/prefill dispatches, the decode dispatch, and readbacks
+        if self._watchdog is not None:
+            self._watchdog.step_started()
+        try:
+            if self._paged is not None:
+                self._admit_ready_paged()
+                self._run_prefill_chunks()
+            else:
+                self._admit_ready()
+            dispatched = self._dispatch_decode()
+            # keep at most pipeline_depth dispatches in flight; drain fully
+            # when nothing new was dispatched (tail of the workload)
+            target = self.config.pipeline_depth if dispatched else 0
+            while len(self._pending) > target:
+                self._harvest_one()
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.step_finished()
         busy = sum(r is not None for r in self._slot_req)
         self.metrics.sample(self.scheduler.depth, busy,
                             self.config.num_slots, self._iteration,
                             paged=(self._paged.stats()
-                                   if self._paged is not None else None))
+                                   if self._paged is not None else None),
+                            qos_level=(self._qos.level
+                                       if self._qos is not None else None),
+                            slot_cap=self._slot_cap)
         if self._iteration % self.config.metrics_interval == 0:
             self.metrics.flush()
 
+    def _qos_tick(self):
+        """One degradation-ladder evaluation on the decode-step clock,
+        plus the queued-request shed sweep the current level implies.
+        Inputs are host scheduler state and step-denominated percentiles
+        only — decisions replay bit-exactly for a replayed trace."""
+        free_frac = None
+        if self._paged is not None:
+            stats = self._paged.stats()
+            free_frac = 1.0 - stats["page_utilization"]
+        self._qos.observe(
+            iteration=self._iteration,
+            queue_depth=self.scheduler.depth,
+            ttft_p95_steps=self.metrics.ttft_under_load_p95(),
+            free_frac=free_frac)
+        pred = self._qos.queued_shed_predicate()
+        if pred is not None:
+            for req in self.scheduler.shed_queued(pred):
+                req._shed(self._iteration, qos_mod.SHED_LADDER)
+                self.metrics.on_shed(req, qos_mod.SHED_LADDER)
+
     def _expire_queued(self):
         """Deadline sweep on the deterministic iteration clock: overdue
-        queued requests complete with ``timeout`` status (load shedding
-        at the queue — admitted requests are never preempted)."""
+        queued requests complete with ``timeout`` status. Only requests
+        that never started are swept — preempted ones hold generated
+        tokens and resume instead (scheduler.expire exempts them)."""
         for req in self.scheduler.expire(self._iteration):
             req._timed_out(self._iteration)
             self.metrics.on_timeout(req)
@@ -455,65 +644,186 @@ class ServingEngine:
             fold = zlib.crc32(repr(req.request_id).encode())
         return jax.random.fold_in(self._rng, fold % (2**31))
 
+    # -- free-slot bookkeeping (autoscaling cap aware) ---------------------
+    def _peek_free_slot(self) -> Optional[int]:
+        """First free slot below the admissible cap (None when all taken
+        or drained by a scale-down)."""
+        for s in self._free:
+            if s < self._slot_cap:
+                return s
+        return None
+
+    def _take_slot(self, slot: int):
+        self._free.remove(slot)
+
+    # -- priority preemption -----------------------------------------------
+    def _try_preempt_for(self, head: Request, need: str = "slot") -> bool:
+        """Free capacity for an at-risk high-priority queue head by
+        preempting the lowest-priority active request back to the queue.
+        ``need`` names the starved resource — ``"slot"`` (contiguous
+        engine / no free slot) or ``"pages"`` (paged admission failed) —
+        so the retry signal matches what admission actually checks: a
+        free slot alone never un-starves a page-starved head. Returns
+        True when admission should be retried. Deterministic: runs on
+        the engine clock, bounded by ``max_preemptions_per_iter``."""
+        if self._qos is None or not self._qos.config.preemption:
+            return False
+        if (self._preempts_this_iter
+                >= self._qos.config.max_preemptions_per_iter):
+            return False
+        head_cls = self._qos.config.class_for(head.priority)
+        if not self._qos.head_at_risk(head, head_cls, self._iteration):
+            return False
+        # drain in-flight work first: the victim's already-dispatched
+        # tokens are real continuations that must be retained for resume,
+        # and a completion may free slots/pages outright (no preemption
+        # needed)
+        drained = bool(self._pending)
+        while self._pending:
+            self._harvest_one()
+        if need == "slot" and self._peek_free_slot() is not None:
+            return True
+        if need == "pages" and drained:
+            return True     # completions may have released pages: retry
+                            # admission before spending the preempt budget
+        victim_slot = None
+        for slot, r in enumerate(self._slot_req):
+            if r is None or r.done or r.priority >= head.priority:
+                continue
+            if victim_slot is None:
+                victim_slot = slot
+                continue
+            v = self._slot_req[victim_slot]
+            # lowest priority first; among ties the most recently admitted
+            # loses (least sunk work discarded), then the highest slot —
+            # a total order, so the same state always picks the same victim
+            if ((r.priority, -(r.admitted_iteration or 0), -slot)
+                    < (v.priority, -(v.admitted_iteration or 0),
+                       -victim_slot)):
+                victim_slot = slot
+        if victim_slot is None:
+            return False
+        self._preempt_slot(victim_slot, reason="priority")
+        self._preempts_this_iter += 1
+        return True
+
+    def _preempt_slot(self, slot: int, reason: str):
+        """Preempt one active request back to the queue: mask its device
+        row (the cancel machinery — in-flight decode steps drop it), free
+        its slot/pages, and requeue it at the front of its class with
+        generated tokens retained. Call only with ``self._pending``
+        drained — undelivered tokens would otherwise be lost to the
+        resume prompt."""
+        req = self._slot_req[slot]
+        self._state = {
+            **self._state,
+            "active": self._state["active"].at[slot].set(False),
+            "remaining": self._state["remaining"].at[slot].set(0),
+        }
+        if self._paged is not None:
+            self._prefill_tasks = deque(
+                t for t in self._prefill_tasks if t[0] != slot)
+            self._paged.release_slot(slot)
+        self._slot_req[slot] = None
+        self._free.append(slot)
+        req._preempted(self._iteration)
+        self.scheduler.requeue(req)
+        self.metrics.on_preempt(req, reason)
+        log_dist(f"serving: preempted request {req.request_id!r} "
+                 f"(slot {slot}, {len(req.tokens)} tokens retained, "
+                 f"reason={reason})", ranks=[0])
+
     def _admit_ready(self):
-        while self._free:
-            req = self.scheduler.next_request()
+        while True:
+            req = self.scheduler.peek()
             if req is None:
                 return
-            slot = self._free.popleft()
-            n = req.prompt.shape[0]
+            slot = self._peek_free_slot()
+            if slot is None:
+                if self._try_preempt_for(req):
+                    continue        # a slot (or a completion) freed up
+                return
+            self.scheduler.next_request()   # actually pop the head
+            self._take_slot(slot)
+            # resumption re-prefills prompt + retained partial output;
+            # for a fresh request these are just prompt / max_new_tokens
+            prompt = req.effective_prompt()
+            max_new = req.remaining_budget()
+            resumed = req.status == PREEMPTED
+            n = prompt.shape[0]
             bucket = self.config.bucket_for(n)
             padded = np.zeros((1, bucket), np.int32)
-            padded[0, :n] = req.prompt
+            padded[0, :n] = prompt
             greedy, has_k, has_p, t, k, p = self._mode
             rng = self._req_rng(req)
             # request_id in the span args: a trace capture can rebuild
             # per-request latency (admit -> decode iterations -> harvest)
-            with _span("serving/admit", {"request_id": req.request_id,
-                                         "prompt_len": n}), \
-                    _goodput("compute"):
-                self._cache, self._state, tok, done = _admit_jit(
-                    self.module, self.params, self._cache, self._state,
-                    jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
-                    jnp.int32(req.max_new_tokens), rng, self._eos, t, k, p,
-                    self._param_transform, greedy, has_k, has_p)
+            try:
+                with _span("serving/admit", {"request_id": req.request_id,
+                                             "prompt_len": n}), \
+                        _goodput("compute"):
+                    self._cache, self._state, tok, done = _admit_jit(
+                        self.module, self.params, self._cache, self._state,
+                        jnp.asarray(padded), jnp.int32(n), jnp.int32(slot),
+                        jnp.int32(max_new), rng, self._eos, t, k, p,
+                        self._param_transform, greedy, has_k, has_p)
+            except Exception as e:
+                if not is_oom_error(e):
+                    raise
+                self._shed_on_oom(req, "admit", e)
+                return
             self._slot_req[slot] = req
             req._admitted(slot, self._iteration)
-            self.metrics.on_admit()
+            self.metrics.on_admit(req)
+            if resumed:
+                self.metrics.on_resume(req)
             self._pending.append(("admit", slot, req, tok, done))
 
     # -- paged admission + chunked prefill ---------------------------------
     def _admit_ready_paged(self):
         """Admit queued requests while pages cover them. Admission gates
         on free PAGES, not free slots: a page-starved queue head stays
-        queued (strict FIFO) until running requests release pages or the
-        prefix cache evicts — slots are cheap metadata in paged mode, so
-        the pool is the real admission resource."""
-        while self._free:
+        queued (class order preserved) until running requests release
+        pages, the prefix cache evicts, or — with QoS on — an at-risk
+        high-priority head preempts a lower class's pages free."""
+        while True:
             req = self.scheduler.peek()
             if req is None:
                 return
-            slot = self._free[0]
-            shared = self._paged.try_admit(slot, req.prompt,
-                                           req.max_new_tokens)
-            if shared is None:
-                return                      # page-starved: head waits
+            slot = self._peek_free_slot()
+            if slot is None:
+                if self._try_preempt_for(req):
+                    continue
+                return
+            prompt = req.effective_prompt()
+            max_new = req.remaining_budget()
+            shared = self._paged.try_admit(slot, prompt, max_new)
+            if shared is None:              # page-starved head
+                if self._try_preempt_for(req, need="pages"):
+                    continue                # preemption released pages
+                return
             self.scheduler.next_request()   # actually pop it
-            self._free.popleft()
+            self._take_slot(slot)
+            resumed = req.status == PREEMPTED
             self._slot_req[slot] = req
             req._admitted(slot, self._iteration)
-            self.metrics.on_admit(shared_tokens=shared)
+            self.metrics.on_admit(req, shared_tokens=shared)
+            if resumed:
+                self.metrics.on_resume(req)
             self._prefill_tasks.append(
-                (slot, req, self._plan_chunks(req, shared)))
+                (slot, req, prompt, max_new,
+                 self._plan_chunks(prompt, shared)))
 
-    def _plan_chunks(self, req, shared_tokens: int):
-        """Split the non-shared prompt tail into page-aligned chunks:
+    def _plan_chunks(self, prompt, shared_tokens: int):
+        """Split the non-shared prefill tail into page-aligned chunks:
         full ``chunk_tokens`` chunks, then one tail chunk padded to the
         smallest page multiple covering the remainder — so chunk widths
         (the only prefill jit axis) come from a bounded bucket set.
         Always at least one chunk: the prefix match caps at the last
-        prompt token, whose logits seed sampling."""
-        p_len = int(req.prompt.shape[0])
+        prefill token, whose logits seed sampling. ``prompt`` is the
+        EFFECTIVE prompt (original + any retained partial output for a
+        resumption)."""
+        p_len = int(prompt.shape[0])
         page = self._paged.page_len
         cap = self._paged.chunk_tokens
         chunks, start = [], shared_tokens
@@ -526,51 +836,65 @@ class ServingEngine:
 
     def _run_prefill_chunks(self):
         """Run at most ``max_chunks_per_iter`` prefill chunks this
-        iteration, FIFO across admitted-but-unprefilled requests — the
+        iteration (the degradation ladder shrinks the budget at level >=
+        2), FIFO across admitted-but-unprefilled requests — the
         chunked-prefill contract: a long prompt never stalls the decode
         batch by more than this many chunks per decode dispatch."""
         budget = self.config.paging.max_chunks_per_iter
+        if self._qos is not None:
+            budget = self._qos.max_chunks(budget)
         while budget > 0 and self._prefill_tasks:
-            slot, req, chunks = self._prefill_tasks[0]
+            slot, req, prompt, max_new, chunks = self._prefill_tasks[0]
             start, width = chunks.pop(0)
-            self._dispatch_chunk(slot, req, start, width,
-                                 is_last=not chunks)
+            ok = self._dispatch_chunk(slot, req, prompt, max_new, start,
+                                      width, is_last=not chunks)
+            if not ok:
+                return          # OOM containment reset the queue state
             if not chunks:
                 self._prefill_tasks.popleft()
             budget -= 1
 
-    def _dispatch_chunk(self, slot: int, req, start: int, width: int,
-                        is_last: bool):
+    def _dispatch_chunk(self, slot: int, req, prompt, max_new: int,
+                        start: int, width: int, is_last: bool) -> bool:
         """Prefill one page-aligned chunk of one request. Mid-chunks only
         fill pages; the LAST chunk also samples the first token (pipelined
         like a contiguous admit) and publishes the prompt's full pages to
         the prefix cache. Same program either way — ``is_last`` is a
-        traced flag, not a jit specialization."""
-        p_len = int(req.prompt.shape[0])
+        traced flag, not a jit specialization. Returns False when a
+        RESOURCE_EXHAUSTED was contained (the caller must stop driving
+        the now-reset prefill queue)."""
+        p_len = int(prompt.shape[0])
         real = min(start + width, p_len) - start
         padded = np.zeros((1, width), np.int32)
-        padded[0, :real] = req.prompt[start:start + real]
+        padded[0, :real] = prompt[start:start + real]
         greedy, has_k, has_p, t, k, p = self._mode
         mgr = self._paged
-        with _span("serving/prefill_chunk",
-                   {"slot": slot, "request_id": req.request_id,
-                    "start": start, "tokens": real,
-                    "last": bool(is_last)}), \
-                _goodput("compute"):
-            mgr.pool, self._state, tok, done = _chunk_prefill_jit(
-                self.module, self.params, mgr.pool, self._state,
-                mgr.page_table[slot], jnp.asarray(padded),
-                jnp.int32(start), jnp.int32(p_len), jnp.int32(slot),
-                jnp.int32(req.max_new_tokens), jnp.asarray(is_last),
-                self._req_rng(req), self._eos, t, k, p,
-                self._param_transform, greedy, has_k, has_p)
+        try:
+            with _span("serving/prefill_chunk",
+                       {"slot": slot, "request_id": req.request_id,
+                        "start": start, "tokens": real,
+                        "last": bool(is_last)}), \
+                    _goodput("compute"):
+                mgr.pool, self._state, tok, done = _chunk_prefill_jit(
+                    self.module, self.params, mgr.pool, self._state,
+                    mgr.page_table[slot], jnp.asarray(padded),
+                    jnp.int32(start), jnp.int32(p_len), jnp.int32(slot),
+                    jnp.int32(max_new), jnp.asarray(is_last),
+                    self._req_rng(req), self._eos, t, k, p,
+                    self._param_transform, greedy, has_k, has_p)
+        except Exception as e:
+            if not is_oom_error(e):
+                raise
+            self._shed_on_oom(req, "chunk_prefill", e)
+            return False
         self.metrics.on_prefill_chunk(real)
         if is_last:
             # pages below the prompt's full-page boundary are immutable
             # from here (decode appends strictly past them): publish them
             # for copy-free reuse by later identical prefixes
-            mgr.publish(slot, req.prompt)
+            mgr.publish(slot, prompt)
             self._pending.append(("admit", slot, req, tok, done))
+        return True
 
     def _dispatch_decode(self) -> bool:
         if all(r is None for r in self._slot_req):
@@ -641,6 +965,93 @@ class ServingEngine:
             self._paged.release_slot(slot)
         self._slot_req[slot] = None
         self._free.append(slot)
+
+    # -- fault containment + recovery --------------------------------------
+    def _shed_on_oom(self, req: Request, where: str, err: Exception):
+        """RESOURCE_EXHAUSTED containment: dump the allocation-failure
+        post-mortem (observability/memory.py oom_forensics — the
+        attributed-buffer view, not a bare error string), shed the
+        offending request with explicit status, and rebuild the device
+        state via ``recover()`` so the engine keeps serving everyone
+        else. The jitted admit/prefill programs donate their cache/pool
+        operands, so after a failed call those buffers cannot be trusted
+        — a full device-state rebuild is the only safe continuation."""
+        report = oom_forensics(
+            reason=f"serving {where} RESOURCE_EXHAUSTED "
+                   f"(request {req.request_id!r}): {str(err)[:200]}")
+        self.last_oom_forensics = report
+        req._shed(self._iteration, qos_mod.SHED_OOM)
+        self.metrics.on_shed(req, qos_mod.SHED_OOM)
+        self.metrics.on_fault("oom", f"{where}: request {req.request_id!r} "
+                              "shed after RESOURCE_EXHAUSTED",
+                              self._iteration)
+        log_dist(f"serving: RESOURCE_EXHAUSTED during {where} — request "
+                 f"{req.request_id!r} shed, forensics captured, engine "
+                 "recovering", ranks=[0])
+        self.recover(f"oom during {where}", kind="oom",
+                     detail=str(err)[:500])
+
+    def recover(self, reason: str, kind: str = "restart",
+                detail: Optional[str] = None):
+        """Requeue-and-re-prefill recovery — the serving engine restart.
+
+        Drops in-flight readbacks (their tokens were never streamed, so
+        re-prefill regenerates them exactly), rebuilds the device-side
+        cache/pool/state from scratch (same shapes: every compiled
+        program stays cached), and pushes every live admitted request
+        back to the queue in original arrival order with its generated
+        tokens retained. Queued requests are untouched. The next
+        ``advance()`` re-admits and re-prefills prompt + partial output —
+        token-exact under greedy sampling, page-granular prefix-cache
+        hits making the recompute cheap on the paged engine."""
+        self._pending.clear()
+        victims = [r for r in self._slot_req
+                   if r is not None and not r.done]
+        n = self.config.num_slots
+        self._slot_req = [None] * n
+        self._free = deque(range(n))
+        self._init_device_state()
+        # requeue_front in reverse arrival order: the earliest-submitted
+        # victim ends up at its class head, restoring FIFO-within-class
+        for r in sorted(victims, key=lambda r: r._seq or 0, reverse=True):
+            r._preempted(self._iteration)
+            self.scheduler.requeue(r)
+            self.metrics.on_preempt(r, kind)
+        self.metrics.on_recover(kind, reason, len(victims), self._iteration)
+        self._restart_watchdog()   # a fired watchdog thread is one-shot
+        log_dist(f"serving: recovered ({kind}: {reason}) — device state "
+                 f"rebuilt, {len(victims)} active requests requeued for "
+                 "re-prefill", ranks=[0])
+        if detail:
+            log_dist(f"serving: recovery detail: {detail.splitlines()[0]}",
+                     ranks=[0])
+
+    # -- elastic capacity (autoscaling hooks) ------------------------------
+    def set_slot_cap(self, n: int) -> int:
+        """Set the admissible-slot cap (the in-process scale axis the
+        elasticity autoscaler drives). Scale-down DRAINS: active requests
+        in slots above the cap are preempted back to the queue via the
+        normal preemption path — tokens retained, resumed later in an
+        admissible slot — never dropped. Compiled shapes are untouched
+        (decode always runs the full ``num_slots`` batch; capped slots
+        ride along masked). Returns the applied cap."""
+        n = max(1, min(int(n), self.config.num_slots))
+        if n == self._slot_cap:
+            return n
+        old, self._slot_cap = self._slot_cap, n
+        if n < old:
+            drained = [s for s in range(n, self.config.num_slots)
+                       if self._slot_req[s] is not None]
+            if drained:
+                while self._pending:    # retain in-flight tokens first
+                    self._harvest_one()
+                for slot in drained:
+                    r = self._slot_req[slot]
+                    if r is not None and not r.done:
+                        self._preempt_slot(slot, reason="scale_down")
+        log_dist(f"serving: slot cap {old} -> {n} "
+                 f"(of {self.config.num_slots} compiled slots)", ranks=[0])
+        return n
 
     # -- construction helpers ---------------------------------------------
     @classmethod

@@ -28,6 +28,11 @@ from ..observability.metrics import percentile as _percentile_impl
 # dashboard convention anyway
 HISTORY_WINDOW = 4096
 
+# retained fault-log entries (watchdog fires, OOM sheds, recoveries):
+# the /statusz breadcrumb trail, capped so a flapping fault can't grow
+# the snapshot without bound
+FAULT_LOG_LIMIT = 32
+
 
 def _percentile(values, q):
     """Nearest-rank percentile without numpy (values non-empty) — the
@@ -65,6 +70,21 @@ class ServingMetrics:
         self.requests_timed_out = 0    # queued past deadline_steps
         self.requests_cancelled = 0    # client cancel() (queued or active)
         self.requests_rejected = 0     # refused at submit (budget/queue cap)
+        self.requests_shed = 0         # QoS shed (SLO admission / ladder /
+                                       # OOM containment) — explicit status,
+                                       # never a silent TTL expiry
+        self.requests_preempted = 0    # preempted-to-queue events (priority
+                                       # preemption, scale-down drain,
+                                       # recovery requeue)
+        self.requests_resumed = 0      # re-admissions after preemption
+        self.recoveries = 0            # requeue-and-re-prefill recoveries
+        self.shed_by_reason = {}       # reason -> count (qos.SHED_*)
+        self.faults = []               # [{kind, detail, iteration}] capped
+                                       # at FAULT_LOG_LIMIT (watchdog/oom/
+                                       # recovery breadcrumbs for /statusz)
+        self.per_class = {}            # qos class name -> counters + ttft
+        self.qos_level = None          # latest ladder level (engine sample)
+        self.slot_cap = None           # latest admissible-slot cap
         self.tokens_generated = 0
         self.prefills = 0
         self.decode_iterations = 0
@@ -92,16 +112,53 @@ class ServingMetrics:
         self.started_at: Optional[float] = None
         self._events = []
 
+    # -- per-class accounting ----------------------------------------------
+    def _cls(self, request) -> Optional[dict]:
+        """The per-class bucket for a request (None when it carries no
+        QoS class — priority-free traffic stays out of the breakdown)."""
+        name = getattr(request, "qos_class", None) if request is not None \
+            else None
+        if name is None:
+            return None
+        c = self.per_class.get(name)
+        if c is None:
+            c = {"submitted": 0, "admitted": 0, "finished": 0,
+                 "timed_out": 0, "shed": 0, "preempted": 0, "resumed": 0,
+                 "ttft_steps": deque(maxlen=self.history_window)}
+            self.per_class[name] = c
+        return c
+
+    def class_ttft_p95(self, class_name: str):
+        """p95 TTFT (steps, deterministic) of one class's recent
+        completions — the SLO-admission signal (None = no data yet)."""
+        c = self.per_class.get(class_name)
+        if not c or not c["ttft_steps"]:
+            return None
+        return _percentile(c["ttft_steps"], 95)
+
+    def ttft_under_load_p95(self):
+        """p95 of the under-load TTFT population in steps (the ladder's
+        latency signal; None until under-load completions exist)."""
+        if not self.ttft_steps_under_load:
+            return None
+        return _percentile(self.ttft_steps_under_load, 95)
+
     # -- engine hooks ------------------------------------------------------
-    def on_submit(self):
+    def on_submit(self, request=None):
         if self.started_at is None:
             self.started_at = time.perf_counter()
         self.requests_submitted += 1
+        c = self._cls(request)
+        if c is not None:
+            c["submitted"] += 1
 
-    def on_admit(self, shared_tokens: int = 0):
+    def on_admit(self, request=None, shared_tokens: int = 0):
         self.requests_admitted += 1
         self.prefills += 1
         self.prefill_tokens_reused += shared_tokens
+        c = self._cls(request)
+        if c is not None:
+            c["admitted"] += 1
 
     def on_prefill_chunk(self, tokens_computed: int):
         self.prefill_chunks += 1
@@ -116,12 +173,63 @@ class ServingMetrics:
 
     def on_timeout(self, request):
         self.requests_timed_out += 1
+        c = self._cls(request)
+        if c is not None:
+            c["timed_out"] += 1
 
     def on_cancel(self, request):
         self.requests_cancelled += 1
 
     def on_reject(self):
         self.requests_rejected += 1
+
+    def on_shed(self, request, reason=None):
+        """Explicit QoS shed (admission refusal, ladder sweep, or OOM
+        containment) — counted overall, per reason, and per class, and
+        mirrored into the shared registry so /metrics and /statusz show
+        the shed rate without a snapshot call."""
+        self.requests_shed += 1
+        key = reason or "unspecified"
+        self.shed_by_reason[key] = self.shed_by_reason.get(key, 0) + 1
+        c = self._cls(request)
+        if c is not None:
+            c["shed"] += 1
+        if self.registry is not None:
+            self.registry.counter("serving/requests_shed").inc()
+
+    def on_preempt(self, request, reason="priority"):
+        self.requests_preempted += 1
+        c = self._cls(request)
+        if c is not None:
+            c["preempted"] += 1
+        if self.registry is not None:
+            self.registry.counter("serving/requests_preempted").inc()
+
+    def on_resume(self, request):
+        self.requests_resumed += 1
+        c = self._cls(request)
+        if c is not None:
+            c["resumed"] += 1
+        if self.registry is not None:
+            self.registry.counter("serving/requests_resumed").inc()
+
+    def on_fault(self, kind: str, detail: str, iteration: int):
+        """One containment event (watchdog fire, OOM shed, recovery):
+        appended to the capped fault log and counted in the registry —
+        the acceptance surface for "the events are visible in /statusz
+        and the metrics snapshot"."""
+        self.faults.append({"kind": kind, "detail": detail,
+                            "iteration": iteration})
+        del self.faults[:-FAULT_LOG_LIMIT]
+        if self.registry is not None:
+            self.registry.counter(f"serving/faults/{kind}").inc()
+
+    def on_recover(self, kind: str, reason: str, requeued: int,
+                   iteration: int):
+        self.recoveries += 1
+        self.on_fault("recovery",
+                      f"{kind}: {reason} ({requeued} requests requeued)",
+                      iteration)
 
     def on_finish(self, request):
         self.requests_finished += 1
@@ -134,16 +242,30 @@ class ServingMetrics:
             self.ttft_steps.append(steps)
             if getattr(request, "submitted_under_load", False):
                 self.ttft_steps_under_load.append(steps)
+            c = self._cls(request)
+            if c is not None:
+                c["ttft_steps"].append(steps)
+                c["finished"] += 1
+        else:
+            c = self._cls(request)
+            if c is not None:
+                c["finished"] += 1
         if request.latency_s is not None:
             self.latency_s.append(request.latency_s)
 
     def sample(self, queue_depth: int, busy_slots: int, num_slots: int,
-               iteration: int, paged: Optional[dict] = None):
+               iteration: int, paged: Optional[dict] = None,
+               qos_level: Optional[int] = None,
+               slot_cap: Optional[int] = None):
         self.queue_depth_sum += queue_depth
         self.queue_depth_max = max(self.queue_depth_max, queue_depth)
         self.occupancy_sum += busy_slots / max(1, num_slots)
         self.busy_slots_max = max(self.busy_slots_max, busy_slots)
         self.samples += 1
+        if qos_level is not None:
+            self.qos_level = qos_level
+        if slot_cap is not None:
+            self.slot_cap = slot_cap
         if self.registry is not None:
             # live scheduler state as registry GAUGES (host ints from the
             # scheduler, zero device reads): the SLO-admission data plane
@@ -151,6 +273,10 @@ class ServingMetrics:
             # series — previously reachable only via internal state
             self.registry.gauge("serving/queue_depth").set(queue_depth)
             self.registry.gauge("serving/active_slots").set(busy_slots)
+            if qos_level is not None:
+                self.registry.gauge("serving/qos_level").set(qos_level)
+            if slot_cap is not None:
+                self.registry.gauge("serving/slot_cap").set(slot_cap)
         if paged is not None:
             self.paged_stats = paged    # host allocator arithmetic only
         if self.monitor is not None and getattr(self.monitor, "enabled",
@@ -164,6 +290,14 @@ class ServingMetrics:
                 ("serving/requests_finished", self.requests_finished,
                  iteration),
             ])
+            if qos_level is not None:
+                self._events.extend([
+                    ("serving/qos_level", qos_level, iteration),
+                    ("serving/requests_shed", self.requests_shed,
+                     iteration),
+                    ("serving/requests_preempted", self.requests_preempted,
+                     iteration),
+                ])
             if paged is not None:
                 self._events.append(("serving/page_utilization",
                                      paged["page_utilization"], iteration))
@@ -195,6 +329,10 @@ class ServingMetrics:
             "requests_timed_out": self.requests_timed_out,
             "requests_cancelled": self.requests_cancelled,
             "requests_rejected": self.requests_rejected,
+            "requests_shed": self.requests_shed,
+            "requests_preempted": self.requests_preempted,
+            "requests_resumed": self.requests_resumed,
+            "recoveries": self.recoveries,
             "tokens_generated": self.tokens_generated,
             "prefills": self.prefills,
             "decode_iterations": self.decode_iterations,
@@ -230,4 +368,29 @@ class ServingMetrics:
                 out[f"{name}_p50"] = _percentile(vals, 50)
                 out[f"{name}_p95"] = _percentile(vals, 95)
                 out[f"{name}_mean"] = sum(vals) / len(vals)
+        if self.qos_level is not None:
+            out["qos_level"] = self.qos_level
+        if self.slot_cap is not None:
+            out["slot_cap"] = self.slot_cap
+        if self.shed_by_reason:
+            for reason, n in sorted(self.shed_by_reason.items()):
+                out[f"shed/{reason}"] = n
+        if self.faults:
+            # breadcrumb list (capped): /statusz and the BENCH artifact
+            # show WHAT fired, not just that a counter moved
+            out["faults"] = list(self.faults)
+        # per-priority-class breakdown as flat numeric keys so the
+        # registry collector, /metrics (Prometheus), /statusz, and
+        # ds_tpu_report all surface it without schema changes
+        for name, c in sorted(self.per_class.items()):
+            for key in ("submitted", "admitted", "finished", "timed_out",
+                        "shed", "preempted", "resumed"):
+                out[f"class/{name}/{key}"] = c[key]
+            if c["submitted"]:
+                out[f"class/{name}/shed_rate"] = c["shed"] / c["submitted"]
+            if c["ttft_steps"]:
+                out[f"class/{name}/ttft_steps_p50"] = _percentile(
+                    c["ttft_steps"], 50)
+                out[f"class/{name}/ttft_steps_p95"] = _percentile(
+                    c["ttft_steps"], 95)
         return out

@@ -201,6 +201,9 @@ class PagedKVManager:
         self.max_pages = config.cache_len // self.page_len
         self.num_pages = pcfg.pool_pages(config.num_slots, config.cache_len)
         self.chunk_tokens = pcfg.chunk_tokens
+        self._module = module          # kept for reset() (fault recovery)
+        self._params = params
+        self._num_slots = config.num_slots
         self.pool = init_page_pool(module, params, self.num_pages,
                                    self.page_len)
         self.allocator = PageAllocator(self.num_pages)
@@ -281,6 +284,22 @@ class PagedKVManager:
         with _span("serving/page_table_copy", {"slot": slot, "pages": 0}):
             self.page_table = self.page_table.at[slot].set(
                 jnp.full((self.max_pages,), NULL_PAGE, jnp.int32))
+
+    def reset(self):
+        """Rebuild the device pool and every host-side ownership structure
+        from scratch — the fault-containment path (engine.recover): after
+        a RESOURCE_EXHAUSTED mid-admit the donated pool buffers may be
+        invalid, and after a requeue-and-re-prefill recovery every page's
+        contents are stale anyway. Shapes are unchanged, so the compiled
+        paged programs stay cached."""
+        self.pool = init_page_pool(self._module, self._params,
+                                   self.num_pages, self.page_len)
+        self.allocator = PageAllocator(self.num_pages)
+        self.prefix = (PrefixCache(self.page_len, self.allocator)
+                       if self.config.enable_prefix_cache else None)
+        self.page_table = jnp.full((self._num_slots, self.max_pages),
+                                   NULL_PAGE, jnp.int32)
+        self._slot_pages = [None] * self._num_slots
 
     # -- accounting --------------------------------------------------------
     def pool_bytes(self) -> int:

@@ -122,6 +122,45 @@ def test_serve_metrics_port_endpoint(tmp_path):
     assert telemetry[0].endswith("/metrics")
 
 
+def test_serve_qos_smoke(tmp_path):
+    """A qos-enabled serve run completes and announces the shed/preempt
+    counters plus the per-class breakdown on stdout (the operator-facing
+    QoS summary line), with the per-class keys in the metrics JSON."""
+    out = tmp_path / "metrics.json"
+    r = _run([os.path.join(BIN, "ds_tpu_serve"), "--synthetic", "5",
+              "--qos", "--num-slots", "2", "--max-len", "48",
+              "--prefill-bucket", "16", "--max-new-tokens", "3",
+              "--d-model", "32", "--n-layers", "1", "--vocab-size", "64",
+              "--quiet", "--metrics-out", str(out)], timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    qos_lines = [l for l in r.stdout.splitlines() if l.startswith("qos:")]
+    assert qos_lines, r.stdout[-800:]
+    assert "shed=" in qos_lines[0] and "preempted=" in qos_lines[0]
+    snap = json.loads(out.read_text())
+    assert "requests_shed" in snap and "requests_preempted" in snap
+    assert any(k.startswith("class/") for k in snap)
+
+
+def test_serve_crash_leaves_partial_snapshot_and_exits_nonzero(tmp_path):
+    """The fault-containment satellite: a serving loop that dies mid-run
+    (chaos hook --inject-crash-at) exits NONZERO and still leaves the
+    partial metrics snapshot — stdout JSON + the sidecar file (the
+    bench.py partial-artifact pattern; a crash used to leave nothing)."""
+    out = tmp_path / "metrics.json"
+    r = _run([os.path.join(BIN, "ds_tpu_serve"), "--synthetic", "4",
+              "--num-slots", "2", "--max-len", "48", "--prefill-bucket",
+              "16", "--max-new-tokens", "4", "--d-model", "32",
+              "--n-layers", "1", "--vocab-size", "64", "--quiet",
+              "--inject-crash-at", "2", "--metrics-out", str(out)],
+             timeout=300)
+    assert r.returncode != 0
+    artifact = json.loads(out.read_text())
+    assert artifact["failed"] is True
+    assert "injected crash" in artifact["reason"]
+    # whatever the engine accumulated before dying rode along
+    assert artifact["serving"].get("requests_submitted") == 4
+
+
 def test_report_diff_two_snapshots(tmp_path):
     """ds_tpu_report --diff: counters as deltas, gauges before->after,
     ordered by the meta capture stamps (stdlib path, no jax needed)."""
