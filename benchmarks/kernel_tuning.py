@@ -125,13 +125,92 @@ def sweep_flash_attention(batch, heads, sq, sk, head_dim, dtype="bfloat16",
     return entries
 
 
+def _paged_candidates(heads, page_len, max_pages, max_candidates=None):
+    """(block_k tokens, head_block) candidates for the paged decode
+    kernel: page_len multiples up to the table width (the DMA block the
+    kernel double-buffers) crossed with head-tile divisors."""
+    bks = [page_len * n for n in (1, 2, 4, 8) if n <= max_pages]
+    hbs = [h for h in (8, 4, 2, 1) if heads % h == 0]
+    cands = [(bk, hb) for bk in bks for hb in hbs]
+    return cands[:max_candidates] if max_candidates else cands
+
+
+def sweep_paged_attention(slots, heads, head_dim, page_len, max_pages,
+                          dtype="float32", kv_int8=False, trials=3,
+                          warmup=1, max_candidates=None, log=print):
+    """Time candidate (block_k, head_block) tilings of the paged
+    decode-attention kernel at one (slots x pages x head-dim) serving
+    shape; returns {key: entry} in the shared tuning-artifact format
+    (``block_k`` in TOKENS — pages_per_block = block_k / page_len)."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.pallas import paged_attention, tuning
+    from deepspeed_tpu.ops.pallas.paged_attention import KERNEL
+
+    num_pages = slots * max_pages + 1
+    ks = jax.random.split(jax.random.PRNGKey(0), 5)
+    dt = jnp.dtype(dtype)
+    kp = jax.random.normal(ks[0], (num_pages, heads, head_dim, page_len), dt)
+    vp = jax.random.normal(ks[1], (num_pages, heads, head_dim, page_len), dt)
+    scales = {}
+    if kv_int8:
+        # THE scatter-side quantization rule (inference/cache.py) so the
+        # timed path dequantizes exactly what serving would store
+        from deepspeed_tpu.inference.cache import _quantize_kv
+        kp, ksc = _quantize_kv(kp)
+        vp, vsc = _quantize_kv(vp)
+        scales = {"k_scale": ksc, "v_scale": vsc}
+    # full tables, full lengths: the worst-case (and steady-state) shape
+    ptab = (jnp.arange(slots * max_pages, dtype=jnp.int32) + 1) \
+        .reshape(slots, max_pages)
+    lengths = jnp.full((slots,), max_pages * page_len - 1, jnp.int32)
+    q = jax.random.normal(ks[2], (slots, 1, heads, head_dim), jnp.float32)
+    kn = jax.random.normal(ks[3], (slots, heads, head_dim, 1), jnp.float32)
+    vn = jax.random.normal(ks[4], (slots, heads, head_dim, 1), jnp.float32)
+
+    fn = jax.jit(lambda *a: paged_attention(*a, impl="kernel", **scales))
+    tuning.clear_last_dispatch()
+    jax.block_until_ready(fn(q, kp, vp, ptab, lengths, kn, vn))
+    dispatched = tuning.last_dispatch(KERNEL)
+    structure = f"page{page_len}"
+    key = dispatched[structure]["key"]
+    log(f"paged_attention slots{slots} h{heads} d{head_dim} "
+        f"pages{max_pages}x{page_len} {dt.name}"
+        f"{' int8' if kv_int8 else ''}: key {key}")
+
+    best = None
+    for bk, hb in _paged_candidates(heads, page_len, max_pages,
+                                    max_candidates):
+        entry = {"block_k": bk, "head_block": hb}
+        with tuning.tuning_table({key: entry}):
+            jax.clear_caches()   # force a re-trace with the candidate
+            try:
+                ms = _time_it(fn, (q, kp, vp, ptab, lengths, kn, vn),
+                              trials, warmup)
+            except Exception as e:  # infeasible tiling = skip, not fail
+                log(f"  bk={bk} hb={hb}: infeasible ({e})")
+                continue
+        log(f"  bk={bk} hb={hb}: {ms:.3f} ms")
+        if best is None or ms < best[1]["ms"]:
+            best = (key, {**entry, "ms": round(ms, 4)})
+    jax.clear_caches()
+    if best is None:
+        raise RuntimeError("no feasible paged_attention candidate")
+    return {best[0]: best[1]}
+
+
+def _int_list(text):
+    return [int(x) for x in str(text).split(",") if x]
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="ds_tpu_bench kernels",
-        description="flash-attention block-size sweep -> tuning artifact")
+        description="attention block-size sweep -> tuning artifact")
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--heads", type=int, default=16)
-    p.add_argument("--head-dim", type=int, default=128)
+    p.add_argument("--head-dim", type=_int_list, default=[128],
+                   help="head dim, or a comma-separated grid")
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--kv-seq", type=int, default=None,
                    help="key length (default: --seq)")
@@ -141,6 +220,19 @@ def main(argv=None):
     p.add_argument("--warmup", type=int, default=1)
     p.add_argument("--max-candidates", type=int, default=None,
                    help="cap the per-structure candidate grid (CI smoke)")
+    p.add_argument("--kernel", choices=["flash_attention",
+                                        "paged_attention", "all"],
+                   default="flash_attention",
+                   help="which kernel family to sweep; paged_attention "
+                        "sweeps the serving decode kernel over the "
+                        "--slots x --max-pages x --head-dim grid")
+    p.add_argument("--slots", type=_int_list, default=[8],
+                   help="paged sweep: comma-separated slot counts")
+    p.add_argument("--max-pages", type=_int_list, default=[16],
+                   help="paged sweep: comma-separated page-table widths")
+    p.add_argument("--page-len", type=int, default=128)
+    p.add_argument("--kv-int8", action="store_true",
+                   help="paged sweep: time the int8-page dequant path")
     p.add_argument("--out", default="benchmarks/results/flash_tuning.json")
     args = p.parse_args(argv)
 
@@ -148,19 +240,37 @@ def main(argv=None):
     from deepspeed_tpu.ops.pallas import tuning
     from deepspeed_tpu.ops.pallas._common import on_tpu
 
-    entries = sweep_flash_attention(
-        args.batch, args.heads, args.seq, args.kv_seq or args.seq,
-        args.head_dim, dtype=args.dtype, causal=not args.no_causal,
-        trials=args.trials, warmup=args.warmup,
-        max_candidates=args.max_candidates)
+    head_dims = (args.head_dim if isinstance(args.head_dim, list)
+                 else [args.head_dim])
+    entries = {}
+    if args.kernel in ("flash_attention", "all"):
+        for hd in head_dims:
+            entries.update(sweep_flash_attention(
+                args.batch, args.heads, args.seq, args.kv_seq or args.seq,
+                hd, dtype=args.dtype, causal=not args.no_causal,
+                trials=args.trials, warmup=args.warmup,
+                max_candidates=args.max_candidates))
+    if args.kernel in ("paged_attention", "all"):
+        # the serving-shape grid: pages x slots x head-dim (each combo
+        # is its own shape key, so one hardware window tunes them all)
+        for slots in args.slots:
+            for max_pages in args.max_pages:
+                for hd in head_dims:
+                    entries.update(sweep_paged_attention(
+                        slots, args.heads, hd, args.page_len, max_pages,
+                        dtype=args.dtype, kv_int8=args.kv_int8,
+                        trials=args.trials, warmup=args.warmup,
+                        max_candidates=args.max_candidates))
     device = jax.devices()[0].device_kind if on_tpu() else "cpu-interpret"
     tuning.save_artifact(
         args.out, entries, device=device,
-        kind="flash_attention_block_sweep",
+        kind=f"{args.kernel}_block_sweep",
         shape={"batch": args.batch, "heads": args.heads, "seq": args.seq,
                "kv_seq": args.kv_seq or args.seq,
                "head_dim": args.head_dim, "dtype": args.dtype,
-               "causal": not args.no_causal},
+               "causal": not args.no_causal,
+               "slots": args.slots, "max_pages": args.max_pages,
+               "page_len": args.page_len, "kv_int8": args.kv_int8},
         trials=args.trials,
         note=("interpret-mode timings are NOT representative — regenerate "
               "on hardware" if device == "cpu-interpret" else

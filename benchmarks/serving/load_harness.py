@@ -254,18 +254,37 @@ def run_benchmark(args):
         num_pages = None
         if args.hbm_rows is not None:
             # pool budget expressed in full-length-row equivalents: the
-            # density experiment holds HBM fixed while slots scale
+            # density experiment holds the BYTE budget fixed while slots
+            # scale. The budget is always priced at the model's dense
+            # dtype; int8 KV pages cost fewer bytes each (int8 K/V +
+            # fp32 per-head-per-token scale planes), so the same budget
+            # buys proportionally more pages — the second density lever
             cache_len = -(-args.max_len // 128) * 128
-            num_pages = args.hbm_rows * (cache_len // args.page_len) + 1
+            if args.kv_int8:
+                # per-token bytes per layer: K+V at d_model elements each
+                dense_tok = 2 * args.n_layers * args.d_model * 4
+                int8_tok = 2 * args.n_layers * (args.d_model
+                                                + args.n_heads * 4)
+                budget = args.hbm_rows * cache_len * dense_tok
+                num_pages = budget // (int8_tok * args.page_len) + 1
+            else:
+                num_pages = args.hbm_rows * (cache_len // args.page_len) + 1
         paging = PagingConfig(
             page_len=args.page_len, num_pages=num_pages,
             prefill_chunk=args.prefill_chunk,
             max_chunks_per_iter=args.max_chunks_per_iter,
-            enable_prefix_cache=not args.no_prefix_cache)
+            enable_prefix_cache=not args.no_prefix_cache,
+            kernel=args.kernel)
+    quantize = None
+    if args.kv_int8 or args.quantize_weights:
+        from deepspeed_tpu.serving.config import QuantizeConfig
+        quantize = QuantizeConfig(
+            weights="int8" if args.quantize_weights else None,
+            kv="int8" if args.kv_int8 else None)
     qos_scenario = args.scenario in QOS_SCENARIOS
     cfg = ServingConfig(num_slots=args.num_slots, max_len=args.max_len,
                         prefill_bucket=args.prefill_bucket, seed=args.seed,
-                        paging=paging,
+                        paging=paging, quantize=quantize,
                         qos=(_qos_config(args)
                              if (args.qos or qos_scenario) else None))
     engine = ServingEngine(model, params, cfg)
@@ -331,14 +350,21 @@ def run_benchmark(args):
         bytes_per_token = pool_bytes / (mgr.num_pages * mgr.page_len)
         rows_equiv = stats["full_length_rows_equivalent"]
         peak = agg.get("concurrent_requests_peak", 0)
+        # the density denominator: the BYTE budget in dense full-row
+        # equivalents (--hbm-rows when given). int8 pools hold more
+        # TOKENS than the dense budget would (that is the point), so
+        # the token-based rows_equiv overstates the denominator there.
+        budget_rows = args.hbm_rows if args.hbm_rows is not None \
+            else rows_equiv
         paging_block = {
             **stats,
             "pool_bytes": pool_bytes,
             "contiguous_bytes_equivalent": int(
                 bytes_per_token * rows_equiv * cfg.cache_len),
             "concurrent_requests_peak": peak,
-            "density_gain_vs_full_rows": (peak / rows_equiv
-                                          if rows_equiv else None),
+            "hbm_budget_rows": budget_rows,
+            "density_gain_vs_full_rows": (peak / budget_rows
+                                          if budget_rows else None),
             # resident-vs-transient honesty (docs/serving.md): the
             # density claim prices the page pool, but each jitted decode
             # step also gathers a contiguous [num_slots, cache_len] view
@@ -409,6 +435,11 @@ def run_benchmark(args):
                 "prefill_chunk": cfg.paging.chunk_tokens,
                 "max_chunks_per_iter": cfg.paging.max_chunks_per_iter,
                 "enable_prefix_cache": cfg.paging.enable_prefix_cache,
+                "kernel": cfg.paging.kernel,
+            }),
+            "quantize": (None if cfg.quantize is None else {
+                "weights": cfg.quantize.weights,
+                "kv": cfg.quantize.kv,
             }),
             "model": {"vocab_size": args.vocab_size, "d_model": args.d_model,
                       "n_layers": args.n_layers, "n_heads": args.n_heads},
@@ -496,6 +527,20 @@ def build_parser():
                         "rows) — the density experiment holds this fixed "
                         "while num_slots scales")
     p.add_argument("--no-prefix-cache", action="store_true")
+    p.add_argument("--kernel", choices=["auto", "on", "off"],
+                   default="auto",
+                   help="paged decode-attention kernel "
+                        "(serving.paging.kernel): 'on' consumes the page "
+                        "table in place (decode_gather_transient_bytes "
+                        "reads 0), 'off' keeps the PR-6 gather path, "
+                        "'auto' picks per backend")
+    p.add_argument("--kv-int8", action="store_true",
+                   help="int8 KV pages with per-page scales "
+                        "(serving.quantize.kv); with --hbm-rows the same "
+                        "byte budget buys proportionally more pages")
+    p.add_argument("--quantize-weights", action="store_true",
+                   help="int8 weight-only serving "
+                        "(serving.quantize.weights)")
     p.add_argument("--peak-tflops", type=float, default=None,
                    help="chip peak TFLOP/s for the artifact's MFU field "
                         "(defaults to the detected chip's table entry; "

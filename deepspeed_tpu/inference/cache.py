@@ -19,6 +19,11 @@ import jax
 import jax.numpy as jnp
 
 _KV_KEYS = ("cached_key", "cached_value")
+# int8 page pools carry one fp32 scale plane per KV leaf (serving int8
+# KV pages): [num_pages, h, 1, page_len] — one scale per head per token,
+# stored page-shaped so scatters and the paged-attention kernel address
+# scales exactly like pages
+_SCALE_KEYS = {"cached_key": "key_scale", "cached_value": "value_scale"}
 
 
 def _as_dict(tree):
@@ -141,7 +146,53 @@ def cache_page_len(pool) -> int:
     return cache_max_len(pool)
 
 
-def gather_pages(pool, page_table, scalar_index: bool = False):
+def pool_is_quantized(pool) -> bool:
+    """True when the page pool stores int8 KV pages (+ scale planes)."""
+    found = []
+
+    def probe(unit):
+        found.append("key_scale" in unit)
+        return unit
+
+    _map_units(pool, probe)
+    return bool(found) and found[0]
+
+
+def quantize_page_pool(pool):
+    """Convert a freshly initialized (zeroed) page pool to int8 storage:
+    every KV leaf becomes int8 zeros plus an fp32 scale plane of zeros
+    (``[pages, h, 1, page_len]``; ``[L, ...]`` scan-stacked). Page bytes
+    halve vs bf16 (quarter vs fp32) — the density lever on top of
+    paging. Scatters quantize on write; gathers and the paged-attention
+    kernel dequantize on read."""
+
+    def convert(unit):
+        out = dict(unit)
+        for name in _KV_KEYS:
+            kv = unit[name]
+            scale_shape = kv.shape[:-2] + (1,) + kv.shape[-1:]
+            out[name] = jnp.zeros(kv.shape, jnp.int8)
+            out[_SCALE_KEYS[name]] = jnp.zeros(scale_shape, jnp.float32)
+        return out
+
+    return _map_units(pool, convert)
+
+
+def _quantize_kv(leaf):
+    """Symmetric per-token-per-head int8: absmax over the head_dim axis
+    (axis -2 of the K^T layout ``[..., h, d, n]``) -> (int8 leaf, fp32
+    scale ``[..., h, 1, n]``). The shared quantization rule for token
+    and chunk scatters — one definition, or scatter and kernel dequant
+    silently disagree."""
+    x = leaf.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=-2, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def gather_pages(pool, page_table, scalar_index: bool = False,
+                 dequant_dtype=None):
     """Materialize the contiguous per-slot view of a paged pool.
 
     ``page_table`` is ``[slots, max_pages]`` int32 (physical page per
@@ -151,21 +202,34 @@ def gather_pages(pool, page_table, scalar_index: bool = False):
     attention decode path runs unchanged on top of it. ``cache_index``
     comes back zeroed per-row (``[slots]``), or scalar-mode when
     ``scalar_index`` (the single-row chunk-prefill form); callers set the
-    real lengths via ``set_cache_index``."""
+    real lengths via ``set_cache_index``.
+
+    int8 pools dequantize during the gather (``dequant_dtype`` — the
+    model's KV compute dtype; fp32 when unset), so the view the dense
+    attention/prefill paths see is an ordinary float cache."""
     page_table = jnp.asarray(page_table, jnp.int32)
     slots, max_pages = page_table.shape
 
     def gather(unit):
         out = {}
         stacked = unit["cached_key"].ndim == 5
+        quant = "key_scale" in unit
         for name in _KV_KEYS:
             kv = unit[name]
             if stacked:
                 g = kv[:, page_table]              # [L, s, m, h, d, p]
+                if quant:
+                    sc = unit[_SCALE_KEYS[name]][:, page_table]
+                    g = (g.astype(jnp.float32) * sc).astype(
+                        dequant_dtype or jnp.float32)
                 g = g.transpose(0, 1, 3, 4, 2, 5)  # [L, s, h, d, m, p]
                 out[name] = g.reshape(g.shape[:4] + (-1,))
             else:
                 g = kv[page_table]                 # [s, m, h, d, p]
+                if quant:
+                    sc = unit[_SCALE_KEYS[name]][page_table]
+                    g = (g.astype(jnp.float32) * sc).astype(
+                        dequant_dtype or jnp.float32)
                 g = g.transpose(0, 2, 3, 1, 4)     # [s, h, d, m, p]
                 out[name] = g.reshape(g.shape[:3] + (-1,))
         n_layers = unit["cached_key"].shape[0] if stacked else None
@@ -236,9 +300,22 @@ def scatter_token_pages(pool, token_tree, pages, offsets):
 
     def scatter(unit, tok):
         out = dict(unit)
+        quant = "key_scale" in unit
         for name, leaf in (("cached_key", tok["k"]),
                            ("cached_value", tok["v"])):
             kv = unit[name]
+            if quant:
+                # quantize on scatter: the token's K/V arrives in compute
+                # precision (kv_token), lands int8 with its scale plane
+                leaf, sc = _quantize_kv(leaf)
+                sname = _SCALE_KEYS[name]
+                splane = unit[sname]
+                if splane.ndim == 5:
+                    sval = sc[..., 0].transpose(1, 0, 2, 3)  # [s, L, h, 1]
+                    out[sname] = splane.at[:, pages, :, :, offsets].set(sval)
+                else:
+                    out[sname] = splane.at[pages, :, :, offsets].set(
+                        sc[..., 0])
             if kv.ndim == 5:
                 val = leaf[..., 0].transpose(1, 0, 2, 3)   # [s, L, h, d]
                 out[name] = kv.at[:, pages, :, :, offsets].set(val)
@@ -260,22 +337,59 @@ def scatter_chunk_pages(pool, token_tree, page_run):
     def scatter(unit, tok):
         out = dict(unit)
         page_len = unit["cached_key"].shape[-1]
+        quant = "key_scale" in unit
         for name, leaf in (("cached_key", tok["k"]),
                            ("cached_value", tok["v"])):
             kv = unit[name]
-            if kv.ndim == 5:
-                n_l, _, h, d, _ = kv.shape
-                val = leaf[:, 0].reshape(n_l, h, d, n_t, page_len)
-                val = val.transpose(0, 3, 1, 2, 4)         # [L, n_t, h, d, p]
-                out[name] = kv.at[:, page_run].set(val)
-            else:
-                _, h, d, _ = kv.shape
-                val = leaf[0].reshape(h, d, n_t, page_len)
-                val = val.transpose(2, 0, 1, 3)            # [n_t, h, d, p]
-                out[name] = kv.at[page_run].set(val)
+            writes = [(name, kv, leaf)]
+            if quant:
+                leaf, sc = _quantize_kv(leaf)
+                sname = _SCALE_KEYS[name]
+                writes = [(name, kv, leaf), (sname, unit[sname], sc)]
+            for wname, dst, val in writes:
+                d_ = dst.shape[-2]                         # d, or 1 (scale)
+                if dst.ndim == 5:
+                    n_l, _, h, _, _ = dst.shape
+                    v = val[:, 0].reshape(n_l, h, d_, n_t, page_len)
+                    v = v.transpose(0, 3, 1, 2, 4)         # [L, n_t, h, d, p]
+                    out[wname] = dst.at[:, page_run].set(v)
+                else:
+                    _, h, _, _ = dst.shape
+                    v = val[0].reshape(h, d_, n_t, page_len)
+                    v = v.transpose(2, 0, 1, 3)            # [n_t, h, d, p]
+                    out[wname] = dst.at[page_run].set(v)
         return out
 
     return _walk_with(pool, token_tree, scatter)
+
+
+def make_paged_view(pool, page_table, lengths):
+    """The cache tree the KERNEL-path paged decode hands to
+    ``module.apply``: every attention unit keeps its POOL-shaped leaves
+    (int8 + scale planes included) and gains the ``page_table``
+    (``[slots, max_pages]``; broadcast ``[L, ...]`` for scan-stacked
+    units so nn.scan slices a per-layer copy) plus per-row ``lengths``
+    as ``cache_index``. SelfAttention detects the ``page_table``
+    variable structurally and runs the paged-attention kernel straight
+    over the pool — no contiguous view is ever gathered."""
+    page_table = jnp.asarray(page_table, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+
+    def attach(unit):
+        out = dict(unit)
+        stacked = unit["cached_key"].ndim == 5
+        if stacked:
+            n_layers = unit["cached_key"].shape[0]
+            out["page_table"] = jnp.broadcast_to(
+                page_table, (n_layers,) + page_table.shape)
+            out["cache_index"] = jnp.broadcast_to(
+                lengths, (n_layers,) + lengths.shape)
+        else:
+            out["page_table"] = page_table
+            out["cache_index"] = lengths
+        return out
+
+    return _map_units(pool, attach)
 
 
 def write_cache_row(cache, row_cache, row):

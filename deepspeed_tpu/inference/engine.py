@@ -82,38 +82,15 @@ class InferenceEngine:
                 raise ValueError(
                     "quantize_weights=True needs params (pass params= or "
                     "checkpoint=)")
+            # direct-vs-transform consumption decided by the module's
+            # supports_quantized_kernels capability flag — the shared
+            # checkpoint->int8 pipeline step (module_quantize.py,
+            # also the serving engine's serving.quantize.weights path)
             from ..module_inject.module_quantize import (
-                quantize_param_tree, dequantize_param_tree, quantized_nbytes)
-            # Two consumption modes:
-            # - direct (deepspeed_tpu models, whose dense layers are QDense):
-            #   only matmul kernels quantize; the int8 {"q","scale"} nodes
-            #   flow straight into the fused-dequant Pallas matmul. Weights
-            #   stay int8 in HBM for the whole decode loop — XLA cannot
-            #   hoist a dequantized bf16 copy out of the scan (which would
-            #   double weight memory and erase the bandwidth win).
-            # - transform (arbitrary user flax modules): quantize the full
-            #   tree and dequantize per step in front of model.apply.
-            # explicit capability flag (ADVICE r3): a module whose dense
-            # layers are all QDense declares supports_quantized_kernels —
-            # a package-name heuristic would quantize "kernel" leaves of
-            # nn.DenseGeneral-based modules in this namespace into dicts
-            # they cannot consume
-            direct = bool(getattr(type(self.module),
-                                  "supports_quantized_kernels", False))
-            from flax.core import meta as _meta
-            self.params = _meta.unbox(self.params)  # boxed leaves would hide
-            self.params = jax.jit(                  # the "kernel" path names
-                lambda p: quantize_param_tree(
-                    p, min_size=quantize_min_size, dtype=dtype,
-                    only_kernels=direct))(self.params)
-            if direct:
-                self._param_transform = None
-            else:
-                dt = dtype
-
-                def _transform(p, _dt=dt):
-                    return dequantize_param_tree(p, dtype=_dt)
-                self._param_transform = _transform
+                quantize_for_serving, quantized_nbytes)
+            self.params, self._param_transform = quantize_for_serving(
+                self.module, self.params, min_size=quantize_min_size,
+                dtype=dtype)
             nb = quantized_nbytes(self.params)
             log_dist(
                 f"int8 weight-only quantization: "

@@ -330,6 +330,49 @@ class SelfAttention(nn.Module):
                 self.variable("kv_token", "v", lambda: vc).value = vc
             if self.is_initializing():
                 max_len = s
+            elif self.has_variable("cache", "page_table"):
+                # Paged-pool decode (serving/paging kernel path): the
+                # cache variables ARE the page pool ([pages, h, d,
+                # page_len]; int8 + scale planes when KV-quantized) plus
+                # the slot page table — the paged-attention kernel
+                # consumes them in place, so no contiguous per-slot view
+                # is ever gathered (decode_gather_transient ~ 0). The
+                # current token's K/V attends via explicit operands and
+                # is scattered into the pool by the ENGINE after the
+                # step (quantized on scatter), which is why kv_token
+                # publication is mandatory here.
+                if s != 1:
+                    raise NotImplementedError(
+                        "paged-pool decode is single-token (got chunk "
+                        f"length {s}); chunked prefill runs through the "
+                        "gathered-row path")
+                if mask is not None or self.sparsity_config is not None \
+                        or (self.dropout_rate > 0.0 and not deterministic):
+                    raise NotImplementedError(
+                        "paged-pool decode does not support external "
+                        "masks, block-sparse patterns, or live attention "
+                        "dropout")
+                if not self.is_mutable_collection("kv_token"):
+                    raise ValueError(
+                        "paged-pool decode requires 'kv_token' in the "
+                        "mutable collections — the engine scatters this "
+                        "step's K/V into the pool after the step")
+                from ..ops.pallas.paged_attention import paged_attention
+                ptab = self.get_variable("cache", "page_table")
+                idx = cache_index.value          # [slots] pooled tokens
+                k_sc = (self.get_variable("cache", "key_scale")
+                        if self.has_variable("cache", "key_scale")
+                        else None)
+                v_sc = (self.get_variable("cache", "value_scale")
+                        if self.has_variable("cache", "value_scale")
+                        else None)
+                slopes = (alibi_slopes(self.n_heads) if self.alibi
+                          else None)
+                decode_out = paged_attention(
+                    q, cached_key.value, cached_value.value, ptab, idx,
+                    kc, vc, alibi_slopes=slopes, k_scale=k_sc,
+                    v_scale=v_sc)
+                cache_index.value = idx + 1
             else:
                 max_len = cached_key.value.shape[3]
                 idx = cache_index.value

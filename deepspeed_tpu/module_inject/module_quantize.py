@@ -46,7 +46,9 @@ def quantize_param_tree(params, *, min_size: int = 4096,
                         dtype=jnp.bfloat16, only_kernels: bool = False) -> Any:
     """Quantize every floating >=2D leaf with numel >= min_size to int8
     (weight-only). Embeddings/kernels qualify; biases, layernorm scales
-    and small tensors stay in ``dtype``.
+    and small tensors stay in ``dtype`` (``dtype=None`` keeps them in
+    their own dtype — the serving path, where the model's compute dtype
+    is already settled).
 
     ``only_kernels=True`` restricts quantization to leaves NAMED "kernel"
     (the matmul weights QDense consumes directly) — the mode for
@@ -67,10 +69,65 @@ def quantize_param_tree(params, *, min_size: int = 4096,
                 and np.issubdtype(np.dtype(arr.dtype), np.floating)
                 and arr.size >= min_size):
             return _quantize_array(arr, axis=arr.ndim - 1)
-        return arr.astype(dtype) if np.issubdtype(
-            np.dtype(arr.dtype), np.floating) else arr
+        if dtype is not None and np.issubdtype(np.dtype(arr.dtype),
+                                               np.floating):
+            return arr.astype(dtype)
+        return arr
 
     return jax.tree_util.tree_map_with_path(one, params, is_leaf=_is_qleaf)
+
+
+def quantize_for_serving(module, params, *, min_size: int = 4096,
+                         dtype=None):
+    """THE checkpoint->int8 weight-only serving pipeline step, shared by
+    ``init_inference(quantize_weights=True)`` and the serving engine's
+    ``serving.quantize.weights`` block. Returns ``(params,
+    param_transform)``:
+
+    - **direct** mode (modules declaring ``supports_quantized_kernels``
+      — every dense layer is QDense): only matmul KERNELS quantize; the
+      int8 ``{"q","scale"}`` nodes flow straight into the fused-dequant
+      Pallas matmul and ``param_transform`` is None. Weights stay int8
+      in HBM for the whole decode loop — XLA cannot hoist a
+      dequantized bf16 copy out of the scan.
+    - **transform** mode (arbitrary flax modules): the full tree
+      quantizes and ``param_transform`` dequantizes per step in front
+      of ``model.apply`` (fused into the consuming dots).
+
+    Already-quantized trees (any ``{"q","scale"}`` leaf present — e.g.
+    an InferenceEngine that quantized at load handing its params to
+    ``serve()``) pass through untouched with transform None: double
+    quantization would compound the rounding error silently.
+    """
+    from ..models.layers import _is_qleaf
+    if any(_is_qleaf(leaf)
+           for leaf in jax.tree.leaves(params, is_leaf=_is_qleaf)):
+        return params, None
+    from flax.core import meta as _meta
+    params = _meta.unbox(params)    # boxed leaves would hide the
+                                    # "kernel" path names
+    direct = bool(getattr(type(module), "supports_quantized_kernels",
+                          False))
+    if dtype is None:
+        # dtype=None means "keep the model's own compute dtype" — the
+        # transform mode must dequantize back to it, not to a
+        # hardcoded bf16 (an fp32 module would otherwise run mixed
+        # fp32/bf16 matmuls with extra rounding beyond int8)
+        dequant_dtype = next(
+            (jnp.dtype(leaf.dtype) for leaf in jax.tree.leaves(params)
+             if np.issubdtype(np.dtype(leaf.dtype), np.floating)),
+            jnp.dtype(jnp.bfloat16))
+    else:
+        dequant_dtype = jnp.dtype(dtype)
+    params = jax.jit(lambda p: quantize_param_tree(
+        p, min_size=min_size, dtype=dtype, only_kernels=direct))(params)
+    if direct:
+        return params, None
+
+    def _transform(p, _dt=dequant_dtype):
+        return dequantize_param_tree(p, dtype=_dt)
+
+    return params, _transform
 
 
 def dequantize_param_tree(params, dtype=jnp.bfloat16):

@@ -30,3 +30,63 @@ def pick_block(dim: int, want: int) -> int:
     import math
     b = math.gcd(dim, min(want, dim))
     return b if b % 128 == 0 or b == dim else dim
+
+
+def read_slopes(slopes_ref, h0: int, hb: int):
+    """[hb, 1] ALiBi slope column for one head block from a prefetched
+    [H] slope vector (shared by the decode / paged-decode kernels)."""
+    import jax.numpy as jnp
+    return jnp.stack([slopes_ref[h0 + h] for h in range(hb)]).reshape(hb, 1)
+
+
+def online_softmax_block(q, kblk, vblk, start, valid_len, q_pos, slopes,
+                         m_ref, l_ref, acc_ref, *, hb, alibi):
+    """One online-softmax update for an [hb, d, Bk] K^T/V block — THE
+    inner loop shared by the decode-attention and paged-attention
+    kernels (one definition, or the two online-softmax recurrences
+    silently drift).
+
+    q is pre-scaled [hb, d] fp32; ``kblk``/``vblk`` are [hb, d, Bk]
+    refs or arrays (any float dtype — int8 pages dequantize BEFORE this
+    call). Per-head scores are hb small matmuls (MHA has distinct K per
+    head, so there is no single big matmul); the softmax/statistics
+    update is vectorized across the head block.
+
+    ``valid_len`` masks columns (``start + i < valid_len`` attend);
+    ``q_pos`` is the query's absolute position — the ALiBi center
+    (``slope * (col - q_pos)``). The single-token decode kernel attends
+    a cache that already holds the current token, so it passes
+    ``valid_len=length, q_pos=length-1``; the paged kernel attends
+    pool pages EXCLUDING the current token and folds it in separately,
+    so it passes ``valid_len=length, q_pos=length``.
+    """
+    import jax
+    import jax.numpy as jnp
+    rows = []
+    for h in range(hb):
+        kh = kblk[h].astype(jnp.float32)                     # [d, Bk]
+        rows.append(jnp.dot(q[h:h + 1], kh,
+                            preferred_element_type=jnp.float32))  # [1, Bk]
+    s = jnp.concatenate(rows, axis=0)                        # [hb, Bk]
+    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + start
+    if alibi:
+        s = s + slopes * (col - q_pos).astype(jnp.float32)
+    valid = col < valid_len
+    s = jnp.where(valid, s, NEG_INF)
+
+    m_prev = m_ref[...]                                      # [hb, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                                   # [hb, Bk]
+    outs = []
+    for h in range(hb):
+        # columns past the valid prefix may hold padding garbage —
+        # 0-probability x NaN = NaN, so zero the V columns explicitly
+        vh = jnp.where(valid[h:h + 1], vblk[h].astype(jnp.float32), 0.0)
+        outs.append(jax.lax.dot_general(
+            p[h:h + 1], vh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32))             # [1, d]
+    pv = jnp.concatenate(outs, axis=0)                       # [hb, d]
+    l_ref[...] = corr * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = corr * acc_ref[...] + pv
+    m_ref[...] = m_new

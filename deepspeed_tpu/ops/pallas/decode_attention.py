@@ -42,53 +42,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._common import NEG_INF
 from ._common import interpret_mode as _interpret
+from ._common import online_softmax_block as _attend_block
+from ._common import read_slopes as _read_slopes
 
 DEFAULT_BLOCK_K = 512
 DEFAULT_HEAD_BLOCK = 8
-from ._common import NEG_INF
-
-
-def _attend_block(q, kbuf, vbuf, start, length, slopes, m_ref, l_ref,
-                  acc_ref, *, hb, alibi):
-    """One online-softmax update for an [hb, d, Bk] K^T/V^T block.
-
-    q is pre-scaled [hb, d] fp32. Per-head scores are hb small matmuls
-    (MHA has distinct K per head, so there is no single big matmul);
-    the softmax/statistics update is vectorized across the head block.
-    """
-    rows = []
-    for h in range(hb):
-        kh = kbuf[h].astype(jnp.float32)                     # [d, Bk]
-        rows.append(jnp.dot(q[h:h + 1], kh,
-                            preferred_element_type=jnp.float32))  # [1, Bk]
-    s = jnp.concatenate(rows, axis=0)                        # [hb, Bk]
-    col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + start
-    if alibi:
-        s = s + slopes * (col - (length - 1)).astype(jnp.float32)
-    valid = col < length
-    s = jnp.where(valid, s, NEG_INF)
-
-    m_prev = m_ref[...]                                      # [hb, 1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    corr = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)                                   # [hb, Bk]
-    outs = []
-    for h in range(hb):
-        # columns past the valid prefix may hold padding garbage —
-        # 0-probability x NaN = NaN, so zero the V columns explicitly
-        vh = jnp.where(valid[h:h + 1], vbuf[h].astype(jnp.float32), 0.0)
-        outs.append(jax.lax.dot_general(
-            p[h:h + 1], vh, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32))             # [1, d]
-    pv = jnp.concatenate(outs, axis=0)                       # [hb, d]
-    l_ref[...] = corr * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
-    acc_ref[...] = corr * acc_ref[...] + pv
-    m_ref[...] = m_new
-
-
-def _read_slopes(slopes_ref, h0, hb):
-    return jnp.stack([slopes_ref[h0 + h] for h in range(hb)]).reshape(hb, 1)
 
 
 def _dma_kernel(len_ref, slopes_ref, q_ref, k_hbm, v_hbm, o_ref,
@@ -140,8 +100,9 @@ def _dma_kernel(len_ref, slopes_ref, q_ref, k_hbm, v_hbm, o_ref,
                 wv.wait()
                 q = q_ref[0].astype(jnp.float32) * scale
                 kb, vb = bufs[parity]
-                _attend_block(q, kb, vb, j * block_k, length, slopes,
-                              m_ref, l_ref, acc_ref, hb=hb, alibi=alibi)
+                _attend_block(q, kb, vb, j * block_k, length, length - 1,
+                              slopes, m_ref, l_ref, acc_ref, hb=hb,
+                              alibi=alibi)
         return carry
 
     jax.lax.fori_loop(0, nb, body, 0)

@@ -1,0 +1,333 @@
+"""Pallas paged decode-attention kernel (page-table-direct KV attention).
+
+The serving engine's paged KV cache (serving/paging/) stores every
+slot's K/V as fixed-size pages in a global pool
+``[num_pages, h, d, page_len]`` (K^T layout) addressed by a dense
+``[num_slots, max_pages]`` int32 page table. Before this kernel, the
+jitted decode step *gathered* each slot's pages into the classic
+contiguous ``[slots, h, d, max_pages * page_len]`` view and ran the
+contiguous decode kernel over it — correct, but the gathered view is
+XLA-managed scratch scaling with ``slots x max_len``
+(``decode_gather_transient_bytes``), which silently caps the paged
+density win at high slot counts.
+
+This kernel consumes the page table DIRECTLY: grid ``(slot,
+head_block)``; each grid step walks the slot's valid pages with
+double-buffered ``make_async_copy`` DMAs — the physical page index
+comes from the scalar-prefetched page table, so pages stream
+HBM->VMEM *in place*, one page (or a tuned multi-page block) at a
+time. Flash-style online softmax (the ``_common.online_softmax_block``
+inner loop shared with ``decode_attention``) accumulates partial
+attention per page block; no contiguous per-slot view ever
+materializes (transient ~ 0, and DMA traffic scales with the VALID
+length, not the allocated table width).
+
+The current decode step's K/V is NOT in the pool yet (the engine
+scatters it after the step, quantized when the pool is int8): it
+arrives as separate full-precision ``k_new``/``v_new`` operands and is
+folded into the softmax as a final single-column update — bias 0 under
+ALiBi (distance 0), always valid, so every row's normalizer is > 0.
+
+int8 KV pages: when ``k_scale``/``v_scale`` page pools are given
+(``[num_pages, h, 1, page_len]`` fp32 — one scale per head per token,
+stored page-shaped; inference/cache.py quantizes on scatter), the page
+DMAs move int8 bytes (HALF the bandwidth of bf16 — decode attention is
+cache-bandwidth-bound) plus the small scale planes, and dequantization
+happens in VMEM inside the page loop, right before the matmul.
+
+Block sizes resolve through the shape-keyed tuning cache
+(``ops/pallas/tuning.py``; ``bin/ds_tpu_bench kernels --kernel
+paged_attention`` sweeps them): key
+``paged_attention/page<page_len>/sq<slots>_sk<table_tokens>_d<d>_...``,
+entries carry ``block_k`` (tokens per DMA block — a page_len multiple;
+pages_per_block = block_k / page_len) and ``head_block``.
+
+Caches whose ``page_len`` is not a 128 multiple cannot tile on real
+TPU (Mosaic minor-dim alignment) and take a fused-dense jnp fallback
+with IDENTICAL semantics; serving defaults page_len to 128 so hardware
+always hits the kernel. Inference-only (no custom_vjp).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import tuning
+from ._common import NEG_INF
+from ._common import interpret_mode as _interpret
+from ._common import online_softmax_block as _attend_block
+from ._common import read_slopes as _read_slopes
+
+DEFAULT_BLOCK_TOKENS = 512
+DEFAULT_HEAD_BLOCK = 8
+
+KERNEL = "paged_attention"
+
+
+def _fold_current_token(q, kn, vn, m_ref, l_ref, acc_ref):
+    """Final online-softmax update for the current token's K/V — one
+    always-valid column at the query's own position (ALiBi bias 0), so
+    ``l`` ends >= exp(0) > 0 for every row including empty slots."""
+    s = jnp.sum(q * kn, axis=-1, keepdims=True)              # [hb, 1]
+    m_prev = m_ref[...]
+    m_new = jnp.maximum(m_prev, s)
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                                   # [hb, 1]
+    l_ref[...] = corr * l_ref[...] + p
+    acc_ref[...] = corr * acc_ref[...] + p * vn
+    m_ref[...] = m_new
+
+
+def _dma_kernel(len_ref, ptab_ref, slopes_ref, q_ref, kn_ref, vn_ref,
+                *refs, scale, page_len, ppb, hb, alibi, quant, max_pages):
+    if quant:
+        (kp_hbm, vp_hbm, ksp_hbm, vsp_hbm, o_ref,
+         kbuf0, vbuf0, kbuf1, vbuf1, ksb0, vsb0, ksb1, vsb1,
+         sem, m_ref, l_ref, acc_ref) = refs
+        bufs = ((kbuf0, vbuf0, ksb0, vsb0), (kbuf1, vbuf1, ksb1, vsb1))
+    else:
+        (kp_hbm, vp_hbm, o_ref, kbuf0, vbuf0, kbuf1, vbuf1,
+         sem, m_ref, l_ref, acc_ref) = refs
+        bufs = ((kbuf0, vbuf0), (kbuf1, vbuf1))
+    b, hi = pl.program_id(0), pl.program_id(1)
+    length = len_ref[b]
+    bt = ppb * page_len
+    nb = pl.cdiv(length, bt)
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    slopes = _read_slopes(slopes_ref, hi * hb, hb) if alibi else None
+
+    def copies(j, slot):
+        """The slot's page DMAs for block ``j``: ``ppb`` physical pages
+        looked up in the prefetched table. Logical indices past the
+        table (a ragged last block) clamp to the last entry — always a
+        VALID physical page (unowned entries hold the null page), whose
+        columns the ``col < length`` mask discards."""
+        descs = []
+        for i in range(ppb):
+            logical = jnp.minimum(j * ppb + i, max_pages - 1)
+            phys = ptab_ref[b, logical]
+            dst = pl.ds(i * page_len, page_len)
+            pairs = [(kp_hbm, bufs[slot][0], 0), (vp_hbm, bufs[slot][1], 1)]
+            if quant:
+                pairs += [(ksp_hbm, bufs[slot][2], 2),
+                          (vsp_hbm, bufs[slot][3], 3)]
+            for src, buf, ch in pairs:
+                descs.append(pltpu.make_async_copy(
+                    src.at[phys, hi], buf.at[:, :, dst], sem.at[slot, ch, i]))
+        return descs
+
+    # the prologue must not start copies a zero-block row never waits:
+    # leaked semaphore signals would satisfy the NEXT grid step's wait()
+    # while its own DMA is still in flight (real-TPU hazard; interpret
+    # mode doesn't model semaphores)
+    @pl.when(nb > 0)
+    def _first_copies():
+        for c in copies(0, 0):
+            c.start()
+
+    def body(j, carry):
+        slot = jax.lax.rem(j, 2)
+
+        for parity in (0, 1):
+            @pl.when((slot == parity) & (j + 1 < nb))
+            def _prefetch():
+                for c in copies(j + 1, 1 - parity):
+                    c.start()
+
+        for parity in (0, 1):
+            @pl.when(slot == parity)
+            def _compute():
+                for c in copies(j, parity):
+                    c.wait()
+                q = q_ref[0].astype(jnp.float32) * scale
+                if quant:
+                    kb, vb, ksb, vsb = bufs[parity]
+                    kblk = kb[...].astype(jnp.float32) * ksb[...]
+                    vblk = vb[...].astype(jnp.float32) * vsb[...]
+                else:
+                    kblk, vblk = bufs[parity]
+                # pool pages EXCLUDE the current token: valid cols <
+                # length, query position = length (folded in below)
+                _attend_block(q, kblk, vblk, j * bt, length, length,
+                              slopes, m_ref, l_ref, acc_ref, hb=hb,
+                              alibi=alibi)
+        return carry
+
+    jax.lax.fori_loop(0, nb, body, 0)
+    q = q_ref[0].astype(jnp.float32) * scale
+    _fold_current_token(q, kn_ref[0].astype(jnp.float32),
+                        vn_ref[0].astype(jnp.float32), m_ref, l_ref, acc_ref)
+    o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def _paged_dma(q_bhd, kp, vp, ptab, lengths, kn, vn, ks, vs, *, scale,
+               page_len, ppb, hb, alibi, slopes):
+    b, heads, d = q_bhd.shape
+    num_pages = kp.shape[0]
+    max_pages = ptab.shape[1]
+    nhb = heads // hb
+    quant = ks is not None
+    kpr = kp.reshape(num_pages, nhb, hb, d, page_len)
+    vpr = vp.reshape(num_pages, nhb, hb, d, page_len)
+    pools = [kpr, vpr]
+    if quant:
+        pools += [ks.reshape(num_pages, nhb, hb, 1, page_len),
+                  vs.reshape(num_pages, nhb, hb, 1, page_len)]
+    bt = ppb * page_len
+    kv_buf = lambda: pltpu.VMEM((hb, d, bt), kp.dtype)
+    scratch = [kv_buf(), kv_buf(), kv_buf(), kv_buf()]
+    if quant:
+        sc_buf = lambda: pltpu.VMEM((hb, 1, bt), jnp.float32)
+        scratch += [sc_buf(), sc_buf(), sc_buf(), sc_buf()]
+    scratch += [
+        pltpu.SemaphoreType.DMA((2, 4 if quant else 2, ppb)),
+        pltpu.VMEM((hb, 1), jnp.float32),
+        pltpu.VMEM((hb, 1), jnp.float32),
+        pltpu.VMEM((hb, d), jnp.float32),
+    ]
+    tok_spec = lambda: pl.BlockSpec((1, hb, d), lambda bi, hi, *_: (bi, hi, 0))
+    return pl.pallas_call(
+        functools.partial(_dma_kernel, scale=scale, page_len=page_len,
+                          ppb=ppb, hb=hb, alibi=alibi, quant=quant,
+                          max_pages=max_pages),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, nhb),
+            in_specs=[tok_spec(), tok_spec(), tok_spec()]
+            + [pl.BlockSpec(memory_space=pl.ANY)] * len(pools),
+            out_specs=tok_spec(),
+            scratch_shapes=scratch,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, heads, d), q_bhd.dtype),
+        # jax renamed TPUCompilerParams -> CompilerParams around 0.5;
+        # support both so the kernel runs on the pinned CI jax too
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=_interpret(),
+    )(lengths, ptab, slopes, q_bhd, kn, vn, *pools)
+
+
+def _paged_dense(q_bhd, kp, vp, ptab, lengths, kn, vn, ks, vs, *, scale,
+                 alibi, slopes):
+    """jnp fallback with IDENTICAL semantics for pools the kernel cannot
+    tile (page_len not a 128 multiple on real TPU) — and the reference
+    the kernel parity suite checks against. Gathers the table's pages
+    (XLA scratch — exactly what the kernel path eliminates), attends
+    cols < length plus the current token as one extra column."""
+    b, heads, d = q_bhd.shape
+    page_len = kp.shape[3]
+    gk = kp[ptab]                                  # [B, M, H, d, p]
+    gv = vp[ptab]
+    if ks is not None:
+        gk = gk.astype(jnp.float32) * ks[ptab]
+        gv = gv.astype(jnp.float32) * vs[ptab]
+    m = ptab.shape[1]
+    s_tot = m * page_len
+    k_all = gk.transpose(0, 2, 3, 1, 4).reshape(b, heads, d, s_tot)
+    v_all = gv.transpose(0, 2, 3, 1, 4).reshape(b, heads, d, s_tot)
+
+    qf = q_bhd.astype(jnp.float32) * scale
+    logits = jnp.einsum("bhd,bhdk->bhk", qf, k_all.astype(jnp.float32))
+    col = jnp.arange(s_tot)[None, None, :]
+    ln = lengths[:, None, None]
+    if alibi:
+        logits = logits + slopes[None, :, None] * (col - ln)
+    logits = jnp.where(col < ln, logits, NEG_INF)
+    s_cur = jnp.einsum("bhd,bhd->bh", qf,
+                       kn.astype(jnp.float32))[..., None]    # [B, H, 1]
+    probs = jax.nn.softmax(jnp.concatenate([logits, s_cur], axis=-1),
+                           axis=-1)
+    # unowned/null-page columns may hold garbage (NaN poison in tests):
+    # 0-probability x NaN = NaN, so zero masked V columns explicitly
+    v_hist = jnp.where(col[:, :, None, :] < ln[:, :, None, :],
+                       v_all.astype(jnp.float32), 0.0)
+    out = jnp.einsum("bhk,bhdk->bhd", probs[..., :s_tot], v_hist)
+    out = out + probs[..., s_tot:] * vn.astype(jnp.float32)
+    return out.astype(q_bhd.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, lengths, k_new, v_new,
+                    *, softmax_scale=None, alibi_slopes=None, k_scale=None,
+                    v_scale=None, block_tokens=None, head_block=None,
+                    impl=None):
+    """Single-token attention straight over a paged KV pool.
+
+    q: [B, 1, H, d] (or [B, H, d]) — the current token's queries.
+    k_pages, v_pages: [num_pages, H, d, page_len] page pool (K^T
+        layout); int8 when ``k_scale``/``v_scale`` are given.
+    page_table: [B, max_pages] int32 — physical page per logical page;
+        unowned entries hold the null page (always safe to read).
+    lengths: [B] int32 — tokens already IN the pool per row (the
+        current token is NOT among them; it attends via ``k_new``).
+    k_new, v_new: [B, H, d, 1] (or [B, H, d]) — the current token's
+        K/V in compute precision (quantized on scatter AFTER the step).
+    k_scale, v_scale: optional [num_pages, H, 1, page_len] fp32 per-
+        token-per-head scale planes of an int8 pool.
+    impl: None (auto), "kernel", or "dense" — parity/testing override.
+
+    Returns [B, 1, H, d] (or [B, H, d], matching q's rank): softmax
+    attention over the row's ``lengths`` pool tokens plus the current
+    token (``lengths + 1`` total; a row with length 0 attends only
+    itself — never NaN).
+    """
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    b, one, heads, d = q.shape
+    if one != 1:
+        raise ValueError(f"paged_attention is single-token (q_len 1), "
+                         f"got {one}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    page_len = k_pages.shape[3]
+    max_pages = page_table.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(d)
+
+    lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (b,))
+    page_table = jnp.asarray(page_table, jnp.int32)
+    kn = k_new.reshape(b, heads, d)
+    vn = v_new.reshape(b, heads, d)
+    alibi = alibi_slopes is not None
+    slopes = (jnp.asarray(alibi_slopes, jnp.float32) if alibi
+              else jnp.zeros((heads,), jnp.float32))
+    q_bhd = jnp.swapaxes(q, 1, 2)[:, :, 0, :]                # [B, H, d]
+
+    # block resolution through the shape-keyed tuning cache: block_k is
+    # tokens per DMA block (a page_len multiple), head_block the grid's
+    # head tile — constants only on a full miss
+    structure = f"page{page_len}"
+    entry, key, source = tuning.lookup(
+        KERNEL, structure, sq=b, sk=max_pages * page_len, d=d,
+        dtype=k_pages.dtype, causal=True)
+    bt = int(entry.get("block_k") or block_tokens or DEFAULT_BLOCK_TOKENS)
+    hb = math.gcd(heads, int(entry.get("head_block") or head_block
+                             or DEFAULT_HEAD_BLOCK))
+    ppb = max(1, min(bt // page_len, max_pages))
+
+    kernel_ok = page_len % 128 == 0 or _interpret()
+    use_kernel = kernel_ok if impl is None else impl == "kernel"
+    if impl == "kernel" and not kernel_ok:
+        raise ValueError(
+            f"paged_attention kernel needs page_len % 128 == 0 on TPU "
+            f"(got {page_len}); use page_len=128 or impl='dense'")
+    tuning.record_dispatch(
+        KERNEL, structure, key, source, block_k=ppb * page_len,
+        head_block=hb, impl="kernel" if use_kernel else "dense")
+    if use_kernel:
+        out = _paged_dma(q_bhd, k_pages, v_pages, page_table, lengths, kn,
+                         vn, k_scale, v_scale, scale=scale,
+                         page_len=page_len, ppb=ppb, hb=hb, alibi=alibi,
+                         slopes=slopes)
+    else:
+        out = _paged_dense(q_bhd, k_pages, v_pages, page_table, lengths,
+                           kn, vn, k_scale, v_scale, scale=scale,
+                           alibi=alibi, slopes=slopes)
+    out = out[:, None]                                       # [B, 1, H, d]
+    return out[:, 0].reshape(b, heads, d) if squeeze else out

@@ -5,12 +5,12 @@ jax — ``runtime/config.py`` pulls ``ServingConfig`` into the top-level
 config schema, and that path must work in dependency-free tooling jobs.
 """
 
-from .config import ServingConfig
+from .config import QuantizeConfig, ServingConfig
 from .paging.config import PagingConfig
 from .qos import QosClass, QosConfig, QosController
 
-__all__ = ["ServingConfig", "PagingConfig", "QosClass", "QosConfig",
-           "QosController", "ServingEngine", "Request",
+__all__ = ["ServingConfig", "PagingConfig", "QuantizeConfig", "QosClass",
+           "QosConfig", "QosController", "ServingEngine", "Request",
            "FifoScheduler", "ServingMetrics", "PagedKVManager"]
 
 _LAZY = {

@@ -13,6 +13,51 @@ from .qos import QosConfig
 
 
 @dataclass
+class QuantizeConfig:
+    """Serving quantization knobs (the ``serving.quantize`` sub-block).
+
+    ``weights``: "int8" stores every big matmul weight int8 with
+    per-output-channel scales at engine build (module_inject/
+    module_quantize.py) — the decode matmuls consume them through the
+    fused-dequant Pallas kernel, so HBM holds and streams HALF the
+    weight bytes (reference analog: the *_int8 inference gemms).
+
+    ``kv``: "int8" stores the paged KV pool int8 with per-page scale
+    planes (quantize on scatter, dequantize inside the paged-attention
+    kernel's page loop / on gather) — halving page bytes doubles pool
+    density again on top of paging. Requires the ``paging`` block.
+
+    Parity ladder (docs/serving.md): weights-only int8 is token-exact
+    vs a generate() reference over the SAME int8 params under greedy
+    sampling; int8 KV rides the bounded-error rung (logit max-abs-err
+    + downstream-token agreement, asserted in
+    tests/unit/test_quantized_serving.py).
+    """
+    weights: Optional[str] = None    # None | "int8"
+    kv: Optional[str] = None         # None | "int8" (paged engines only)
+    min_size: int = 4096             # smallest weight (elements) to
+                                     # quantize; everything below stays
+                                     # in its own dtype
+
+    def validate(self, paged: bool):
+        for field_name, val in (("weights", self.weights), ("kv", self.kv)):
+            if val not in (None, "int8"):
+                raise ValueError(
+                    f"serving.quantize.{field_name} must be null or "
+                    f"'int8', got {val!r}")
+        if self.kv is not None and not paged:
+            raise ValueError(
+                "serving.quantize.kv requires the block-paged KV cache "
+                "(serving.paging) — per-page scales live in the page "
+                "pool")
+        if self.min_size < 1:
+            raise ValueError(
+                f"serving.quantize.min_size must be >= 1, got "
+                f"{self.min_size}")
+        return self
+
+
+@dataclass
 class ServingConfig:
     """Continuous-batching serving knobs (reference analog: the
     init_inference kwargs + DeepSpeed-MII deployment config).
@@ -52,6 +97,10 @@ class ServingConfig:
                                      # (serving/qos.py, docs/serving.md):
                                      # absent or enabled=False keeps the
                                      # pre-QoS FIFO engine untouched
+    quantize: Optional[QuantizeConfig] = None
+                                     # int8 weight-only serving + int8 KV
+                                     # pages (docs/serving.md "Quantized
+                                     # serving"); absent = full-precision
 
     def __post_init__(self):
         # nested-block plumbing: runtime/config.py's dict_to_dataclass is
@@ -60,6 +109,8 @@ class ServingConfig:
             self.paging = PagingConfig(**self.paging)
         if isinstance(self.qos, dict):
             self.qos = QosConfig(**self.qos)
+        if isinstance(self.quantize, dict):
+            self.quantize = QuantizeConfig(**self.quantize)
 
     def validate(self):
         if self.num_slots < 1:
@@ -91,12 +142,24 @@ class ServingConfig:
             self.paging.validate(self.cache_len)
         if self.qos is not None:
             self.qos.validate()
+        if self.quantize is not None:
+            self.quantize.validate(self.paged)
         return self
 
     @property
     def paged(self) -> bool:
         """True when the block-paged KV cache is configured AND enabled."""
         return self.paging is not None and self.paging.enabled
+
+    @property
+    def weights_int8(self) -> bool:
+        """True when serving should int8-quantize weights at build."""
+        return self.quantize is not None and self.quantize.weights == "int8"
+
+    @property
+    def kv_int8(self) -> bool:
+        """True when the paged KV pool stores int8 pages."""
+        return self.quantize is not None and self.quantize.kv == "int8"
 
     @property
     def qos_enabled(self) -> bool:

@@ -42,6 +42,15 @@ class PagingConfig:
     max_chunks_per_iter: int = 1     # prefill chunks run between two
                                      # decode dispatches (1 = decode never
                                      # stalls more than one chunk)
+    kernel: str = "auto"             # paged decode-attention kernel
+                                     # (ops/pallas/paged_attention.py):
+                                     # "auto" = on real TPU with a
+                                     # 128-aligned page_len (the gather
+                                     # fallback elsewhere — CPU runs stay
+                                     # bit-identical to the pre-kernel
+                                     # engine), "on" = force (tests/
+                                     # interpret mode), "off" = always
+                                     # gather the contiguous view
 
     def validate(self, cache_len: int):
         """Validate against the owning ServingConfig's slot capacity."""
@@ -63,6 +72,10 @@ class PagingConfig:
             raise ValueError(
                 "serving.paging.max_chunks_per_iter must be >= 1, got "
                 f"{self.max_chunks_per_iter}")
+        if self.kernel not in ("auto", "on", "off"):
+            raise ValueError(
+                f"serving.paging.kernel must be 'auto', 'on', or 'off', "
+                f"got {self.kernel!r}")
         max_pages = cache_len // self.page_len
         if self.num_pages is not None and self.num_pages < max_pages + 1:
             raise ValueError(

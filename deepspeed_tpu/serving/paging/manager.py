@@ -33,9 +33,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...inference.cache import (cache_max_len, cache_page_len,
-                                extract_token_kv, gather_pages,
-                                init_page_pool, scatter_chunk_pages,
+from ...inference.cache import (cache_page_len, extract_token_kv,
+                                gather_pages, init_page_pool,
+                                make_paged_view, pool_is_quantized,
+                                quantize_page_pool, scatter_chunk_pages,
                                 scatter_token_pages, set_cache_index)
 from ...inference.generation import _sample_impl
 from ...observability.programs import track_program
@@ -81,30 +82,52 @@ def _chunk_tree_from_cache(cache, start, chunk):
 
 def _paged_decode_iter_impl(module, params, pool, page_table, state, rng, it,
                             eos_id, t, k, p, param_transform, greedy, has_k,
-                            has_p):
+                            has_p, use_kernel=False, dequant_dtype=None):
     """One masked decode step over the full slot batch, paged twin of
-    engine._decode_iter_impl: gather pages -> contiguous view -> the
-    unchanged attention path -> scatter the new token's K/V back to each
-    active slot's tail page. Inactive slots write the null page."""
+    engine._decode_iter_impl.
+
+    ``use_kernel`` (static — one compiled program per engine either
+    way): the paged-attention kernel consumes the pool + page table IN
+    PLACE via ``make_paged_view`` — no contiguous per-slot view is ever
+    gathered (``decode_gather_transient`` ~ 0). Off-kernel, the PR-6
+    gather path runs unchanged: gather pages -> contiguous view (int8
+    pools dequantize to ``dequant_dtype`` during the gather) -> the
+    unchanged attention path. Both scatter the new token's K/V back to
+    each active slot's tail page (quantized on scatter for int8
+    pools); inactive slots write the null page."""
     lengths = state["lengths"]
     active = state["active"]
-    cache = gather_pages(pool, page_table)
-    s_max = cache_max_len(cache)
+    page_len = cache_page_len(pool)
+    s_max = page_len * page_table.shape[1]
     idx_w = jnp.minimum(lengths, s_max - 1)
-    cache = set_cache_index(cache, idx_w)
     p_ = param_transform(params) if param_transform is not None else params
-    logits, vars_out = module.apply(
-        {"params": p_, "cache": cache}, state["last_token"][:, None],
-        decode=True, positions=idx_w[:, None],
-        mutable=["cache", "kv_token"])
+    if use_kernel:
+        view = make_paged_view(pool, page_table, idx_w)
+        logits, vars_out = module.apply(
+            {"params": p_, "cache": view}, state["last_token"][:, None],
+            decode=True, positions=idx_w[:, None],
+            mutable=["cache", "kv_token"])
+        tok = vars_out.get("kv_token")
+        if tok is None or len(jax.tree.leaves(tok)) == 0:
+            raise ValueError(
+                "paged-attention kernel decode requires the module to "
+                "publish the 'kv_token' collection (models/layers.py "
+                "SelfAttention does) — there is no contiguous view to "
+                "re-slice the token's K/V from")
+    else:
+        cache = gather_pages(pool, page_table, dequant_dtype=dequant_dtype)
+        cache = set_cache_index(cache, idx_w)
+        logits, vars_out = module.apply(
+            {"params": p_, "cache": cache}, state["last_token"][:, None],
+            decode=True, positions=idx_w[:, None],
+            mutable=["cache", "kv_token"])
+        tok = _token_tree(vars_out, vars_out["cache"], idx_w)
     nxt = _sample_impl(logits[:, -1, :], jax.random.fold_in(rng, it),
                        t, k, p, greedy, has_k, has_p)
 
-    page_len = cache_page_len(pool)
     page_idx = idx_w // page_len
     phys = jnp.take_along_axis(page_table, page_idx[:, None], axis=1)[:, 0]
     phys = jnp.where(active, phys, NULL_PAGE)
-    tok = _token_tree(vars_out, vars_out["cache"], idx_w)
     pool = scatter_token_pages(pool, tok, phys, idx_w % page_len)
 
     remaining = jnp.where(active, state["remaining"] - 1, state["remaining"])
@@ -121,14 +144,15 @@ def _paged_decode_iter_impl(module, params, pool, page_table, state, rng, it,
 
 _paged_decode_jit = track_program(
     "serving/paged_decode",
-    jax.jit(_paged_decode_iter_impl, static_argnums=(0, 11, 12, 13, 14),
+    jax.jit(_paged_decode_iter_impl,
+            static_argnums=(0, 11, 12, 13, 14, 15, 16),
             donate_argnums=(2, 4)), subsystem="serving")
 
 
 def _chunk_prefill_impl(module, params, pool, state, ptab_row, chunk_ids,
                         chunk_start, end_pos, slot, max_new, is_last, rng,
                         eos_id, t, k, p, param_transform, greedy, has_k,
-                        has_p):
+                        has_p, dequant_dtype=None):
     """Prefill one page-aligned chunk of one request through its slot's
     gathered row view and scatter the chunk's K/V into its pages.
 
@@ -140,7 +164,8 @@ def _chunk_prefill_impl(module, params, pool, state, ptab_row, chunk_ids,
     token is sampled every call but only published when ``is_last`` —
     one compiled program per chunk bucket, mid/last selected by a traced
     flag, not a specialization."""
-    row = gather_pages(pool, ptab_row[None], scalar_index=True)
+    row = gather_pages(pool, ptab_row[None], scalar_index=True,
+                       dequant_dtype=dequant_dtype)
     row = set_cache_index(row, chunk_start)
     positions = chunk_start + jnp.arange(chunk_ids.shape[1])
     p_ = param_transform(params) if param_transform is not None else params
@@ -183,7 +208,7 @@ def _chunk_prefill_impl(module, params, pool, state, ptab_row, chunk_ids,
 
 _chunk_prefill_jit = track_program(
     "serving/chunk_prefill",
-    jax.jit(_chunk_prefill_impl, static_argnums=(0, 16, 17, 18, 19),
+    jax.jit(_chunk_prefill_impl, static_argnums=(0, 16, 17, 18, 19, 20),
             donate_argnums=(2, 3)), subsystem="serving")
 
 
@@ -204,8 +229,9 @@ class PagedKVManager:
         self._module = module          # kept for reset() (fault recovery)
         self._params = params
         self._num_slots = config.num_slots
-        self.pool = init_page_pool(module, params, self.num_pages,
-                                   self.page_len)
+        self.kv_quant = "int8" if config.kv_int8 else None
+        self.use_kernel = self._resolve_kernel(pcfg.kernel)
+        self.pool = self._build_pool()
         self.allocator = PageAllocator(self.num_pages)
         self.prefix = (PrefixCache(self.page_len, self.allocator)
                        if pcfg.enable_prefix_cache else None)
@@ -219,7 +245,48 @@ class PagedKVManager:
             f"(= {(self.num_pages - 1) * self.page_len // self.cache_len} "
             f"full-length rows), prefill chunk {self.chunk_tokens}, "
             f"prefix cache "
-            f"{'on' if self.prefix is not None else 'off'}", ranks=[0])
+            f"{'on' if self.prefix is not None else 'off'}, decode "
+            f"{'paged-attention kernel' if self.use_kernel else 'gather'}"
+            f"{', int8 KV pages' if self.kv_quant else ''}", ranks=[0])
+
+    def _resolve_kernel(self, mode: str) -> bool:
+        """Resolve the ``serving.paging.kernel`` knob: "on" forces the
+        paged-attention kernel (interpret mode runs it anywhere; real
+        TPU needs a 128-aligned page_len — refused loudly, never a
+        silent gather), "off" forces the PR-6 gather path (bitwise
+        identical to the pre-kernel engine), "auto" turns the kernel on
+        exactly where it is the proven win: real TPU with an aligned
+        page_len. CPU runs stay on the gather path by default so
+        replay/bit-reproducibility contracts hold."""
+        from ...ops.pallas._common import interpret_mode
+        aligned = self.page_len % 128 == 0
+        if mode == "on":
+            if not (aligned or interpret_mode()):
+                raise ValueError(
+                    f"serving.paging.kernel='on' needs page_len % 128 == "
+                    f"0 on TPU (got {self.page_len})")
+            return True
+        if mode == "off":
+            return False
+        return aligned and not interpret_mode()
+
+    def _build_pool(self):
+        """Fresh zeroed pool; ``dequant_dtype`` records the model's KV
+        compute dtype BEFORE int8 conversion — gathers dequantize back
+        to it, so the gathered view always matches what the attention
+        path writes into it."""
+        pool = init_page_pool(self._module, self._params, self.num_pages,
+                              self.page_len)
+        self.dequant_dtype = next(
+            leaf.dtype for leaf in jax.tree.leaves(pool)
+            if getattr(leaf, "ndim", 0) >= 4)
+        if self.kv_quant:
+            pool = quantize_page_pool(pool)
+        # the scatter/gather/kernel paths all key off the scale planes
+        # structurally — assert the built pool agrees with the config
+        # so a layout drift fails HERE, not as silent fp math
+        assert pool_is_quantized(pool) == bool(self.kv_quant)
+        return pool
 
     # -- admission ---------------------------------------------------------
     def pages_for(self, prompt_len: int, max_new: int) -> int:
@@ -292,8 +359,7 @@ class PagedKVManager:
         invalid, and after a requeue-and-re-prefill recovery every page's
         contents are stale anyway. Shapes are unchanged, so the compiled
         paged programs stay cached."""
-        self.pool = init_page_pool(self._module, self._params,
-                                   self.num_pages, self.page_len)
+        self.pool = self._build_pool()
         self.allocator = PageAllocator(self.num_pages)
         self.prefix = (PrefixCache(self.page_len, self.allocator)
                        if self.config.enable_prefix_cache else None)
@@ -313,14 +379,24 @@ class PagedKVManager:
         each jitted decode step gathers as XLA-managed scratch — derived
         from the pool's own leaf shapes (the figure the PR-6 bench
         artifact hand-computed; resident-vs-transient honesty in
-        docs/serving.md). Per attention unit: one page's bytes times
+        docs/serving.md). On the paged-attention KERNEL path this is 0:
+        pages stream HBM->VMEM in place and no per-slot view ever
+        materializes. On the gather path, per attention unit: one
+        page's K/V elements (at the DEQUANT dtype — an int8 pool still
+        gathers a full-precision view, so quantization does NOT shrink
+        this figure, only the kernel eliminates it) times
         ``num_slots * max_pages``."""
+        if self.use_kernel:
+            return 0
+        from jax.tree_util import tree_flatten_with_path
         num_slots = int(self.page_table.shape[0])
+        itemsize = jnp.dtype(self.dequant_dtype).itemsize
         total = 0
-        for leaf in jax.tree.leaves(self.pool):
-            if getattr(leaf, "ndim", 0) >= 4:
+        for path, leaf in tree_flatten_with_path(self.pool)[0]:
+            name = getattr(path[-1], "key", None)
+            if name in ("cached_key", "cached_value"):
                 pages_dim = int(leaf.shape[leaf.ndim - 4])
-                per_page = int(leaf.size) // pages_dim * leaf.dtype.itemsize
+                per_page = int(leaf.size) // pages_dim * itemsize
                 total += per_page * num_slots * self.max_pages
         return total
 
@@ -334,6 +410,8 @@ class PagedKVManager:
             "pool_tokens": usable * self.page_len,
             "full_length_rows_equivalent":
                 usable * self.page_len // self.cache_len,
+            "kernel": self.use_kernel,
+            "kv_quant": self.kv_quant,
         }
         if self.prefix is not None:
             out.update(self.prefix.stats())
