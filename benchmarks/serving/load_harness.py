@@ -28,6 +28,7 @@ from collections import deque
 import numpy as np
 
 QOS_SCENARIOS = ("diurnal", "burst", "adversarial-long-prompt")
+FLEET_SCENARIOS = ("fleet-burst", "fleet-diurnal")
 
 
 def make_trace(seed: int, num_requests: int, *, mean_interarrival: float = 2.0,
@@ -151,9 +152,66 @@ def make_qos_trace(scenario: str, seed: int, num_requests: int, *,
     return trace
 
 
+def make_fleet_trace(scenario: str, seed: int, num_requests: int, *,
+                     vocab_size: int = 256, page_len: int = 16,
+                     num_prefix_groups: int = 4, prefix_pages: int = 2,
+                     prefix_frac: float = 0.75, tail_len_range=(4, 20),
+                     output_len_range=(4, 24),
+                     mean_interarrival: float = 2.0, burst_size: int = 6):
+    """Seeded multi-tenant fleet traces on the step clock (all
+    bit-reproducible per seed): ``num_prefix_groups`` distinct shared
+    system prompts (each ``prefix_pages`` FULL pages, so the prefix
+    cache and the router fingerprint the same runs), with
+    ``prefix_frac`` of the requests opening with one of them — the
+    traffic shape where prefix-affinity routing pays (one tenant's
+    prefix keeps hitting one replica's radix cache) and least-loaded
+    scatters it cold.
+
+    - ``fleet-burst``: quiet gaps punctured by ``burst_size`` same-step
+      stampedes — the router must spread a stampede without destroying
+      affinity;
+    - ``fleet-diurnal``: the 4-phase arrival-rate day of the QoS pack
+      (off-peak 4x -> shoulder -> peak 0.5x -> shoulder) at fleet scale.
+    """
+    if scenario not in FLEET_SCENARIOS:
+        raise ValueError(f"unknown fleet scenario {scenario!r}; pick one "
+                         f"of {FLEET_SCENARIOS}")
+    r = np.random.RandomState(seed)
+    prefixes = [r.randint(1, vocab_size, size=prefix_pages * page_len)
+                .astype(np.int32) for _ in range(num_prefix_groups)]
+    phase_len = max(1, num_requests // 8)
+    trace, step = [], 0
+    for i in range(num_requests):
+        if scenario == "fleet-burst":
+            if i % burst_size == 0:
+                step += int(round(burst_size * mean_interarrival))
+        else:                                  # fleet-diurnal
+            scale = (4.0, 1.5, 0.5, 1.5)[(i // phase_len) % 4]
+            mean = max(mean_interarrival * scale, 1e-6)
+            step += int(r.geometric(min(1.0, 1.0 / mean)))
+        tail = r.randint(1, vocab_size,
+                         size=int(r.randint(tail_len_range[0],
+                                            tail_len_range[1] + 1))
+                         ).astype(np.int32)
+        out = int(r.randint(output_len_range[0], output_len_range[1] + 1))
+        group = -1
+        if r.random_sample() < prefix_frac:
+            group = int(r.randint(0, num_prefix_groups))
+            prompt = np.concatenate([prefixes[group], tail])
+        else:
+            prompt = tail
+        trace.append({"id": i, "arrival_step": step,
+                      "kind": (f"group{group}" if group >= 0
+                               else "uniform"),
+                      "prompt": prompt.tolist(), "max_new_tokens": out})
+    return trace
+
+
 def replay(engine, trace):
     """Feed ``trace`` through ``engine`` honoring arrival steps on the
     engine-iteration clock; returns the request handles in trace order.
+    ``engine`` may equally be a ``ServingFleet`` — same submit/advance/
+    busy/iteration surface, fleet-step clock instead of engine clock.
 
     Idle gaps fast-forward the clock to the NEXT arrival step (not just
     the head request), so a same-step burst lands together — admitting
@@ -172,7 +230,9 @@ def replay(engine, trace):
                 t["prompt"], t["max_new_tokens"], request_id=t["id"],
                 priority=t.get("priority", 0))
         engine.advance()
-    engine.metrics.flush()
+    metrics = getattr(engine, "metrics", None)   # fleets have none
+    if metrics is not None:
+        metrics.flush()
     return [handles[t["id"]] for t in trace]
 
 
@@ -464,6 +524,179 @@ def run_benchmark(args):
     return result
 
 
+def _build_fleet(args, router: str):
+    """One fleet per A/B arm: same model/seed/geometry, only the router
+    policy differs — the comparison is dispatch policy, nothing else.
+    Always paged: prefix affinity exists to feed the radix cache."""
+    from deepspeed_tpu.serving import ServingConfig
+    from deepspeed_tpu.serving.fleet.config import FleetConfig
+    from deepspeed_tpu.serving.fleet.manager import ServingFleet
+    from deepspeed_tpu.serving.paging import PagingConfig
+
+    model, params = build_demo_model(
+        vocab_size=args.vocab_size, max_seq_len=args.max_len,
+        d_model=args.d_model, n_layers=args.n_layers, n_heads=args.n_heads,
+        seed=args.seed)
+    cfg = ServingConfig(
+        num_slots=args.num_slots, max_len=args.max_len,
+        prefill_bucket=args.prefill_bucket, seed=args.seed,
+        paging=PagingConfig(page_len=args.page_len, kernel=args.kernel),
+        fleet=FleetConfig(replicas=args.replicas, router=router,
+                          disaggregate=args.disaggregate,
+                          prefill_replicas=args.prefill_replicas))
+    return ServingFleet(model, params, cfg)
+
+
+def _replay_fleet(fleet, trace, kill_step=None):
+    """The ``replay`` loop with the replica-kill chaos hook: once the
+    replay clock reaches ``kill_step`` the highest-id live replica dies
+    hard — its requests must finish elsewhere (the failover
+    acceptance). The trigger compares the REPLAY clock (which
+    fast-forwards across idle gaps exactly like ``replay``), not the
+    raw advance count — ``kill_step`` defaults to a trace ARRIVAL step
+    and must fire even when the workload drains in fewer advances."""
+    pending = deque(sorted(trace, key=lambda t: t["arrival_step"]))
+    handles, killed = {}, None
+    clock = 0
+    while pending or fleet.busy:
+        clock = max(clock, fleet.iteration)
+        if not fleet.busy and pending and pending[0]["arrival_step"] > clock:
+            clock = pending[0]["arrival_step"]
+        while pending and pending[0]["arrival_step"] <= clock:
+            t = pending.popleft()
+            handles[t["id"]] = fleet.submit(
+                t["prompt"], t["max_new_tokens"], request_id=t["id"],
+                priority=t.get("priority", 0))
+        if kill_step is not None and killed is None \
+                and clock >= kill_step:
+            killed = fleet.pick_disposable_replica()
+            fleet.kill_replica(killed)
+        fleet.advance()
+    return [handles[t["id"]] for t in trace], killed
+
+
+def _fleet_run_block(fleet, trace, handles):
+    """One A/B arm's artifact block: router-level goodput + latency,
+    router decision accounting, and the per-replica breakdown."""
+    from deepspeed_tpu.observability.metrics import percentile
+    snap = fleet.snapshot()
+    ttft_steps = [h.first_token_iteration - h.submitted_iteration
+                  for h in handles
+                  if h.first_token_iteration is not None
+                  and h.submitted_iteration is not None]
+    tokens = sum(len(h.tokens) for h in handles)
+    wall = max((h.finished_at or h.submitted_at) for h in handles) \
+        - min(h.submitted_at for h in handles)
+    finished = sum(h.status == "finished" for h in handles)
+    hits = lookups = 0
+    per_replica = {}
+    for rid, rep in snap["replicas"].items():
+        serving = rep.get("serving") or {}
+        hits += serving.get("prefix_hits", 0)
+        lookups += serving.get("prefix_lookups", 0)
+        per_replica[rid] = {
+            "role": rep["role"], "alive": rep["alive"],
+            "requests_finished": serving.get("requests_finished", 0),
+            "tokens_generated": serving.get("tokens_generated", 0),
+            "queue_depth_mean": serving.get("queue_depth_mean"),
+            "queue_depth_max": serving.get("queue_depth_max"),
+            "slot_occupancy_mean": serving.get("slot_occupancy_mean"),
+            "ttft_steps_p50": serving.get("ttft_steps_p50"),
+            "ttft_steps_p95": serving.get("ttft_steps_p95"),
+            "prefix_hit_rate": serving.get("prefix_hit_rate"),
+            "handoffs_exported": serving.get("handoffs_exported", 0),
+            "handoffs_imported": serving.get("handoffs_imported", 0),
+        }
+    return {
+        "router": snap["router"],
+        "goodput": {
+            "requests_finished": finished,
+            "requests_submitted": len(handles),
+            "finished_frac": finished / max(1, len(handles)),
+            "tokens_generated": tokens,
+            "wall_s": wall,
+            "tokens_per_s": tokens / wall if wall > 0 else 0.0,
+            "fleet_steps": fleet.iteration,
+        },
+        "ttft_steps_p50": percentile(ttft_steps, 50),
+        "ttft_steps_p95": percentile(ttft_steps, 95),
+        "prefix_hit_rate": hits / max(1, lookups),
+        "handoffs_completed": snap["handoffs_completed"],
+        "failovers": snap["failovers"],
+        "dead_replicas": snap["dead_replicas"],
+        "per_replica": per_replica,
+        "statuses": {s: sum(h.status == s for h in handles)
+                     for s in {h.status for h in handles}},
+    }
+
+
+def run_fleet_benchmark(args):
+    """The fleet scenario pack: the SAME seeded multi-tenant trace
+    through (a) the prefix-affinity router, (b) least-loaded-only
+    dispatch — the A/B the acceptance criteria compare — plus (c) a
+    replica-kill run where every request must still finish. Writes the
+    ``BENCH_serving_fleet`` artifact."""
+    trace = make_fleet_trace(
+        args.scenario, args.seed, args.num_requests,
+        vocab_size=args.vocab_size, page_len=args.page_len,
+        num_prefix_groups=args.num_prefix_groups,
+        prefix_pages=args.prefix_pages, prefix_frac=args.prefix_frac,
+        output_len_range=(args.min_output, args.max_output),
+        mean_interarrival=args.mean_interarrival)
+    # warmup: one throwaway fleet pays every jit specialization (chunk
+    # buckets + paged decode) so the A/B arms' wall-clock numbers
+    # compare dispatch policy, not who compiled first
+    warm = _build_fleet(args, "least_loaded")
+    replay(warm, trace[: min(4, len(trace))])
+    warm.close()
+    arms = {}
+    for router in ("prefix_affinity", "least_loaded"):
+        fleet = _build_fleet(args, router)
+        handles = replay(fleet, trace)
+        arms[router] = _fleet_run_block(fleet, trace, handles)
+        fleet.close()
+    kill_step = args.kill_step
+    if kill_step is None:
+        kill_step = trace[len(trace) // 2]["arrival_step"]
+    fleet = _build_fleet(args, "prefix_affinity")
+    handles, killed = _replay_fleet(fleet, trace, kill_step=kill_step)
+    kill_block = _fleet_run_block(fleet, trace, handles)
+    kill_block["killed_replica"] = killed
+    kill_block["kill_step"] = kill_step
+    kill_block["all_finished"] = all(h.status == "finished"
+                                     for h in handles)
+    fleet.close()
+    aff, ll = arms["prefix_affinity"], arms["least_loaded"]
+    return {
+        "bench": "serving_fleet",
+        "config": {
+            "replicas": args.replicas,
+            "num_slots": args.num_slots, "max_len": args.max_len,
+            "page_len": args.page_len,
+            "disaggregate": args.disaggregate,
+            "prefill_replicas": (args.prefill_replicas
+                                 if args.disaggregate else None),
+            "model": {"vocab_size": args.vocab_size,
+                      "d_model": args.d_model,
+                      "n_layers": args.n_layers, "n_heads": args.n_heads},
+        },
+        "trace": {"scenario": args.scenario, "seed": args.seed,
+                  "num_requests": args.num_requests,
+                  "num_prefix_groups": args.num_prefix_groups,
+                  "prefix_pages": args.prefix_pages,
+                  "prefix_frac": args.prefix_frac,
+                  "mean_interarrival": args.mean_interarrival},
+        "router_ab": arms,
+        "router_ab_delta": {
+            "prefix_hit_rate": (aff["prefix_hit_rate"]
+                                - ll["prefix_hit_rate"]),
+            "ttft_steps_p95": ((aff["ttft_steps_p95"] or 0)
+                               - (ll["ttft_steps_p95"] or 0)),
+        },
+        "replica_kill": kill_block,
+    }
+
+
 def build_parser():
     p = argparse.ArgumentParser(
         prog="ds_tpu_bench serving",
@@ -487,7 +720,7 @@ def build_parser():
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--scenario",
                    choices=["uniform", "prefix-adversarial",
-                            *QOS_SCENARIOS],
+                            *QOS_SCENARIOS, *FLEET_SCENARIOS],
                    default="uniform",
                    help="prefix-adversarial: most requests share a seeded "
                         "system prompt and a minority carry near-max-len "
@@ -495,7 +728,12 @@ def build_parser():
                         "at 0). diurnal / burst / adversarial-long-prompt: "
                         "the QoS scenario pack — priority-tagged seeded "
                         "traces replayed against the QoS engine (implies "
-                        "--qos; artifact gains the per-class qos block)")
+                        "--qos; artifact gains the per-class qos block). "
+                        "fleet-burst / fleet-diurnal: the multi-replica "
+                        "pack — one seeded multi-tenant trace through the "
+                        "prefix-affinity router vs least-loaded-only "
+                        "dispatch, plus a replica-kill failover run "
+                        "(artifact: BENCH_serving_fleet.json)")
     p.add_argument("--qos", action="store_true",
                    help="enable the serving.qos block (automatic for the "
                         "QoS scenario pack)")
@@ -541,6 +779,27 @@ def build_parser():
     p.add_argument("--quantize-weights", action="store_true",
                    help="int8 weight-only serving "
                         "(serving.quantize.weights)")
+    fl = p.add_argument_group("fleet scenario pack (docs/serving.md "
+                              "'Multi-replica fleet')")
+    fl.add_argument("--replicas", type=int, default=3,
+                    help="fleet size for the fleet-* scenarios")
+    fl.add_argument("--disaggregate", action="store_true",
+                    help="run the fleet arms with disaggregated "
+                         "prefill/decode roles (page handoffs)")
+    fl.add_argument("--prefill-replicas", type=int, default=1,
+                    help="prefill-role replicas under --disaggregate")
+    fl.add_argument("--num-prefix-groups", type=int, default=4,
+                    help="distinct shared system prompts (tenants) in "
+                         "the fleet trace")
+    fl.add_argument("--prefix-pages", type=int, default=2,
+                    help="pages per shared prefix (full pages: what the "
+                         "radix cache and the router both key on)")
+    fl.add_argument("--prefix-frac", type=float, default=0.75,
+                    help="fraction of requests opening with a shared "
+                         "prefix")
+    fl.add_argument("--kill-step", type=int, default=None,
+                    help="fleet step for the replica-kill run (default: "
+                         "the mid-trace arrival step)")
     p.add_argument("--peak-tflops", type=float, default=None,
                    help="chip peak TFLOP/s for the artifact's MFU field "
                         "(defaults to the detected chip's table entry; "
@@ -554,9 +813,32 @@ def build_parser():
 def main(argv=None):
     args = build_parser().parse_args(argv)
     if args.out is None:
-        args.out = ("BENCH_serving_qos.json"
+        args.out = ("BENCH_serving_fleet.json"
+                    if args.scenario in FLEET_SCENARIOS
+                    else "BENCH_serving_qos.json"
                     if args.scenario in QOS_SCENARIOS
                     else "BENCH_serving.json")
+    if args.scenario in FLEET_SCENARIOS:
+        result = run_fleet_benchmark(args)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+        aff = result["router_ab"]["prefix_affinity"]
+        ll = result["router_ab"]["least_loaded"]
+        kill = result["replica_kill"]
+        print(f"BENCH_serving_fleet: {args.replicas} replicas, "
+              f"{args.num_requests} requests "
+              f"({result['trace']['num_prefix_groups']} prefix groups); "
+              "prefix-affinity vs least-loaded: "
+              f"hit rate {aff['prefix_hit_rate']:.2f} vs "
+              f"{ll['prefix_hit_rate']:.2f}, ttft p95 "
+              f"{aff['ttft_steps_p95']} vs {ll['ttft_steps_p95']} steps, "
+              f"{aff['goodput']['tokens_per_s']:.1f} vs "
+              f"{ll['goodput']['tokens_per_s']:.1f} tok/s; "
+              f"replica-kill (step {kill['kill_step']}): "
+              f"{kill['goodput']['requests_finished']}/"
+              f"{kill['goodput']['requests_submitted']} finished, "
+              f"{kill['failovers']} failovers; artifact -> {args.out}")
+        return 0
     result = run_benchmark(args)
     with open(args.out, "w") as f:
         json.dump(result, f, indent=1)

@@ -17,8 +17,13 @@ Two scale axes:
   (requests requeued with tokens retained, resumed in an admissible
   slot) instead of dropping them.
 - **replicas** — when the process is already at ``num_slots`` and still
-  saturated, the recommendation carries a ``target_replicas`` hint for
-  the fleet layer (this module never spawns processes).
+  saturated, the recommendation carries ``target_replicas``, which the
+  fleet layer (serving/fleet/manager.py ``ServingFleet``) ACTS on:
+  sustained backlog spawns replicas, sustained idleness retires one,
+  drained through the preemption/slot-cap path. A fleet-scoped scaler
+  passes ``replica_slots`` (slots per replica) so the backlog-sized
+  target is denominated in replicas of that size, and feeds fleet-total
+  gauges through its own registry.
 
 Deterministic on purpose: every input is a host int sampled on the
 engine-iteration clock, streak counters provide hysteresis, and the
@@ -45,7 +50,9 @@ class ServingAutoscaleConfig:
     """Knobs for the rule-based serving autoscaler."""
     enabled: bool = True
     min_slots: int = 1               # scale-down floor
-    max_replicas: int = 8            # replica-hint ceiling
+    max_replicas: int = 8            # target_replicas ceiling (the
+                                     # fleet manager spawns toward the
+                                     # target, never past this)
     queue_per_slot_high: float = 1.0  # queue_depth >= cap * this AND all
                                       # admissible slots busy = pressure
     occupancy_low: float = 0.375     # active/cap below this with an empty
@@ -94,10 +101,15 @@ class ServingAutoscaler:
 
     def __init__(self, engine=None,
                  config: Optional[ServingAutoscaleConfig] = None,
-                 registry=None):
+                 registry=None, replica_slots: Optional[int] = None):
         self.engine = engine
         self.config = (config or ServingAutoscaleConfig()).validate()
         self.registry = registry if registry is not None else get_registry()
+        # fleet mode (engine=None, gauges carry fleet TOTALS): the size
+        # of ONE replica, so the saturated-branch target is "how many
+        # replicas of this size cover the backlog" instead of dividing
+        # by the whole fleet's slot count
+        self.replica_slots = replica_slots
         self._pressure_streak = 0
         self._idle_streak = 0
         self.decisions: List[dict] = []
@@ -151,8 +163,10 @@ class ServingAutoscaler:
             else:
                 # the process is maxed out: recommend fleet-level scale-out
                 # sized by the backlog (ceil of waiting+running per full
-                # replica), capped
-                want = -(-(queue_depth + active) // max(1, num_slots))
+                # replica), capped — ServingFleet._autoscale_tick spawns
+                # toward this figure
+                per_replica = self.replica_slots or max(1, num_slots)
+                want = -(-(queue_depth + active) // per_replica)
                 target_replicas = max(2, min(cfg.max_replicas, want))
                 action = ACTION_SCALE_UP
                 reason = (f"saturated at num_slots={num_slots} with queue "
@@ -186,7 +200,9 @@ class ServingAutoscaler:
         engine's slot cap (scale-down drains via the preemption path —
         ``ServingEngine.set_slot_cap`` requeues active requests with
         their tokens retained, never drops them). Replica targets are
-        hints for the fleet layer and are returned untouched."""
+        returned untouched here — the fleet manager
+        (``ServingFleet._autoscale_tick``) is the consumer that spawns
+        and drains replicas toward them."""
         if self.engine is not None and decision["action"] != ACTION_HOLD:
             applied = self.engine.set_slot_cap(decision["target_slots"])
             decision = {**decision, "applied_slot_cap": applied}

@@ -15,6 +15,7 @@ models without hard-coding the module hierarchy. All of them are pure
 jnp functions, safe inside jit.
 """
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -361,6 +362,60 @@ def scatter_chunk_pages(pool, token_tree, page_run):
         return out
 
     return _walk_with(pool, token_tree, scatter)
+
+
+def export_pages(pool, page_ids):
+    """Read ``page_ids``'s K/V contents (and scale planes, for int8
+    pools) out of the pool as host numpy arrays — the device half of the
+    fleet's page-granular prefill/decode handoff (serving/fleet/). One
+    record per attention unit, in ``_map_units`` traversal order (a
+    deterministic walk both ends share), each leaf
+    ``[n, h, d|1, page_len]`` (``[L, n, ...]`` scan-stacked). A host
+    sync by design: the handoff is a host-mediated page transfer."""
+    ids = np.asarray(page_ids, np.int32)
+    units = []
+
+    def grab(unit):
+        stacked = unit["cached_key"].ndim == 5
+        rec = {}
+        for name in _KV_KEYS + tuple(_SCALE_KEYS.values()):
+            leaf = unit.get(name)
+            if leaf is None:
+                continue
+            rec[name] = np.asarray(leaf[:, ids] if stacked else leaf[ids])
+        units.append(rec)
+        return unit
+
+    _map_units(pool, grab)
+    return units
+
+
+def import_pages(pool, page_ids, units):
+    """Write ``export_pages`` records into ``page_ids`` of (a structurally
+    identical) ``pool`` — the receiving half of the page handoff. Pure
+    ``.at[].set`` dispatches outside any jit (the page-table-update
+    pattern): shapes never change, so every compiled paged program stays
+    cached. ``units`` must come from a pool with the same layout and
+    quantization mode (the engine validates the wire format first)."""
+    ids = jnp.asarray(np.asarray(page_ids, np.int32))
+    it = iter(units)
+
+    def put(unit):
+        rec = next(it)
+        stacked = unit["cached_key"].ndim == 5
+        out = dict(unit)
+        for name, data in rec.items():
+            if name not in unit:
+                raise ValueError(
+                    f"handoff page payload carries {name!r} but the "
+                    "receiving pool has no such plane — quantization "
+                    "modes differ between replicas")
+            leaf = unit[name]
+            out[name] = (leaf.at[:, ids].set(data) if stacked
+                         else leaf.at[ids].set(data))
+        return out
+
+    return _map_units(pool, put)
 
 
 def make_paged_view(pool, page_table, lengths):

@@ -124,10 +124,13 @@ def render_prometheus(snapshot: dict) -> str:
 def build_statusz(snapshot: dict) -> dict:
     """The /statusz payload: the operator-facing sections of a snapshot
     (goodput breakdown, program table, memory attribution, serving
-    queue/slot state), plus the capture meta header."""
+    queue/slot state), plus the capture meta header. Fleet snapshots
+    (``ServingFleet.metrics_snapshot``) additionally carry the router-
+    level ``fleet`` section — per-replica stats/roles/liveness, router
+    policy + recent decisions, handoff/failover/scaling counters."""
     reg = snapshot.get("registry", snapshot)
     collected = reg.get("collected") or {}
-    return {
+    out = {
         "meta": reg.get("meta") or {},
         "goodput": snapshot.get("goodput") or {},
         "programs": snapshot.get("programs") or {},
@@ -139,6 +142,76 @@ def build_statusz(snapshot: dict) -> dict:
         "counters": reg.get("counters") or {},
         "gauges": reg.get("gauges") or {},
     }
+    if snapshot.get("fleet"):
+        out["fleet"] = snapshot["fleet"]
+    return out
+
+
+def parse_prometheus(text: str) -> dict:
+    """Inverse of ``render_prometheus`` for the samples a router needs:
+    ``{metric_name: value}`` for unlabeled samples plus
+    ``{metric_name{label="..."}: value}`` for labeled ones (quantile
+    series and the goodput categories keep their label string as the
+    key suffix). Comment/HELP/TYPE lines are skipped; unparseable
+    sample lines are ignored rather than fatal — a scrape must degrade,
+    not crash the router."""
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value_part = line.rsplit(None, 1)
+            out[name_part] = float(value_part)
+        except ValueError:
+            continue
+    return out
+
+
+class MetricsScrapeClient:
+    """Per-replica scrape client over a replica's live telemetry
+    endpoint (the PR-8 plane): ``gauges()`` pulls and parses
+    ``/metrics``, ``healthz()`` answers the liveness probe the fleet's
+    health sweep uses for PROCESS replicas. Stdlib urllib, short
+    timeouts, and every failure degrades to None/False — a dead replica
+    must read as dead, never hang the router."""
+
+    def __init__(self, base_url: str, timeout_s: float = 2.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _get(self, path: str):
+        import urllib.error
+        import urllib.request
+        try:
+            with urllib.request.urlopen(self.base_url + path,
+                                        timeout=self.timeout_s) as r:
+                return r.status, r.read().decode("utf-8", "replace")
+        except (urllib.error.URLError, OSError, ValueError):
+            return None, None
+
+    def healthz(self) -> bool:
+        status, _ = self._get("/healthz")
+        return status == 200
+
+    def gauges(self):
+        """Parsed /metrics samples, or None when the endpoint is
+        unreachable (the caller treats that as a missed health check)."""
+        status, body = self._get("/metrics")
+        if status != 200 or body is None:
+            return None
+        return parse_prometheus(body)
+
+    def statusz(self):
+        status, body = self._get("/statusz")
+        if status != 200 or body is None:
+            return None
+        try:
+            return json.loads(body)
+        except ValueError:
+            return None    # truncated/partial body mid-shutdown: the
+                           # degrade-to-None contract covers bad bodies
+                           # exactly like unreachable endpoints
 
 
 class TelemetryServer:

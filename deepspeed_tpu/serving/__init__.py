@@ -6,12 +6,14 @@ config schema, and that path must work in dependency-free tooling jobs.
 """
 
 from .config import QuantizeConfig, ServingConfig
+from .fleet.config import FleetConfig
 from .paging.config import PagingConfig
 from .qos import QosClass, QosConfig, QosController
 
 __all__ = ["ServingConfig", "PagingConfig", "QuantizeConfig", "QosClass",
            "QosConfig", "QosController", "ServingEngine", "Request",
-           "FifoScheduler", "ServingMetrics", "PagedKVManager"]
+           "FifoScheduler", "ServingMetrics", "PagedKVManager",
+           "FleetConfig", "ServingFleet", "FleetRequest"]
 
 _LAZY = {
     "ServingEngine": ".engine",
@@ -19,6 +21,8 @@ _LAZY = {
     "FifoScheduler": ".scheduler",
     "ServingMetrics": ".metrics",
     "PagedKVManager": ".paging.manager",
+    "ServingFleet": ".fleet.manager",
+    "FleetRequest": ".fleet.manager",
 }
 
 

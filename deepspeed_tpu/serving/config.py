@@ -8,6 +8,7 @@ importable without jax (the ds_tpu_lint job runs dependency-free).
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
+from .fleet.config import FleetConfig
 from .paging.config import PagingConfig
 from .qos import QosConfig
 
@@ -101,6 +102,12 @@ class ServingConfig:
                                      # int8 weight-only serving + int8 KV
                                      # pages (docs/serving.md "Quantized
                                      # serving"); absent = full-precision
+    fleet: Optional[FleetConfig] = None
+                                     # multi-replica fleet (serving/fleet/,
+                                     # docs/serving.md "Multi-replica
+                                     # fleet"): replica manager + prefix-
+                                     # affinity router + disaggregated
+                                     # prefill/decode; absent = one engine
 
     def __post_init__(self):
         # nested-block plumbing: runtime/config.py's dict_to_dataclass is
@@ -111,6 +118,8 @@ class ServingConfig:
             self.qos = QosConfig(**self.qos)
         if isinstance(self.quantize, dict):
             self.quantize = QuantizeConfig(**self.quantize)
+        if isinstance(self.fleet, dict):
+            self.fleet = FleetConfig(**self.fleet)
 
     def validate(self):
         if self.num_slots < 1:
@@ -144,6 +153,8 @@ class ServingConfig:
             self.qos.validate()
         if self.quantize is not None:
             self.quantize.validate(self.paged)
+        if self.fleet is not None:
+            self.fleet.validate(self)
         return self
 
     @property
@@ -165,6 +176,11 @@ class ServingConfig:
     def qos_enabled(self) -> bool:
         """True when the QoS layer is configured AND enabled."""
         return self.qos is not None and self.qos.enabled
+
+    @property
+    def fleet_enabled(self) -> bool:
+        """True when the multi-replica fleet is configured AND enabled."""
+        return self.fleet is not None and self.fleet.enabled
 
     @property
     def cache_len(self) -> int:

@@ -262,6 +262,12 @@ class ServingEngine:
                                           # drains above the cap via the
                                           # preemption path; compiled
                                           # shapes never change)
+        # disaggregated-fleet prefill role (serving/fleet/): the engine
+        # runs chunked prefill + first token only, never dispatches a
+        # decode, and stages every prefilled request for a page-granular
+        # KV handoff to a decode replica (set via set_prefill_role)
+        self.prefill_only = False
+        self._handoff_ready = []          # [(slot, req)] awaiting export
         self._preempts_this_iter = 0
         self._watchdog = None
         self._watchdog_report = None      # set by the watchdog thread;
@@ -634,7 +640,15 @@ class ServingEngine:
                 self._run_prefill_chunks()
             else:
                 self._admit_ready()
-            dispatched = self._dispatch_decode()
+            if self.prefill_only:
+                # prefill role: no decode ever dispatches (the decode
+                # replica owns generation past token 1), but the
+                # deterministic iteration clock still ticks — deadline
+                # sweeps and the fleet's lockstep replay depend on it
+                dispatched = False
+                self._iteration += 1
+            else:
+                dispatched = self._dispatch_decode()
             # keep at most pipeline_depth dispatches in flight; drain fully
             # when nothing new was dispatched (tail of the workload)
             target = self.config.pipeline_depth if dispatched else 0
@@ -994,6 +1008,16 @@ class ServingEngine:
                 self.metrics.on_token()
                 if bool(np.asarray(done)):
                     self._finish(slot, req)
+                elif self.prefill_only:
+                    # prefill role: mask the device row (this engine
+                    # never decodes it) and stage the slot for a page
+                    # handoff — pages stay allocated until export
+                    self._state = {
+                        **self._state,
+                        "active": self._state["active"].at[slot].set(False),
+                        "remaining": self._state["remaining"].at[slot].set(0),
+                    }
+                    self._handoff_ready.append((slot, req))
                 return
             _, snapshot, toks, done = entry
             toks = np.asarray(toks)
@@ -1056,6 +1080,8 @@ class ServingEngine:
         token-exact under greedy sampling, page-granular prefix-cache
         hits making the recompute cheap on the paged engine."""
         self._pending.clear()
+        self._handoff_ready.clear()   # staged slots are requeued below —
+                                      # their page contents are stale
         victims = [r for r in self._slot_req
                    if r is not None and not r.done]
         n = self.config.num_slots
@@ -1103,6 +1129,135 @@ class ServingEngine:
         log_dist(f"serving: slot cap {old} -> {n} "
                  f"(of {self.config.num_slots} compiled slots)", ranks=[0])
         return n
+
+    # -- disaggregated prefill/decode handoff (serving/fleet/) -------------
+    def set_prefill_role(self, on: bool = True):
+        """Flip the engine into (or out of) the disaggregated fleet's
+        prefill role: admissions and chunked prefill run normally, the
+        decode program never dispatches, and every prefilled request
+        stages in ``take_handoff_ready()`` for a page-granular KV
+        transfer to a decode replica. Paged engines only — the handoff
+        IS a page transfer."""
+        if on and self._paged is None:
+            raise ValueError(
+                "prefill role (disaggregated fleet) requires the "
+                "block-paged KV cache (serving.paging) — the handoff is "
+                "a page transfer, not a cache copy")
+        self.prefill_only = bool(on)
+
+    def take_handoff_ready(self):
+        """Pop the requests whose prefill (and first token) completed and
+        now await export — ``[(slot, req)]``. Slots stay allocated (pages
+        pinned) until ``export_handoff``; entries whose request was
+        cancelled or requeued in the meantime are dropped here."""
+        out, self._handoff_ready = self._handoff_ready, []
+        return [(s, r) for s, r in out
+                if self._slot_req[s] is r and not r.done]
+
+    def export_handoff(self, slot: int, req: Request) -> dict:
+        """Serialize one prefilled request as a page-granular handoff
+        payload (docs/serving.md "Handoff wire format"): the prefilled
+        pages' contents, the page-table run length, and the request +
+        sampler state a decode replica needs to continue token-exactly.
+        Frees the slot — the pages travel as values, not references."""
+        if self._paged is None:
+            raise ValueError("export_handoff requires the paged engine")
+        # what was prefilled = the effective prompt at admission; tokens
+        # holds exactly one post-prefill sample (the handoff fires at
+        # first-token harvest), so the frontier is one behind it
+        prefill_len = len(req.prompt) + len(req.tokens) - 1
+        remaining = req.max_new_tokens - len(req.tokens)
+        kv, n_filled = self._paged.export_slot(slot, prefill_len)
+        payload = {
+            "version": 1,
+            "page_len": self._paged.page_len,
+            "kv_quant": self._paged.kv_quant,
+            "prefill_len": prefill_len,
+            "n_pages_filled": n_filled,
+            "kv": kv,
+            "state": {"last_token": int(req.tokens[-1]),
+                      "remaining": int(remaining)},
+            "request": {"request_id": req.request_id,
+                        "prompt": np.asarray(req.prompt, np.int32),
+                        "generated": list(req.tokens),
+                        "max_new_tokens": int(req.max_new_tokens),
+                        "priority": int(req.priority)},
+        }
+        self._paged.release_slot(slot)
+        self._slot_req[slot] = None
+        self._free.append(slot)
+        self.metrics.on_handoff_export(req)
+        return payload
+
+    def inject_handoff(self, payload: dict,
+                       request: Optional[Request] = None,
+                       on_token=None) -> Optional[Request]:
+        """Import a handoff payload into a free slot and continue decode
+        from it — ZERO prefill recompute (no prefill program runs; the
+        transferred pages are written in place with the page-table-update
+        dispatch pattern, so every compiled program stays cached).
+        Returns the live ``Request`` rebuilt from the payload (the ONE
+        payload->Request mapping — callers pass ``on_token=`` to wire
+        streaming instead of rebuilding it themselves; ``request=``
+        threads a fully prepared handle through when one exists), or
+        None when no slot/pages are free — the caller retries on a
+        later step. Token-exact under greedy sampling: decode continues
+        from the transferred KV + last token exactly as the prefilling
+        engine would have."""
+        if self._paged is None:
+            raise ValueError("inject_handoff requires the paged engine")
+        if payload.get("version") != 1:
+            raise ValueError(
+                f"unknown handoff payload version {payload.get('version')!r}")
+        if (payload["page_len"] != self._paged.page_len
+                or payload.get("kv_quant") != self._paged.kv_quant):
+            raise ValueError(
+                "handoff wire-format mismatch: payload page_len="
+                f"{payload['page_len']}/kv_quant={payload.get('kv_quant')!r}"
+                f" vs pool page_len={self._paged.page_len}/kv_quant="
+                f"{self._paged.kv_quant!r} — fleet replicas must share "
+                "one serving config")
+        slot = self._peek_free_slot()
+        if slot is None:
+            return None
+        st = payload["state"]
+        rq = payload["request"]
+        prefill_len = int(payload["prefill_len"])
+        remaining = int(st["remaining"])
+        total = self._paged.pages_for(prefill_len, remaining)
+        if not self._paged.import_slot(slot, payload["kv"],
+                                       int(payload["n_pages_filled"]),
+                                       total):
+            return None
+        if request is None:
+            request = Request(np.asarray(rq["prompt"], np.int32),
+                              rq["max_new_tokens"], rq["request_id"],
+                              on_token=on_token,
+                              priority=rq.get("priority", 0))
+            request.tokens = list(rq["generated"])
+        if request.submitted_iteration is None:
+            request.submitted_iteration = self._iteration
+        self._take_slot(slot)
+        self._slot_req[slot] = request
+        request._admitted(slot, self._iteration)
+        self._state = {
+            "lengths": self._state["lengths"].at[slot].set(prefill_len),
+            "last_token": self._state["last_token"].at[slot].set(
+                st["last_token"]),
+            "active": self._state["active"].at[slot].set(True),
+            "remaining": self._state["remaining"].at[slot].set(remaining),
+        }
+        # publish the imported prompt's full pages to THIS replica's
+        # prefix cache: later handoffs/admits of the same prefix family
+        # reference them copy-free, exactly like a local prefill would
+        prefilled = np.concatenate(
+            [np.asarray(rq["prompt"], np.int32),
+             np.asarray(rq["generated"][:-1], np.int32)]) \
+            if len(rq["generated"]) > 1 else np.asarray(rq["prompt"],
+                                                        np.int32)
+        self._paged.publish(slot, prefilled)
+        self.metrics.on_handoff_import(request, prefill_len)
+        return request
 
     # -- construction helpers ---------------------------------------------
     @classmethod

@@ -1,0 +1,32 @@
+"""Multi-replica serving fleet (docs/serving.md "Multi-replica fleet").
+
+Lazy exports (PEP 562, the serving/__init__ pattern) so
+``fleet.config`` stays importable without jax — ``serving/config.py``
+pulls ``FleetConfig`` into the ``serving.fleet`` block, and that path
+must work in dependency-free tooling jobs.
+"""
+
+from .config import FleetConfig
+
+__all__ = ["FleetConfig", "ServingFleet", "FleetRequest", "Router",
+           "ReplicaStats", "LocalReplica", "ProcessReplica",
+           "serialize_handoff", "deserialize_handoff"]
+
+_LAZY = {
+    "ServingFleet": ".manager",
+    "FleetRequest": ".manager",
+    "Router": ".router",
+    "ReplicaStats": ".replica",
+    "LocalReplica": ".replica",
+    "ProcessReplica": ".replica",
+    "serialize_handoff": ".handoff",
+    "deserialize_handoff": ".handoff",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(_LAZY[name], __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

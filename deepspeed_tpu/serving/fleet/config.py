@@ -1,0 +1,142 @@
+"""Fleet configuration (the ``serving.fleet`` sub-block).
+
+Stdlib-only (same contract as ``serving/config.py``): ``runtime/
+config.py`` reaches this dataclass through ``ServingConfig``, and that
+import path must stay jax-free for the dependency-free tooling jobs
+(ds_tpu_lint in CI).
+
+Reference frame: DeepSpeed-Inference's multi-GPU serving architecture
+(arXiv:2207.00032) — the layer ABOVE one engine: N supervised replicas,
+a prefix-affinity front-end router, and (optionally) disaggregated
+prefill/decode where prompt-heavy replicas hand page-granular KV to
+decode replicas so bursty prompt traffic cannot starve steady-state
+decode (docs/serving.md "Multi-replica fleet").
+"""
+
+from dataclasses import dataclass
+
+ROUTERS = ("prefix_affinity", "least_loaded")
+BACKENDS = ("inprocess", "process")
+
+
+@dataclass
+class FleetConfig:
+    """Multi-replica serving knobs.
+
+    Every replica runs the SAME ``ServingConfig`` (minus this block):
+    identical compiled shapes, identical page geometry — which is what
+    makes the page handoff a transfer instead of a recompute, and what
+    keeps routing decisions replayable (the router only ever reads
+    deterministic per-replica state on the fleet step clock).
+    """
+    enabled: bool = True
+    replicas: int = 2                # engines at fleet start
+    backend: str = "inprocess"       # "inprocess" = N engines, one
+                                     # process, lockstep clock (the
+                                     # deterministic/CI path);
+                                     # "process" = one worker subprocess
+                                     # per replica (fleet/worker.py line
+                                     # protocol + /healthz endpoint)
+    router: str = "prefix_affinity"  # dispatch policy: route to the
+                                     # replica whose radix prefix cache
+                                     # most likely holds the prompt head,
+                                     # least-loaded fallback; or pure
+                                     # "least_loaded"
+    affinity_queue_factor: float = 2.0
+                                     # affinity yields to least-loaded
+                                     # when the affine replica's queue
+                                     # exceeds factor * slot_cap (a hot
+                                     # prefix must not melt one replica)
+    affinity_index_size: int = 512   # prompt-head runs remembered per
+                                     # replica (LRU) by the router
+    disaggregate: bool = False       # split roles: prefill replicas run
+                                     # chunked prefill + first token then
+                                     # hand page-granular KV to decode
+                                     # replicas (requires serving.paging)
+    prefill_replicas: int = 1        # leading replicas that take the
+                                     # prefill role when disaggregated
+    health_every_steps: int = 8      # fleet steps between health sweeps
+    max_missed_health: int = 2       # consecutive missed checks before a
+                                     # replica is declared dead and its
+                                     # in-flight requests requeue through
+                                     # the router
+    autoscale: bool = False          # act on ServingAutoscaler
+                                     # target_replicas: spawn on
+                                     # sustained backlog, drain via the
+                                     # preemption/slot-cap path on
+                                     # scale-down
+    min_replicas: int = 1
+    max_replicas: int = 8
+    autoscale_every_steps: int = 16  # fleet steps between autoscaler
+                                     # observations
+    replica_telemetry: bool = False  # per-replica /metrics endpoints on
+                                     # ephemeral ports (the router-level
+                                     # endpoint is separate — see
+                                     # ServingFleet.start_telemetry)
+
+    def validate(self, serving_config=None) -> "FleetConfig":
+        if self.replicas < 1:
+            raise ValueError(
+                f"serving.fleet.replicas must be >= 1, got {self.replicas}")
+        if self.backend not in BACKENDS:
+            raise ValueError(
+                f"serving.fleet.backend must be one of {BACKENDS}, got "
+                f"{self.backend!r}")
+        if self.router not in ROUTERS:
+            raise ValueError(
+                f"serving.fleet.router must be one of {ROUTERS}, got "
+                f"{self.router!r}")
+        if self.affinity_queue_factor <= 0:
+            raise ValueError(
+                "serving.fleet.affinity_queue_factor must be > 0, got "
+                f"{self.affinity_queue_factor}")
+        if self.affinity_index_size < 1:
+            raise ValueError(
+                "serving.fleet.affinity_index_size must be >= 1, got "
+                f"{self.affinity_index_size}")
+        if self.disaggregate:
+            if self.replicas < 2:
+                raise ValueError(
+                    "serving.fleet.disaggregate needs >= 2 replicas "
+                    "(at least one prefill and one decode), got "
+                    f"{self.replicas}")
+            if not 1 <= self.prefill_replicas < self.replicas:
+                raise ValueError(
+                    f"serving.fleet.prefill_replicas must satisfy 1 <= n "
+                    f"< replicas ({self.replicas}), got "
+                    f"{self.prefill_replicas}")
+            if serving_config is not None and not serving_config.paged:
+                raise ValueError(
+                    "serving.fleet.disaggregate requires the block-paged "
+                    "KV cache (serving.paging) — the prefill->decode "
+                    "handoff is a page transfer")
+        if self.health_every_steps < 1:
+            raise ValueError(
+                "serving.fleet.health_every_steps must be >= 1, got "
+                f"{self.health_every_steps}")
+        if self.max_missed_health < 1:
+            raise ValueError(
+                "serving.fleet.max_missed_health must be >= 1, got "
+                f"{self.max_missed_health}")
+        if self.min_replicas < 1 or self.max_replicas < self.min_replicas:
+            raise ValueError(
+                "serving.fleet needs 1 <= min_replicas <= max_replicas, "
+                f"got min={self.min_replicas} max={self.max_replicas}")
+        if self.autoscale_every_steps < 1:
+            raise ValueError(
+                "serving.fleet.autoscale_every_steps must be >= 1, got "
+                f"{self.autoscale_every_steps}")
+        if self.disaggregate and self.min_replicas < 2:
+            # a disaggregated fleet can never drain below one prefill +
+            # one decode replica
+            self.min_replicas = 2
+        return self
+
+    def role_for(self, replica_id: int) -> str:
+        """Role of replica ``replica_id`` at spawn: the leading
+        ``prefill_replicas`` take the prefill role when disaggregated,
+        everything else serves end-to-end ("full") or decode-only."""
+        if not self.disaggregate:
+            return "full"
+        return ("prefill" if replica_id < self.prefill_replicas
+                else "decode")

@@ -1,0 +1,96 @@
+"""Handoff wire format: page-granular prefill state between replicas.
+
+A handoff payload is what ``ServingEngine.export_handoff`` produces and
+``inject_handoff`` consumes — the complete state a decode replica needs
+to continue a prefilled request TOKEN-EXACTLY with zero prefill
+recompute:
+
+- ``kv``: the prefilled pages' contents (one record per attention unit
+  in deterministic tree order; int8 pages travel int8 WITH their scale
+  planes — no requantization round-trip on the wire);
+- ``prefill_len`` / ``n_pages_filled``: the prefill frontier (pages past
+  it are unwritten budget and never travel);
+- ``state``: the sampler handover (last sampled token + remaining
+  budget);
+- ``request``: prompt tokens, already-generated tokens, budget, id,
+  priority — enough to rebuild the ``Request`` on the receiver.
+
+In-process fleets pass the payload dict by reference.
+``serialize_handoff``/``deserialize_handoff`` flatten it to one
+self-describing ``.npz`` byte blob for a process/network boundary (the
+fleet worker protocol base64s it over the pipe). Versioned: receivers
+refuse unknown ``version`` values loudly rather than guessing.
+"""
+
+import io
+import json
+from typing import Dict
+
+import numpy as np
+
+HANDOFF_VERSION = 1
+# payload keys that are numpy arrays at the top level
+_ARRAY_META = ("prompt",)
+
+
+def handoff_nbytes(payload: Dict) -> int:
+    """Wire bytes of the page transfer itself (the figure the fleet
+    bench reports): KV page contents + scale planes only."""
+    return sum(int(a.nbytes) for rec in payload["kv"]
+               for a in rec.values())
+
+
+def serialize_handoff(payload: Dict) -> bytes:
+    """Flatten a handoff payload to one ``.npz`` blob. Unit records key
+    as ``kv/<unit index>/<leaf name>`` — tree ORDER carries structure
+    (both ends walk the pool with the same deterministic traversal), so
+    no path strings need to survive the wire."""
+    meta = {
+        "version": payload["version"],
+        "page_len": payload["page_len"],
+        "kv_quant": payload["kv_quant"],
+        "prefill_len": payload["prefill_len"],
+        "n_pages_filled": payload["n_pages_filled"],
+        "n_units": len(payload["kv"]),
+        "state": payload["state"],
+        "request": {k: v for k, v in payload["request"].items()
+                    if k not in _ARRAY_META},
+    }
+    arrays = {"request/prompt": np.asarray(payload["request"]["prompt"],
+                                           np.int32)}
+    for i, rec in enumerate(payload["kv"]):
+        for name, arr in rec.items():
+            arrays[f"kv/{i}/{name}"] = arr
+    arrays["meta"] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+def deserialize_handoff(blob: bytes) -> Dict:
+    """Rebuild the payload dict ``inject_handoff`` consumes from a
+    ``serialize_handoff`` blob."""
+    with np.load(io.BytesIO(blob)) as z:
+        meta = json.loads(bytes(z["meta"].tobytes()).decode("utf-8"))
+        if meta.get("version") != HANDOFF_VERSION:
+            raise ValueError(
+                f"unknown handoff wire version {meta.get('version')!r} "
+                f"(this build speaks {HANDOFF_VERSION})")
+        kv = []
+        for i in range(meta["n_units"]):
+            prefix = f"kv/{i}/"
+            kv.append({k[len(prefix):]: z[k] for k in z.files
+                       if k.startswith(prefix)})
+        request = dict(meta["request"])
+        request["prompt"] = z["request/prompt"]
+    return {
+        "version": meta["version"],
+        "page_len": meta["page_len"],
+        "kv_quant": meta["kv_quant"],
+        "prefill_len": meta["prefill_len"],
+        "n_pages_filled": meta["n_pages_filled"],
+        "kv": kv,
+        "state": meta["state"],
+        "request": request,
+    }

@@ -1,0 +1,679 @@
+"""Replica manager + fleet front-end: the layer above one engine.
+
+``ServingFleet`` owns N supervised replicas (``fleet/replica.py``), a
+prefix-affinity router (``fleet/router.py``), the disaggregated
+prefill->decode page-handoff pump, dead-replica failover, and the
+closed autoscaling loop (``elasticity/serving_autoscaler.py``
+``target_replicas`` finally ACTS here: sustained backlog spawns
+replicas, scale-down drains through the PR-10 preemption/slot-cap path).
+
+The fleet runs on its own deterministic step clock: one ``advance()``
+advances every live replica one engine iteration (lockstep), then moves
+handoffs, detects deaths, and evaluates scaling. Every decision reads
+host ints snapshotted on that clock, so a replayed trace reproduces the
+same dispatch/handoff/failover sequence bit-exactly — the engine-level
+replay discipline, one level up.
+
+Clients hold ``FleetRequest`` handles: one stable object per request no
+matter how many replicas serve it (prefill -> decode handoff, failover
+re-prefill). Tokens stream into the handle from whichever replica
+currently owns the request; under greedy sampling the merged stream is
+bit-equal to a single uninterrupted engine (the QoS resume guarantee,
+inherited wholesale).
+"""
+
+import time
+from collections import deque
+from dataclasses import replace
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...utils.logging import log_dist
+from ..request import Request
+from .config import FleetConfig
+from .replica import LocalReplica, ProcessReplica, ReplicaDead
+from .router import Router
+
+TERMINAL = ("finished", "timeout", "cancelled", "shed")
+LOG_LIMIT = 4096     # dispatch/handoff log entries kept (replay asserts
+                     # run over bounded traces; a long-lived server must
+                     # not grow them forever)
+
+
+class FleetRequest:
+    """One client request as the FLEET sees it: a stable handle whose
+    tokens/status survive handoffs and replica deaths. Field names
+    mirror ``serving.request.Request`` so the bench/CLI reporting paths
+    work on either."""
+
+    def __init__(self, prompt, max_new_tokens: int, request_id,
+                 priority: int = 0, on_token=None):
+        self.request_id = request_id
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.priority = int(priority)
+        self.on_token = on_token
+        self.tokens: List[int] = []
+        self.status = "queued"
+        self.shed_reason: Optional[str] = None
+        self.replica_id: Optional[int] = None   # current owner (None
+                                                # while a handoff is in
+                                                # transit)
+        self.prefill_replica_id: Optional[int] = None
+        self.handoffs = 0
+        self.failovers = 0
+        self.preemptions = 0
+        self._inner: Optional[Request] = None   # local-backend engine req
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        # fleet-clock stamps (deterministic run-to-run)
+        self.submitted_iteration: Optional[int] = None
+        self.first_token_iteration: Optional[int] = None
+        self.finished_iteration: Optional[int] = None
+
+    @property
+    def done(self) -> bool:
+        return self.status in TERMINAL
+
+    @property
+    def output_tokens(self) -> List[int]:
+        return list(self.tokens)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finished_at is None:
+            return None
+        return self.finished_at - self.submitted_at
+
+    def remaining_budget(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    def effective_prompt(self) -> np.ndarray:
+        if not self.tokens:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens, np.int32)])
+
+    def __repr__(self):
+        return (f"FleetRequest(id={self.request_id!r}, "
+                f"status={self.status}, replica={self.replica_id}, "
+                f"generated={len(self.tokens)}/{self.max_new_tokens}, "
+                f"handoffs={self.handoffs}, failovers={self.failovers})")
+
+
+class ServingFleet:
+    """N supervised replicas behind one prefix-affinity front end.
+
+    Usage (the single-engine surface, one level up)::
+
+        fleet = ServingFleet(module, params, cfg)   # cfg.fleet block set
+        reqs = [fleet.submit(p, max_new_tokens=32) for p in prompts]
+        fleet.run()
+        reqs[0].output_tokens
+        fleet.close()
+
+    ``backend="process"`` ignores ``module/params`` and spawns
+    ``fleet/worker.py`` subprocesses from ``spec`` (model/checkpoint +
+    serving config dict) — each its own device world and telemetry
+    endpoint.
+    """
+
+    def __init__(self, module, params, config, *, spec: Optional[dict] =
+                 None, monitor=None):
+        from ..config import ServingConfig
+        if isinstance(config, dict):
+            config = ServingConfig(**config)
+        self.config = config.validate()
+        if not self.config.fleet_enabled:
+            raise ValueError("ServingFleet needs an enabled serving.fleet "
+                             "block (plain ServingEngine serves without "
+                             "one)")
+        self.fcfg: FleetConfig = self.config.fleet
+        self._module = module
+        self._params = params
+        # replicas never see the fleet block: a replica IS the leaf
+        self._replica_config = replace(self.config, fleet=None)
+        self._spec = spec
+        if self.fcfg.backend == "process" and spec is None:
+            raise ValueError(
+                "backend='process' needs spec= (model/checkpoint + "
+                "serving config dict) — workers rebuild the engine from "
+                "it")
+        page_len = (self.config.paging.page_len if self.config.paged
+                    else self.config.prefill_bucket)
+        self.router = Router(self.fcfg, page_len)
+        self._replicas: Dict[int, object] = {}
+        self._next_rid = 0
+        self._failed = set()            # rids whose failover already ran
+        self._handles: Dict[object, FleetRequest] = {}   # LIVE handles
+        self._handoff_backlog = deque() # [(payload, handle|None)]
+        self._iteration = 0
+        self.dispatch_log: List[tuple] = []   # (request_id, replica_id)
+        self.handoff_log: List[tuple] = []    # (request_id, src, dst) —
+                                              # capped at LOG_LIMIT
+        self.handoffs_completed = 0           # monotonic (the log trims)
+        self.failovers = 0
+        self.replicas_spawned = 0
+        self.replicas_retired = 0
+        self.dead_replicas = 0
+        self.requests_submitted = 0
+        self.requests_finished = 0
+        self.last_scale_decision: Optional[dict] = None
+        self.telemetry = None
+        self._scaler = None
+        if self.fcfg.autoscale:
+            from ...elasticity.serving_autoscaler import (
+                ServingAutoscaleConfig, ServingAutoscaler)
+            from ...observability.metrics import MetricsRegistry
+            self._scale_registry = MetricsRegistry()
+            self._scaler = ServingAutoscaler(
+                engine=None,
+                config=ServingAutoscaleConfig(
+                    min_slots=1, max_replicas=self.fcfg.max_replicas),
+                registry=self._scale_registry,
+                replica_slots=self.config.num_slots)
+        for _ in range(self.fcfg.replicas):
+            self._spawn_replica()
+        self.replicas_spawned = 0       # construction is not a scale-up
+        log_dist(
+            f"serving fleet: {len(self._replicas)} replicas "
+            f"({self.fcfg.backend}, router={self.fcfg.router}"
+            f"{', disaggregated ' + str(self.fcfg.prefill_replicas) + ' prefill' if self.fcfg.disaggregate else ''})",
+            ranks=[0])
+
+    # -- replica lifecycle -------------------------------------------------
+    def _spawn_replica(self, role: Optional[str] = None):
+        rid = self._next_rid
+        self._next_rid += 1
+        role = role or self.fcfg.role_for(rid)
+        if self.fcfg.backend == "process":
+            port = 0 if self.fcfg.replica_telemetry else None
+            rep = ProcessReplica(rid, role,
+                                 {**self._spec, "telemetry_port": port})
+        else:
+            rep = LocalReplica(rid, role, self._module, self._params,
+                               self._replica_config,
+                               telemetry=self.fcfg.replica_telemetry)
+        self._replicas[rid] = rep
+        self.replicas_spawned += 1
+        return rep
+
+    def kill_replica(self, rid: int):
+        """Hard-kill one replica (the chaos/failover hook): the next
+        ``advance()`` detects the death and requeues its in-flight
+        requests through the router."""
+        self._replicas[rid].kill()
+
+    def _alive(self, roles=None) -> List[int]:
+        return [rid for rid, rep in sorted(self._replicas.items())
+                if rep.alive and (roles is None or rep.role in roles)]
+
+    def _stats(self, rids) -> List:
+        return [self._replicas[r].stats() for r in rids]
+
+    def _submit_roles(self):
+        return ("prefill",) if self.fcfg.disaggregate else ("full",)
+
+    # -- client API --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               request_id=None, priority: int = 0,
+               on_token=None) -> FleetRequest:
+        """Route one request to a replica (prefix affinity or least
+        loaded) and return its fleet-level handle."""
+        if max_new_tokens is None:
+            max_new_tokens = self.config.default_max_new_tokens
+        if request_id is None:
+            request_id = f"f{self.requests_submitted}"
+        eligible = self._alive(self._submit_roles())
+        if not eligible:
+            raise RuntimeError("fleet: no live replica accepts submissions")
+        target = self.router.route(
+            np.asarray(prompt, np.int32), self._stats(eligible),
+            step=self._iteration, request_id=request_id)
+        handle = FleetRequest(prompt, max_new_tokens, request_id,
+                              priority=priority, on_token=on_token)
+        handle.submitted_iteration = self._iteration
+        self.requests_submitted += 1
+        self.dispatch_log.append((request_id, target))
+        del self.dispatch_log[:-LOG_LIMIT]
+        self._dispatch(handle, target, handle.prompt, max_new_tokens)
+        return handle
+
+    def _on_token_cb(self, handle: FleetRequest):
+        def cb(_req, token):
+            if handle.first_token_at is None:
+                handle.first_token_at = time.perf_counter()
+                handle.first_token_iteration = self._iteration
+            handle.tokens.append(int(token))
+            if handle.on_token is not None:
+                handle.on_token(handle, int(token))
+        return cb
+
+    def _dispatch(self, handle: FleetRequest, rid: int, prompt,
+                  max_new: int):
+        rep = self._replicas[rid]
+        handle.replica_id = rid
+        if handle.prefill_replica_id is None:
+            handle.prefill_replica_id = rid
+        if rep.backend == "inprocess":
+            inner = rep.submit(prompt, max_new,
+                               request_id=handle.request_id,
+                               priority=handle.priority,
+                               on_token=self._on_token_cb(handle))
+            handle._inner = inner
+            if inner.done:          # QoS shed/refused at submit
+                self._finalize(handle, inner.status, inner.shed_reason)
+                return
+        else:
+            try:
+                reply = rep.submit(prompt, max_new,
+                                   request_id=handle.request_id,
+                                   priority=handle.priority)
+            except ReplicaDead:
+                # undetected death discovered at dispatch time (e.g. an
+                # OOM-killed worker between health sweeps): reroute NOW
+                # — the request must not ride a corpse or get lost; the
+                # death sweep reaps the replica next advance. Bounded:
+                # each retry excludes one more dead replica.
+                eligible = self._alive(self._submit_roles())
+                if not eligible:
+                    raise RuntimeError(
+                        "fleet: no live replica accepts submissions")
+                target = self.router.route(
+                    prompt, self._stats(eligible), step=self._iteration,
+                    request_id=handle.request_id)
+                self.dispatch_log.append((handle.request_id, target))
+                del self.dispatch_log[:-LOG_LIMIT]
+                return self._dispatch(handle, target, prompt, max_new)
+            if reply.get("status") in TERMINAL:
+                self._finalize(handle, reply["status"], None)
+                return
+        self._handles[handle.request_id] = handle
+
+    def _finalize(self, handle: FleetRequest, status: str,
+                  shed_reason=None):
+        handle.status = status
+        handle.shed_reason = shed_reason
+        handle.finished_at = time.perf_counter()
+        handle.finished_iteration = self._iteration
+        handle._inner = None
+        if status == "finished":
+            self.requests_finished += 1
+        self._handles.pop(handle.request_id, None)
+
+    # -- the fleet step ----------------------------------------------------
+    def advance(self):
+        """One fleet iteration: detect deaths and fail their requests
+        over, advance every live replica one engine step (lockstep),
+        harvest completions, pump page handoffs, run the health sweep
+        and the autoscaler on their cadences."""
+        for rid, rep in sorted(self._replicas.items()):
+            if not rep.alive and rid not in self._failed:
+                self._fail_replica(rid)
+        if not self._alive():
+            raise RuntimeError(
+                "fleet: every replica is dead — nothing left to serve "
+                "the backlog")
+        if self.fcfg.disaggregate and self.busy:
+            for role in ("prefill", "decode"):
+                if not self._alive((role,)):
+                    # a one-sided fleet can neither prefill nor finish:
+                    # fail loudly (containment = partial snapshot +
+                    # restart) instead of spinning on a stalled backlog
+                    raise RuntimeError(
+                        f"fleet: disaggregated fleet lost every {role} "
+                        "replica — in-flight work cannot complete")
+        handoff_ready = []   # [(rid, id)] from process replicas
+        for rid in self._alive():
+            rep = self._replicas[rid]
+            if rep.backend == "inprocess":
+                rep.advance()    # ReplicaCrash propagates: in-process
+                                 # crashes are fatal (see replica.py)
+            else:
+                try:
+                    reply = rep.advance()
+                except ReplicaDead:
+                    continue     # detected at the top of the next step
+                self._apply_worker_reply(rid, reply)
+                handoff_ready.extend((rid, hid)
+                                     for hid in reply.get("handoff_ready",
+                                                          []))
+        self._harvest_local()
+        self._pump_handoffs(handoff_ready)
+        if self._iteration % self.fcfg.health_every_steps == 0:
+            self._health_sweep()
+        if self._scaler is not None and \
+                self._iteration % self.fcfg.autoscale_every_steps == 0:
+            self._autoscale_tick()
+        self._iteration += 1
+
+    @property
+    def iteration(self) -> int:
+        """Fleet step counter — the deterministic clock traces replay
+        against (the fleet mirror of ``ServingEngine.iteration``)."""
+        return self._iteration
+
+    @property
+    def busy(self) -> bool:
+        return bool(self._handles) or bool(self._handoff_backlog)
+
+    def run(self, max_iterations: Optional[int] = None):
+        it = 0
+        while self.busy:
+            self.advance()
+            it += 1
+            if max_iterations is not None and it >= max_iterations:
+                break
+
+    # -- harvest -----------------------------------------------------------
+    def _harvest_local(self):
+        for handle in list(self._handles.values()):
+            inner = handle._inner
+            if inner is not None and inner.done:
+                self._finalize(handle, inner.status, inner.shed_reason)
+
+    def _apply_worker_reply(self, rid: int, reply: dict):
+        for hid, token, _it in reply.get("events", []):
+            handle = self._handles.get(hid)
+            if handle is None or handle.replica_id != rid:
+                continue
+            if handle.first_token_at is None:
+                handle.first_token_at = time.perf_counter()
+                handle.first_token_iteration = self._iteration
+            handle.tokens.append(int(token))
+            if handle.on_token is not None:
+                handle.on_token(handle, int(token))
+        for rec in reply.get("finished", []):
+            handle = self._handles.get(rec["id"])
+            if handle is not None and handle.replica_id == rid:
+                self._finalize(handle, rec["status"],
+                               rec.get("shed_reason"))
+
+    # -- disaggregated handoff pump ---------------------------------------
+    def _pump_handoffs(self, process_ready):
+        """Export every staged prefill and inject into the least-loaded
+        decode replica; page-starved injections stay in the backlog and
+        retry next step (deterministic: backlog order is FIFO on the
+        fleet clock)."""
+        for rid in self._alive(("prefill",)):
+            rep = self._replicas[rid]
+            if rep.backend != "inprocess":
+                continue
+            for slot, req in rep.take_handoff_ready():
+                handle = self._handles.get(req.request_id)
+                payload = rep.export_handoff(slot, req)
+                if handle is not None:
+                    handle.replica_id = None       # in transit
+                self._handoff_backlog.append((payload, handle))
+        for rid, hid in process_ready:
+            rep = self._replicas[rid]
+            if not rep.alive:
+                continue
+            handle = self._handles.get(hid)
+            try:
+                payload = rep.export_handoff_by_id(hid)
+            except ReplicaDead:
+                continue       # the death sweep requeues from the handle
+            if handle is not None:
+                handle.replica_id = None
+            self._handoff_backlog.append((payload, handle))
+        retry = deque()
+        while self._handoff_backlog:
+            payload, handle = self._handoff_backlog.popleft()
+            decode = self._alive(("decode",))
+            # refresh load per injection: a burst of handoffs must fan
+            # out across decode replicas, not pile onto one snapshot
+            target = self.router.pick_least_loaded(self._stats(decode)) \
+                if decode else None
+            if target is None:
+                retry.append((payload, handle))
+                continue
+            rep = self._replicas[target]
+            accepted = self._inject(rep, payload, handle)
+            if not accepted:
+                retry.append((payload, handle))
+                continue
+            src = (handle.prefill_replica_id if handle is not None
+                   else None)
+            hid = payload["request"]["request_id"]
+            self.handoffs_completed += 1
+            self.handoff_log.append((hid, src, target))
+            del self.handoff_log[:-LOG_LIMIT]
+            if handle is not None:
+                handle.replica_id = target
+                handle.handoffs += 1
+        self._handoff_backlog = retry
+
+    def _inject(self, rep, payload, handle) -> bool:
+        if rep.backend == "inprocess":
+            live = rep.inject_handoff(
+                payload, on_token=(self._on_token_cb(handle)
+                                   if handle is not None else None))
+            if live is None:
+                return False
+            if handle is not None:
+                handle._inner = live
+            return True
+        try:
+            return rep.inject_handoff(payload)
+        except ReplicaDead:
+            return False
+
+    # -- failure containment ----------------------------------------------
+    def _health_sweep(self):
+        """Cadenced probe (every ``health_every_steps``): a hard death
+        (process exit, kill) fails over immediately; a wedged-but-alive
+        process replica (live pid, dead /healthz) accumulates misses and
+        fails over after ``max_missed_health`` consecutive ones."""
+        for rid in list(self._alive()):
+            rep = self._replicas[rid]
+            state = rep.probe_health()
+            if state == "ok":
+                rep.missed_health = 0
+                continue
+            if state == "dead":
+                self._fail_replica(rid)
+                continue
+            rep.missed_health += 1
+            if rep.missed_health >= self.fcfg.max_missed_health:
+                rep.alive = False
+                self._fail_replica(rid)
+
+    def _fail_replica(self, rid: int):
+        """Dead-replica containment — the fleet-level mirror of
+        ``engine.recover()``: forget its router affinity, requeue every
+        request it owned through the router with generated tokens
+        RETAINED (the continuation re-prefills prompt + partial output
+        elsewhere — token-exact under greedy sampling, the PR-10 resume
+        guarantee), and reap the corpse."""
+        rep = self._replicas[rid]
+        rep.alive = False
+        self._failed.add(rid)
+        self.dead_replicas += 1
+        self.router.forget_replica(rid)
+        victims = [h for h in self._handles.values()
+                   if h.replica_id == rid and not h.done]
+        for handle in victims:
+            self._failover(handle)
+        try:
+            rep.kill()
+        except Exception:   # ds-tpu: lint-ok[PY001] — reaping a corpse
+            # must never take the fleet down with it
+            pass
+        log_dist(f"fleet: replica {rid} dead — {len(victims)} requests "
+                 "requeued through the router", ranks=[0])
+
+    def _failover(self, handle: FleetRequest):
+        """Re-dispatch one orphaned request: continuation = original
+        prompt + retained tokens, budget = what is still owed."""
+        handle.failovers += 1
+        handle.preemptions += 1
+        self.failovers += 1
+        handle._inner = None
+        remaining = handle.remaining_budget()
+        if remaining <= 0:          # owed nothing more: call it finished
+            self._finalize(handle, "finished")
+            return
+        eligible = self._alive(self._submit_roles())
+        if not eligible:
+            raise RuntimeError(
+                "fleet: no live replica left to fail requests over to")
+        target = self.router.route(
+            handle.effective_prompt(), self._stats(eligible),
+            step=self._iteration, request_id=handle.request_id)
+        self.dispatch_log.append((handle.request_id, target))
+        del self.dispatch_log[:-LOG_LIMIT]
+        self._dispatch(handle, target, handle.effective_prompt(),
+                       remaining)
+
+    # -- closed-loop autoscaling ------------------------------------------
+    def _autoscale_tick(self):
+        """Publish fleet totals as the gauges the autoscaler reads, then
+        ACT on its recommendation: spawn replicas toward
+        ``target_replicas`` on sustained backlog, retire one (drained
+        via the preemption/slot-cap path) on sustained idleness."""
+        alive = self._alive()
+        stats = self._stats(alive)
+        reg = self._scale_registry
+        reg.gauge("serving/queue_depth").set(
+            sum(s.queue_depth for s in stats))
+        reg.gauge("serving/active_slots").set(
+            sum(s.active_slots for s in stats))
+        reg.gauge("serving/slot_cap").set(
+            sum(s.slot_cap for s in stats))
+        decision = self._scaler.observe()
+        self.last_scale_decision = decision
+        if decision["action"] == "scale_up":
+            target = min(decision["target_replicas"],
+                         self.fcfg.max_replicas)
+            while len(self._alive()) < target:
+                rep = self._spawn_replica()
+                log_dist(f"fleet: scale-up -> spawned replica "
+                         f"{rep.replica_id} ({decision['reason']})",
+                         ranks=[0])
+        elif decision["action"] == "scale_down":
+            if len(alive) > self.fcfg.min_replicas:
+                rid = self._pick_retirable(alive)
+                if rid is not None:
+                    self._retire_replica(rid)
+
+    def _pick_retirable(self, alive):
+        """Highest-id replica whose removal keeps the fleet serviceable.
+        Disaggregated fleets are role-aware: only a role with >= 2 live
+        members may shrink (losing the last decode — or prefill —
+        replica bricks the fleet regardless of the total count), decode
+        capacity drains before prefill (autoscale spawns rejoin as
+        decode). None = nothing is safely retirable."""
+        if not self.fcfg.disaggregate:
+            return max(alive)
+        by_role = {}
+        for rid in alive:
+            by_role.setdefault(self._replicas[rid].role, []).append(rid)
+        for role in ("decode", "full", "prefill"):
+            rids = by_role.get(role, [])
+            if len(rids) > 1:
+                return max(rids)
+        return None
+
+    def pick_disposable_replica(self) -> int:
+        """The chaos/retire victim selector the kill hooks share: the
+        highest-id live replica whose death the fleet can absorb
+        (role-aware under disaggregation); falls back to the highest id
+        when nothing is safely disposable — the caller asked for a
+        kill, so a bricking kill is honored loudly rather than
+        silently skipped."""
+        alive = self._alive()
+        rid = self._pick_retirable(alive)
+        return rid if rid is not None else max(alive)
+
+    def _retire_replica(self, rid: int):
+        """Graceful scale-down: drain the replica through the PR-10
+        preemption/slot-cap path (active requests preempted with tokens
+        retained), re-dispatch everything it still owns through the
+        router, then stop it."""
+        rep = self._replicas[rid]
+        if rep.backend == "inprocess":
+            rep.engine.set_slot_cap(1)      # preemption-path drain
+        victims = [h for h in self._handles.values()
+                   if h.replica_id == rid and not h.done]
+        rep.alive = False                   # no more routing to it
+        self._failed.add(rid)               # failover already handled here
+        self.router.forget_replica(rid)
+        for handle in victims:
+            self._failover(handle)
+        rep.stop()
+        self.replicas_retired += 1
+        log_dist(f"fleet: scale-down -> retired replica {rid} "
+                 f"({len(victims)} requests re-dispatched)", ranks=[0])
+
+    # -- telemetry ---------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The fleet section of /statusz: per-replica stats + serving
+        snapshots, router policy/decisions, handoff + failover + scaling
+        counters. Host state only."""
+        replicas = {}
+        for rid, rep in sorted(self._replicas.items()):
+            entry = {"role": rep.role, "alive": rep.alive,
+                     **rep.stats().to_dict()}
+            if rep.backend == "inprocess":
+                # a dead engine's host-side metrics stay readable: the
+                # work it served before dying must not vanish from the
+                # per-replica breakdown (or the kill-run bench block)
+                entry["serving"] = rep.engine.metrics.snapshot()
+            entry["telemetry_port"] = rep.telemetry_port
+            replicas[str(rid)] = entry
+        return {
+            "iteration": self._iteration,
+            "backend": self.fcfg.backend,
+            "disaggregate": self.fcfg.disaggregate,
+            "replicas": replicas,
+            "router": self.router.stats(),
+            "handoffs_in_transit": len(self._handoff_backlog),
+            "handoffs_completed": self.handoffs_completed,
+            "failovers": self.failovers,
+            "dead_replicas": self.dead_replicas,
+            "replicas_spawned": self.replicas_spawned,
+            "replicas_retired": self.replicas_retired,
+            "requests_submitted": self.requests_submitted,
+            "requests_finished": self.requests_finished,
+            "autoscale": self.last_scale_decision,
+        }
+
+    def metrics_snapshot(self) -> dict:
+        """The router-level /statusz payload: the process registry plus
+        the fleet section (observability/export.py renders it)."""
+        from ...observability.metrics import get_registry
+        return {"registry": get_registry().snapshot(),
+                "fleet": self.snapshot()}
+
+    def start_telemetry(self, port: int = 0, host: str = "127.0.0.1"):
+        """Router-level /metrics + /healthz + /statusz (the fleet
+        section rides /statusz); per-replica endpoints are separate
+        (``serving.fleet.replica_telemetry``)."""
+        if self.telemetry is not None:
+            return self.telemetry
+        from ...observability.export import TelemetryServer
+        self.telemetry = TelemetryServer(self.metrics_snapshot, host=host,
+                                         port=port).start()
+        log_dist(f"fleet telemetry: http://{host}:{self.telemetry.port}"
+                 "/statusz", ranks=[0])
+        return self.telemetry
+
+    def close(self):
+        if self.telemetry is not None:
+            t, self.telemetry = self.telemetry, None
+            t.stop()
+        for rep in self._replicas.values():
+            try:
+                rep.stop()
+            except Exception:   # ds-tpu: lint-ok[PY001] — teardown must
+                # reach every replica even when one refuses to die
+                pass

@@ -1,0 +1,363 @@
+"""Replica wrappers: one supervised ServingEngine, local or subprocess.
+
+Two backends behind one narrow surface (``submit`` / ``advance`` /
+``stats`` / ``healthy`` / handoff export+inject / ``stop``):
+
+- ``LocalReplica`` — an in-process ``ServingEngine`` driven in lockstep
+  on the fleet clock. The deterministic/CI path: stats are host ints
+  read synchronously, tokens stream through ``on_token`` callbacks, and
+  a replayed trace reproduces every dispatch bit-exactly.
+- ``ProcessReplica`` — one worker subprocess (``fleet/worker.py``) per
+  replica over a line-JSON pipe protocol, each with its own telemetry
+  endpoint (``/metrics`` + ``/healthz`` on its own port — the PR-8
+  plane, per process). Exchanges are synchronous request/response, so
+  dispatch order stays deterministic; wall-clock effects enter only
+  through process scheduling, which the protocol never consults.
+
+Failure matrix (docs/serving.md "Multi-replica fleet"):
+
+- a DETECTED dead replica (missed health checks, worker process exit,
+  ``kill()``) is contained — the manager requeues its in-flight
+  requests through the router, the fleet-level mirror of
+  ``engine.recover()``;
+- an UNHANDLED exception out of an in-process replica's ``advance()``
+  is fatal by design: replicas share the process, so a crash mid-
+  dispatch means shared state (donated device buffers, watchdog
+  threads) can no longer be trusted — the serve CLI emits its partial
+  fleet snapshot and exits nonzero for the orchestrator to restart
+  (``ReplicaCrash`` is the chaos hook's vehicle).
+"""
+
+import base64
+import json
+import os
+import select
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...utils.logging import log_dist
+from .handoff import deserialize_handoff, serialize_handoff
+
+PROTOCOL_SENTINEL = "@fleet "
+
+
+class ReplicaCrash(RuntimeError):
+    """An in-process replica died mid-advance (chaos injection or a real
+    engine fault): the fleet process is compromised — containment is a
+    partial snapshot + nonzero exit, not failover."""
+
+
+class ReplicaDead(RuntimeError):
+    """A process replica stopped answering the pipe protocol."""
+
+
+@dataclass
+class ReplicaStats:
+    """One replica's dispatch-relevant state, snapshotted on the fleet
+    step clock — the same host ints its ``/metrics`` plane exports
+    (queue-depth / active-slot gauges, per-class TTFT), read without the
+    scrape race so routing replays bit-exactly."""
+    replica_id: int
+    alive: bool = True
+    role: str = "full"
+    iteration: int = 0
+    queue_depth: int = 0
+    active_slots: int = 0
+    num_slots: int = 0
+    slot_cap: int = 0
+    free_slots: int = 0
+    class_ttft_p95: Dict[str, float] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"replica_id": self.replica_id, "alive": self.alive,
+                "role": self.role, "iteration": self.iteration,
+                "queue_depth": self.queue_depth,
+                "active_slots": self.active_slots,
+                "num_slots": self.num_slots, "slot_cap": self.slot_cap,
+                "free_slots": self.free_slots,
+                "class_ttft_p95": dict(self.class_ttft_p95)}
+
+
+def engine_stats(engine, replica_id: int, role: str,
+                 alive: bool = True) -> ReplicaStats:
+    """Build a ``ReplicaStats`` snapshot from a live engine's host
+    state (shared by LocalReplica and the worker's stats reply)."""
+    active = sum(r is not None for r in engine._slot_req)
+    return ReplicaStats(
+        replica_id=replica_id, alive=alive, role=role,
+        iteration=engine.iteration,
+        queue_depth=engine.scheduler.depth,
+        active_slots=active,
+        num_slots=engine.config.num_slots,
+        slot_cap=engine.slot_cap,
+        free_slots=engine.num_free_slots,
+        class_ttft_p95={
+            name: p95 for name in list(engine.metrics.per_class)
+            if (p95 := engine.metrics.class_ttft_p95(name)) is not None})
+
+
+class LocalReplica:
+    """One in-process engine under fleet supervision."""
+
+    backend = "inprocess"
+
+    def __init__(self, replica_id: int, role: str, module, params, config,
+                 *, telemetry: bool = False):
+        from ..engine import ServingEngine
+        self.replica_id = replica_id
+        self.role = role
+        self.engine = ServingEngine(module, params, config)
+        if role == "prefill":
+            self.engine.set_prefill_role(True)
+        self.alive = True
+        self.missed_health = 0
+        self.fail_at: Optional[int] = None   # chaos: raise ReplicaCrash
+                                             # once the clock passes this
+        if telemetry:
+            self.engine.start_telemetry(port=0)
+
+    @property
+    def telemetry_port(self) -> Optional[int]:
+        t = self.engine.telemetry
+        return t.port if t is not None else None
+
+    def submit(self, prompt, max_new_tokens, request_id, priority=0,
+               on_token=None):
+        return self.engine.submit(prompt, max_new_tokens,
+                                  request_id=request_id, on_token=on_token,
+                                  priority=priority)
+
+    def advance(self):
+        if self.fail_at is not None and \
+                self.engine.iteration >= self.fail_at:
+            self.alive = False
+            raise ReplicaCrash(
+                f"replica {self.replica_id} crashed at iteration "
+                f"{self.engine.iteration} (injected)")
+        self.engine.advance()
+
+    def stats(self) -> ReplicaStats:
+        return engine_stats(self.engine, self.replica_id, self.role,
+                            self.alive)
+
+    def healthy(self) -> bool:
+        return self.alive
+
+    def probe_health(self) -> str:
+        """Health-sweep probe: an in-process replica is either alive or
+        hard-dead (``kill()``) — there is no transient-miss state to
+        count, so ``max_missed_health`` only governs scrape-probed
+        process replicas."""
+        return "ok" if self.alive else "dead"
+
+    @property
+    def busy(self) -> bool:
+        return self.alive and self.engine.busy
+
+    # -- handoff -----------------------------------------------------------
+    def take_handoff_ready(self) -> List:
+        return self.engine.take_handoff_ready()
+
+    def export_handoff(self, slot, req) -> dict:
+        return self.engine.export_handoff(slot, req)
+
+    def inject_handoff(self, payload, request=None, on_token=None):
+        return self.engine.inject_handoff(payload, request=request,
+                                          on_token=on_token)
+
+    # -- lifecycle ---------------------------------------------------------
+    def kill(self):
+        """Simulated hard death (the failover test's hook): the manager
+        sees ``healthy() == False`` on its next sweep and requeues."""
+        self.alive = False
+        self.engine.close()
+
+    def stop(self):
+        self.alive = False
+        self.engine.close()
+
+
+class ProcessReplica:
+    """One worker subprocess speaking the fleet/worker.py line protocol.
+
+    Every exchange is synchronous (send one op line, read its reply), so
+    cross-replica dispatch ORDER is exactly the manager's call order.
+    Worker stdout multiplexes engine logs and protocol lines; protocol
+    lines carry the ``@fleet `` sentinel and everything else is passed
+    through to this process's stdout untouched.
+    """
+
+    backend = "process"
+
+    def __init__(self, replica_id: int, role: str, spec: dict, *,
+                 reply_timeout_s: float = 120.0):
+        self.replica_id = replica_id
+        self.role = role
+        self.alive = True
+        self.missed_health = 0
+        self.reply_timeout_s = reply_timeout_s
+        self.telemetry_port: Optional[int] = None
+        self._last_stats: Optional[ReplicaStats] = None
+        self._inflight = 0    # submits since the last advance reply —
+                              # folded into queue_depth so a same-step
+                              # burst spreads instead of piling onto one
+                              # stale snapshot
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # binary pipes + an explicit byte buffer: select() watches the
+        # raw fd, so a buffering text wrapper could strand a complete
+        # reply line in userspace while select blocks on a drained fd
+        self._buf = b""
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "deepspeed_tpu.serving.fleet.worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))))
+        self._send({"op": "init", "replica_id": replica_id, "role": role,
+                    **spec})
+        ready = self._read_reply()
+        self.telemetry_port = ready.get("telemetry_port")
+        log_dist(f"fleet: replica {replica_id} worker pid "
+                 f"{self._proc.pid} ready (role={role}, telemetry port "
+                 f"{self.telemetry_port})", ranks=[0])
+
+    # -- protocol plumbing -------------------------------------------------
+    def _send(self, msg: dict):
+        if self._proc.stdin is None or self._proc.poll() is not None:
+            self.alive = False
+            raise ReplicaDead(f"replica {self.replica_id} worker is gone")
+        try:
+            self._proc.stdin.write((json.dumps(msg) + "\n").encode("utf-8"))
+            self._proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            self.alive = False
+            raise ReplicaDead(
+                f"replica {self.replica_id} pipe closed: {e}") from e
+
+    def _read_line(self) -> bytes:
+        """Next complete stdout line, buffered byte-wise (select on the
+        raw fd + os.read — never a buffering reader that could strand a
+        complete line in userspace while select blocks)."""
+        fd = self._proc.stdout.fileno()
+        while b"\n" not in self._buf:
+            ready, _, _ = select.select([fd], [], [], self.reply_timeout_s)
+            if not ready:
+                self.alive = False
+                raise ReplicaDead(
+                    f"replica {self.replica_id} worker silent past "
+                    f"{self.reply_timeout_s}s")
+            chunk = os.read(fd, 1 << 16)
+            if not chunk:                     # EOF — the worker died
+                self.alive = False
+                raise ReplicaDead(
+                    f"replica {self.replica_id} worker exited "
+                    f"(rc={self._proc.poll()})")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\n", 1)
+        return line
+
+    def _read_reply(self) -> dict:
+        while True:
+            line = self._read_line().decode("utf-8", "replace")
+            if line.startswith(PROTOCOL_SENTINEL):
+                reply = json.loads(line[len(PROTOCOL_SENTINEL):])
+                if reply.get("op") == "error":
+                    raise RuntimeError(
+                        f"replica {self.replica_id} worker error: "
+                        f"{reply.get('detail')}")
+                return reply
+            sys.stdout.write(f"[replica {self.replica_id}] {line}\n")
+
+    # -- the replica surface ----------------------------------------------
+    def submit(self, prompt, max_new_tokens, request_id, priority=0,
+               on_token=None):
+        """Forward one submission; token streaming arrives as events in
+        later ``advance()`` replies (``on_token`` is ignored here — the
+        manager applies events to its fleet handles)."""
+        self._send({"op": "submit", "id": request_id,
+                    "prompt": np.asarray(prompt, np.int32).tolist(),
+                    "max_new_tokens": int(max_new_tokens),
+                    "priority": int(priority)})
+        self._inflight += 1
+        return self._read_reply()
+
+    def advance(self) -> dict:
+        """One lockstep engine iteration; the reply carries the step's
+        token events, finished requests, staged handoff ids, and a fresh
+        stats snapshot."""
+        self._send({"op": "advance"})
+        reply = self._read_reply()
+        self._inflight = 0
+        self._last_stats = ReplicaStats(
+            replica_id=self.replica_id, alive=True, role=self.role,
+            **reply["stats"])
+        return reply
+
+    def stats(self) -> ReplicaStats:
+        if self._last_stats is None or not self.alive:
+            return ReplicaStats(replica_id=self.replica_id,
+                                alive=self.alive, role=self.role,
+                                queue_depth=self._inflight)
+        s = self._last_stats
+        if self._inflight:
+            s = ReplicaStats(**{**s.to_dict()})
+            s.queue_depth += self._inflight
+        return s
+
+    def healthy(self) -> bool:
+        if not self.alive or self._proc.poll() is not None:
+            self.alive = False
+            return False
+        return True
+
+    def probe_health(self) -> str:
+        """Health-sweep probe: a dead process (exit/kill/pipe loss) is
+        ``"dead"`` immediately; a live worker whose telemetry endpoint
+        stops answering ``/healthz`` is a ``"miss"`` — the sweep counts
+        those against ``max_missed_health`` (a wedged worker can sit on
+        a live pid forever). Without a telemetry port the pid is the
+        only signal and a live one reads ``"ok"``."""
+        if not self.healthy():
+            return "dead"
+        if self.telemetry_port:
+            from ...observability.export import MetricsScrapeClient
+            probe = MetricsScrapeClient(
+                f"http://127.0.0.1:{self.telemetry_port}")
+            return "ok" if probe.healthz() else "miss"
+        return "ok"
+
+    @property
+    def busy(self) -> bool:
+        s = self.stats()
+        return self.alive and bool(s.queue_depth or s.active_slots)
+
+    # -- handoff (payloads cross the pipe as base64 npz blobs) -------------
+    def export_handoff_by_id(self, request_id) -> dict:
+        self._send({"op": "export", "id": request_id})
+        reply = self._read_reply()
+        return deserialize_handoff(base64.b64decode(reply["blob"]))
+
+    def inject_handoff(self, payload, request=None) -> bool:
+        blob = base64.b64encode(serialize_handoff(payload)).decode("ascii")
+        self._send({"op": "inject", "blob": blob})
+        return bool(self._read_reply().get("accepted"))
+
+    # -- lifecycle ---------------------------------------------------------
+    def kill(self):
+        self.alive = False
+        if self._proc.poll() is None:
+            self._proc.kill()
+            self._proc.wait(timeout=10)
+
+    def stop(self):
+        if self.alive and self._proc.poll() is None:
+            try:
+                self._send({"op": "stop"})
+                self._proc.wait(timeout=30)
+            except (ReplicaDead, subprocess.TimeoutExpired):
+                self._proc.kill()
+        self.alive = False

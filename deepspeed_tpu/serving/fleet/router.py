@@ -1,0 +1,163 @@
+"""Front-end request router: prefix affinity over live replica stats.
+
+The router answers one question per submission: WHICH replica serves
+this prompt. Two policies (``serving.fleet.router``):
+
+- ``least_loaded`` — the replica with the smallest outstanding work
+  (queue depth + active slots, normalized by its admissible cap), ties
+  broken by replica id. The classic front-end baseline.
+- ``prefix_affinity`` — route to the replica whose radix prefix cache
+  most likely already holds the prompt's head, so the PR-6 page-granular
+  prefix sharing actually fires: the router fingerprints each prompt's
+  page-aligned head chunks (the same granularity the prefix tree keys
+  on) and remembers, per replica, which head runs it routed there. The
+  longest recorded match wins — unless that replica's queue is past
+  ``affinity_queue_factor * slot_cap``, in which case a hot prefix must
+  not melt one replica and the decision falls back to least-loaded.
+
+Determinism contract (the repo-wide replay discipline): decisions are a
+pure function of (prompt tokens, the per-replica stats snapshot, the
+router's own routing history). Stats snapshots are taken synchronously
+on the fleet step clock — the same host ints the per-replica ``/metrics``
+plane exports (queue-depth and active-slot gauges, per-class TTFT), read
+without the scrape race — so a replayed trace produces the same dispatch
+sequence bit-exactly. Fingerprints are ``zlib.crc32`` over the raw int32
+token bytes: stable across processes and runs (python ``hash()`` is
+salted per process and would not be).
+"""
+
+import zlib
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .config import FleetConfig
+
+
+def prompt_fingerprints(prompt, page_len: int, max_chunks: int = 8
+                        ) -> List[int]:
+    """Fingerprint the prompt's page-aligned head: one crc32 per full
+    ``page_len`` chunk (capped at ``max_chunks`` — affinity needs the
+    head, not the tail), each folded over the previous so a chunk's
+    fingerprint identifies the whole RUN up to it, exactly like a radix
+    path."""
+    toks = np.asarray(prompt, np.int32)
+    n_full = min(int(toks.shape[0]) // page_len, max_chunks)
+    fps, acc = [], 0
+    for i in range(n_full):
+        chunk = toks[i * page_len:(i + 1) * page_len]
+        acc = zlib.crc32(chunk.tobytes(), acc)
+        fps.append(acc)
+    return fps
+
+
+class Router:
+    """Prefix-affinity / least-loaded dispatch over replica stats."""
+
+    def __init__(self, config: FleetConfig, page_len: int):
+        self.config = config
+        self.page_len = max(1, int(page_len))
+        # replica_id -> OrderedDict[run fingerprint -> True] (LRU, capped
+        # at affinity_index_size); rebuilt entries move to the MRU end
+        self._affinity: Dict[int, OrderedDict] = {}
+        self.decisions_total = 0
+        self.affinity_hits = 0        # routed by a recorded prefix match
+        self.affinity_overridden = 0  # match found but replica overloaded
+        self._log: List[dict] = []    # capped decision log (/statusz)
+        self.LOG_LIMIT = 256
+
+    # -- bookkeeping -------------------------------------------------------
+    def forget_replica(self, replica_id: int):
+        """Drop a dead/retired replica's affinity state — routing a
+        prefix at a corpse would pin its traffic on the fallback path."""
+        self._affinity.pop(replica_id, None)
+
+    def _record(self, replica_id: int, fps: List[int]):
+        idx = self._affinity.setdefault(replica_id, OrderedDict())
+        for fp in fps:
+            idx.pop(fp, None)
+            idx[fp] = True
+        while len(idx) > self.config.affinity_index_size:
+            idx.popitem(last=False)
+
+    def _match_len(self, replica_id: int, fps: List[int]) -> int:
+        """Longest recorded head run (in pages) for this prompt on this
+        replica. Run fingerprints are cumulative, so a hit on fps[i]
+        implies the whole run through page i was routed here."""
+        idx = self._affinity.get(replica_id)
+        if not idx:
+            return 0
+        n = 0
+        for i, fp in enumerate(fps):
+            if fp in idx:
+                n = i + 1
+        return n
+
+    # -- the decision ------------------------------------------------------
+    @staticmethod
+    def _load_key(s):
+        """Least-loaded total order: outstanding work normalized by the
+        admissible cap, then raw depth, then replica id — same stats
+        always pick the same replica."""
+        cap = max(1, s.slot_cap)
+        return ((s.queue_depth + s.active_slots) / cap, s.queue_depth,
+                s.replica_id)
+
+    def route(self, prompt, stats: List, *, step: int = 0,
+              request_id=None) -> int:
+        """Pick a replica id for ``prompt`` from the live ``stats``
+        snapshots (alive replicas only — the caller filters roles).
+        Raises when no replica is eligible."""
+        alive = [s for s in stats if s.alive]
+        if not alive:
+            raise RuntimeError("router: no live replica to dispatch to")
+        # least_loaded never consults the affinity index: skip both the
+        # crc32 work and the per-replica LRU upkeep under that policy
+        fps = (prompt_fingerprints(prompt, self.page_len)
+               if self.config.router == "prefix_affinity" else [])
+        self.decisions_total += 1
+        choice, why, match = None, "least_loaded", 0
+        if self.config.router == "prefix_affinity" and fps:
+            best = max(alive, key=lambda s: (self._match_len(
+                s.replica_id, fps), -self._load_key(s)[0], -s.replica_id))
+            match = self._match_len(best.replica_id, fps)
+            if match > 0:
+                limit = max(1.0, self.config.affinity_queue_factor
+                            * max(1, best.slot_cap))
+                if best.queue_depth < limit:
+                    choice, why = best.replica_id, "affinity"
+                    self.affinity_hits += 1
+                else:
+                    self.affinity_overridden += 1
+                    why = "affinity_overridden"
+        if choice is None:
+            choice = min(alive, key=self._load_key).replica_id
+        if fps:
+            self._record(choice, fps)
+        self._log.append({"step": step, "request_id": request_id,
+                          "replica": choice, "why": why,
+                          "match_pages": match})
+        del self._log[:-self.LOG_LIMIT]
+        return choice
+
+    def pick_least_loaded(self, stats: List) -> Optional[int]:
+        """Bare least-loaded pick (the handoff target selector — decode
+        replicas have no prompt affinity to exploit)."""
+        alive = [s for s in stats if s.alive]
+        if not alive:
+            return None
+        return min(alive, key=self._load_key).replica_id
+
+    def stats(self) -> dict:
+        return {
+            "policy": self.config.router,
+            "decisions_total": self.decisions_total,
+            "affinity_hits": self.affinity_hits,
+            "affinity_overridden": self.affinity_overridden,
+            "affinity_hit_rate": (self.affinity_hits
+                                  / max(1, self.decisions_total)),
+            "indexed_runs": {rid: len(idx)
+                             for rid, idx in self._affinity.items()},
+            "recent_decisions": list(self._log[-16:]),
+        }

@@ -1,0 +1,172 @@
+"""Fleet worker: one ServingEngine subprocess on a line-JSON protocol.
+
+``python -m deepspeed_tpu.serving.fleet.worker`` reads an ``init`` spec
+line on stdin (serving config dict + model spec + role + optional
+telemetry port), builds its engine, then serves ops until ``stop``:
+
+    {"op": "submit", "id", "prompt", "max_new_tokens", "priority"}
+    {"op": "advance"}                 -> events/finished/handoffs/stats
+    {"op": "export", "id"}            -> base64 handoff blob
+    {"op": "inject", "blob": b64}     -> accepted true/false
+    {"op": "stop"}
+
+Replies go to stdout prefixed with the ``@fleet `` sentinel so they
+multiplex cleanly with engine logging (the parent passes everything
+else through). Every op is answered before the next is read — the
+synchronous exchange is what keeps multi-process dispatch replayable.
+
+Each worker is its own process and device world: ``JAX_PLATFORMS`` /
+``XLA_FLAGS`` from the environment pick its backend and virtual device
+subset, and ``telemetry_port`` lights up the per-replica PR-8
+``/metrics`` + ``/healthz`` + ``/statusz`` endpoint the router-side
+scrape client (observability/export.py) reads.
+"""
+
+import base64
+import json
+import sys
+
+import numpy as np
+
+from .handoff import deserialize_handoff, serialize_handoff
+from .replica import PROTOCOL_SENTINEL, engine_stats
+
+
+def _reply(msg: dict):
+    sys.stdout.write(PROTOCOL_SENTINEL + json.dumps(msg) + "\n")
+    sys.stdout.flush()
+
+
+def _build_engine(spec: dict):
+    from ..config import ServingConfig
+    from ..engine import ServingEngine
+    model_spec = dict(spec.get("model") or {})
+    seed = model_spec.pop("seed", 0)
+    if spec.get("checkpoint"):
+        from ...models.gpt import GPT, GPTConfig
+        from ...runtime.checkpointing import load_module_params
+        params = load_module_params(spec["checkpoint"])
+        module = GPT(GPTConfig(**model_spec))
+    else:
+        from benchmarks.serving.load_harness import build_demo_model
+        module, params = build_demo_model(seed=seed, **model_spec)
+    serving = dict(spec.get("serving") or {})
+    serving.pop("fleet", None)      # a replica IS the fleet's leaf
+    return ServingEngine(module, params, ServingConfig(**serving))
+
+
+class _Worker:
+    def __init__(self, spec: dict):
+        self.replica_id = spec.get("replica_id", 0)
+        self.role = spec.get("role", "full")
+        self.engine = _build_engine(spec)
+        if self.role == "prefill":
+            self.engine.set_prefill_role(True)
+        port = spec.get("telemetry_port")
+        telemetry_port = None
+        if port is not None:
+            telemetry_port = self.engine.start_telemetry(port=port).port
+        self._handles = {}           # id -> Request
+        self._reported = set()       # ids whose completion already went out
+        self._events = []            # [[id, token, engine iteration]]
+        self._staged = {}            # id -> (slot, req) awaiting export
+        _reply({"op": "ready", "replica_id": self.replica_id,
+                "telemetry_port": telemetry_port})
+
+    def _on_token(self, req, token):
+        self._events.append([req.request_id, int(token),
+                             self.engine.iteration])
+
+    def _completions(self):
+        done = []
+        for rid, req in list(self._handles.items()):
+            if req.done and rid not in self._reported:
+                self._reported.add(rid)
+                done.append({
+                    "id": rid, "status": req.status,
+                    "shed_reason": req.shed_reason,
+                    "submitted_iteration": req.submitted_iteration,
+                    "first_token_iteration": req.first_token_iteration,
+                    "finished_iteration": req.finished_iteration,
+                    "preemptions": req.preemptions,
+                })
+        return done
+
+    def op_submit(self, msg):
+        req = self.engine.submit(
+            np.asarray(msg["prompt"], np.int32), msg["max_new_tokens"],
+            request_id=msg["id"], priority=msg.get("priority", 0),
+            on_token=self._on_token)
+        self._handles[msg["id"]] = req
+        _reply({"op": "submitted", "id": msg["id"], "status": req.status})
+
+    def op_advance(self, msg):
+        self.engine.advance()
+        for slot, req in self.engine.take_handoff_ready():
+            self._staged[req.request_id] = (slot, req)
+        events, self._events = self._events, []
+        stats = {k: v for k, v in engine_stats(
+            self.engine, self.replica_id, self.role).to_dict().items()
+            if k not in ("replica_id", "alive", "role")}
+        _reply({"op": "advanced", "iteration": self.engine.iteration,
+                "events": events, "finished": self._completions(),
+                "handoff_ready": sorted(self._staged, key=str),
+                "stats": stats})
+
+    def op_export(self, msg):
+        slot, req = self._staged.pop(msg["id"])
+        payload = self.engine.export_handoff(slot, req)
+        self._handles.pop(msg["id"], None)   # completion lands elsewhere
+        _reply({"op": "payload", "id": msg["id"],
+                "blob": base64.b64encode(
+                    serialize_handoff(payload)).decode("ascii")})
+
+    def op_inject(self, msg):
+        payload = deserialize_handoff(base64.b64decode(msg["blob"]))
+        rid = payload["request"]["request_id"]
+        live = self.engine.inject_handoff(payload,
+                                          on_token=self._on_token)
+        if live is not None:
+            self._handles[rid] = live
+        _reply({"op": "injected", "id": rid,
+                "accepted": live is not None})
+
+    def serve(self):
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            msg = json.loads(line)
+            op = msg.get("op")
+            if op == "stop":
+                break
+            handler = getattr(self, f"op_{op}", None)
+            if handler is None:
+                _reply({"op": "error", "detail": f"unknown op {op!r}"})
+                continue
+            try:
+                handler(msg)
+            except Exception as e:   # ds-tpu: lint-ok[PY001] — the
+                # protocol boundary: an op failure must reach the parent
+                # as a typed error reply, never kill the pipe silently
+                _reply({"op": "error", "detail": f"{op}: {e}"})
+        self.engine.close()
+        _reply({"op": "bye"})
+
+
+def main():
+    from ...utils.host_env import honor_jax_platforms_env
+    honor_jax_platforms_env()
+    first = sys.stdin.readline()
+    if not first:
+        return 2
+    spec = json.loads(first)
+    if spec.get("op") != "init":
+        _reply({"op": "error", "detail": "first line must be the init spec"})
+        return 2
+    _Worker(spec).serve()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,6 +78,12 @@ class ServingMetrics:
                                        # recovery requeue)
         self.requests_resumed = 0      # re-admissions after preemption
         self.recoveries = 0            # requeue-and-re-prefill recoveries
+        self.handoffs_exported = 0     # prefilled requests shipped to a
+                                       # decode replica (fleet prefill role)
+        self.handoffs_imported = 0     # page-handoffs continued here
+        self.handoff_tokens_imported = 0
+                                       # prompt tokens whose prefill this
+                                       # engine NEVER ran (page transfer)
         self.shed_by_reason = {}       # reason -> count (qos.SHED_*)
         self.faults = []               # [{kind, detail, iteration}] capped
                                        # at FAULT_LOG_LIMIT (watchdog/oom/
@@ -213,6 +219,29 @@ class ServingMetrics:
         if self.registry is not None:
             self.registry.counter("serving/requests_resumed").inc()
 
+    def on_handoff_export(self, request):
+        """One prefilled request shipped out as a page handoff (the
+        fleet's disaggregated prefill role). The request leaves this
+        engine mid-flight — its completion lands on the decode replica's
+        ledger, so export is its terminal event HERE."""
+        self.handoffs_exported += 1
+        if self.registry is not None:
+            self.registry.counter("serving/handoffs_exported").inc()
+
+    def on_handoff_import(self, request, prefill_tokens: int):
+        """One page handoff continued on this engine: counts as an
+        admission (the request occupies a slot from here on) plus the
+        prompt tokens whose prefill this engine skipped entirely —
+        the zero-recompute figure the acceptance test asserts."""
+        self.requests_admitted += 1
+        self.handoffs_imported += 1
+        self.handoff_tokens_imported += prefill_tokens
+        c = self._cls(request)
+        if c is not None:
+            c["admitted"] += 1
+        if self.registry is not None:
+            self.registry.counter("serving/handoffs_imported").inc()
+
     def on_fault(self, kind: str, detail: str, iteration: int):
         """One containment event (watchdog fire, OOM shed, recovery):
         appended to the capped fault log and counted in the registry —
@@ -347,6 +376,10 @@ class ServingMetrics:
                                     if self.samples else 0.0),
             "concurrent_requests_peak": self.busy_slots_max,
         }
+        if self.handoffs_exported or self.handoffs_imported:
+            out["handoffs_exported"] = self.handoffs_exported
+            out["handoffs_imported"] = self.handoffs_imported
+            out["handoff_tokens_imported"] = self.handoff_tokens_imported
         if self.prefill_chunks or self.prefill_tokens_reused:
             total = self.prefill_tokens_computed + self.prefill_tokens_reused
             out["prefill_chunks"] = self.prefill_chunks

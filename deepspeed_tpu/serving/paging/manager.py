@@ -33,8 +33,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...inference.cache import (cache_page_len, extract_token_kv,
-                                gather_pages, init_page_pool,
+from ...inference.cache import (cache_page_len, export_pages,
+                                extract_token_kv, gather_pages,
+                                import_pages, init_page_pool,
                                 make_paged_view, pool_is_quantized,
                                 quantize_page_pool, scatter_chunk_pages,
                                 scatter_token_pages, set_cache_index)
@@ -351,6 +352,51 @@ class PagedKVManager:
         with _span("serving/page_table_copy", {"slot": slot, "pages": 0}):
             self.page_table = self.page_table.at[slot].set(
                 jnp.full((self.max_pages,), NULL_PAGE, jnp.int32))
+
+    # -- page-granular handoff (serving/fleet disaggregation) --------------
+    def export_slot(self, slot: int, prefill_len: int):
+        """Read the slot's prefilled page CONTENTS out of the pool for a
+        cross-replica handoff: only pages below the prefill frontier
+        travel (``ceil(prefill_len / page_len)`` — decode appends
+        strictly past them on the receiver, so the still-unwritten
+        budget pages are garbage nobody copies). Returns
+        ``(unit_records, n_filled)``; the caller owns releasing the slot
+        once the payload is safely handed off."""
+        pages = self._slot_pages[slot]
+        if pages is None:
+            raise ValueError(f"export of unowned slot {slot}")
+        n_filled = -(-int(prefill_len) // self.page_len)
+        page_ids = pages[:n_filled]
+        with _span("serving/handoff_export", {"slot": slot,
+                                              "pages": n_filled}):
+            return export_pages(self.pool, page_ids), n_filled
+
+    def import_slot(self, slot: int, kv_units, n_filled: int,
+                    total_pages: int) -> bool:
+        """Allocate ``total_pages`` fresh pages for an incoming handoff
+        and write the ``n_filled`` transferred page records into the
+        first of them (the same admission discipline as ``try_admit``:
+        all-or-nothing, prefix-cache eviction as the fallback, False =
+        page-starved — the caller retries on a later step). Shapes never
+        change, so the receiver's compiled paged programs stay cached —
+        the handoff is a page transfer, not a recompute."""
+        if self._slot_pages[slot] is not None:
+            raise ValueError(f"import into occupied slot {slot}")
+        private = self.allocator.alloc(total_pages)
+        if private is None and self.prefix is not None:
+            self.prefix.evict(total_pages)
+            private = self.allocator.alloc(total_pages)
+        if private is None:
+            return False
+        with _span("serving/handoff_import", {"slot": slot,
+                                              "pages": n_filled}):
+            self.pool = import_pages(self.pool, private[:n_filled],
+                                     kv_units)
+            self._slot_pages[slot] = private
+            row = np.full((self.max_pages,), NULL_PAGE, np.int32)
+            row[:len(private)] = private
+            self.page_table = self.page_table.at[slot].set(row)
+        return True
 
     def reset(self):
         """Rebuild the device pool and every host-side ownership structure

@@ -161,6 +161,65 @@ def test_serve_crash_leaves_partial_snapshot_and_exits_nonzero(tmp_path):
     assert artifact["serving"].get("requests_submitted") == 4
 
 
+FLEET_ARGS = ["--num-slots", "2", "--max-len", "48", "--prefill-bucket",
+              "16", "--max-new-tokens", "3", "--d-model", "32",
+              "--n-layers", "1", "--vocab-size", "64", "--paged",
+              "--page-len", "16", "--quiet"]
+
+
+@pytest.mark.slow
+def test_serve_fleet_summary_line(tmp_path):
+    """--replicas 2: the fleet serve path completes the workload and
+    prints the stable ``fleet:`` exit summary (replica/finished/router/
+    handoff/failover counters) plus the fleet snapshot JSON."""
+    out = tmp_path / "fleet.json"
+    r = _run([os.path.join(BIN, "ds_tpu_serve"), "--synthetic", "4",
+              "--replicas", "2", *FLEET_ARGS, "--metrics-out", str(out)],
+             timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    fleet_lines = [l for l in r.stdout.splitlines()
+                   if l.startswith("fleet: ")]
+    assert fleet_lines, r.stdout[-800:]
+    assert "2 replicas (2 alive), 4/4 finished" in fleet_lines[0]
+    assert "router=prefix_affinity" in fleet_lines[0]
+    snap = json.loads(out.read_text())
+    assert snap["requests_finished"] == 4
+    assert set(snap["replicas"]) == {"0", "1"}
+
+
+@pytest.mark.slow
+def test_serve_fleet_replica_crash_partial_snapshot(tmp_path):
+    """An injected in-process replica crash is fatal by design (shared
+    process state): nonzero exit AND the partial fleet snapshot —
+    stdout JSON + sidecar — recording which replica died."""
+    out = tmp_path / "fleet.json"
+    r = _run([os.path.join(BIN, "ds_tpu_serve"), "--synthetic", "4",
+              "--replicas", "2", *FLEET_ARGS,
+              "--inject-replica-crash-at", "1",
+              "--metrics-out", str(out)], timeout=300)
+    assert r.returncode != 0
+    artifact = json.loads(out.read_text())
+    assert artifact["failed"] is True
+    assert "crashed at iteration" in artifact["reason"]
+    assert artifact["serving"]["replicas"]["1"]["alive"] is False
+
+
+@pytest.mark.slow
+def test_serve_fleet_kill_replica_failover(tmp_path):
+    """The contained-death path: a DETECTED replica kill mid-run fails
+    its requests over — everything still finishes, exit 0, the summary
+    line records the death."""
+    out = tmp_path / "fleet.json"
+    r = _run([os.path.join(BIN, "ds_tpu_serve"), "--synthetic", "4",
+              "--replicas", "2", *FLEET_ARGS, "--kill-replica-at", "1",
+              "--metrics-out", str(out)], timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    fleet_lines = [l for l in r.stdout.splitlines()
+                   if l.startswith("fleet: ")]
+    assert fleet_lines and "4/4 finished" in fleet_lines[0]
+    assert "dead=1" in fleet_lines[0]
+
+
 def test_report_diff_two_snapshots(tmp_path):
     """ds_tpu_report --diff: counters as deltas, gauges before->after,
     ordered by the meta capture stamps (stdlib path, no jax needed)."""

@@ -195,7 +195,7 @@ class TestWeightsInt8Parity:
     # gpt2 stays in the time-boxed tier-1 lane; the variants ride the
     # CI unit matrix only (engine drives cost ~10s each)
     @pytest.mark.parametrize("arch", [
-        "gpt2",
+        pytest.param("gpt2", marks=pytest.mark.slow),
         pytest.param("gptj", marks=pytest.mark.slow),
         pytest.param("bloom", marks=pytest.mark.slow),
     ])
@@ -255,7 +255,7 @@ class TestKvInt8BoundedLadder:
     # tier-1 keeps one arch per decode path; the full arch x kernel
     # product rides the CI unit matrix only
     @pytest.mark.parametrize("arch", [
-        "gpt2",
+        pytest.param("gpt2", marks=pytest.mark.slow),
         pytest.param("gptj", marks=pytest.mark.slow),
         pytest.param("bloom", marks=pytest.mark.slow),
     ])
@@ -323,6 +323,7 @@ class TestKvInt8BoundedLadder:
         err = np.abs(decode_logits(pool_fp) - decode_logits(pool_q)).max()
         assert err < 0.2, f"int8 KV decode logit err {err}"
 
+    @pytest.mark.slow
     def test_pool_bytes_halved_and_gauges(self):
         """mem/kv_pool_resident reflects the int8 page dtype: the int8
         pool (int8 K/V + fp32 scale planes) costs a strict fraction of

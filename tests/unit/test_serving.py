@@ -90,7 +90,10 @@ class TestServingConfig:
 # ---------------------------------------------------------------------------
 
 class TestCacheHelpers:
-    @pytest.mark.parametrize("scan_layers", [True, False])
+    @pytest.mark.parametrize("scan_layers", [
+        pytest.param(True, marks=pytest.mark.slow),
+        False,
+    ])
     def test_set_index_and_row_roundtrip(self, scan_layers):
         from deepspeed_tpu.inference.cache import (
             cache_max_len, cache_num_rows, make_row_cache, set_cache_index,
@@ -169,6 +172,7 @@ class TestScheduler:
 # ---------------------------------------------------------------------------
 
 class TestContinuousBatchingParity:
+    @pytest.mark.slow
     def test_33_requests_through_4_slots_match_generate(self):
         """33 mixed-length requests, 4 slots: every request's streamed
         tokens exactly match its whole-batch generate() reference;
@@ -215,7 +219,10 @@ class TestContinuousBatchingParity:
         assert snap["tokens_generated"] == sum(outs)
         assert not eng.busy and eng.num_free_slots == 4
 
-    @pytest.mark.parametrize("arch", ["gptj", "bloom"])
+    @pytest.mark.parametrize("arch", [
+        pytest.param("gptj", marks=pytest.mark.slow),
+        pytest.param("bloom", marks=pytest.mark.slow),
+    ])
     def test_rotary_and_alibi_variants(self, arch):
         """Per-slot positions must be exact for rotary (position enters
         q/k) and ALiBi (relative bias computed in-kernel per slot)."""
@@ -240,6 +247,7 @@ class TestContinuousBatchingParity:
             np.testing.assert_array_equal(np.asarray(req.output_tokens), ref,
                                           err_msg=f"{arch} {req.request_id}")
 
+    @pytest.mark.slow
     def test_eos_completes_slot_early(self):
         """A slot must free on EOS, its stream ending with the EOS token,
         matching the generate() eos semantics truncated at the first hit."""
@@ -289,6 +297,7 @@ class TestEnginePlumbing:
         with pytest.raises(ValueError, match="config= or as keyword"):
             ServingEngine(m, params, ServingConfig(), num_slots=2)
 
+    @pytest.mark.slow
     def test_inference_engine_serve_bridge(self):
         import deepspeed_tpu
         m, params = _model(vocab=53)
@@ -331,6 +340,7 @@ class TestEnginePlumbing:
         assert snap["ttft_steps_p50"] is not None
         assert 0 < snap["slot_occupancy_mean"] <= 1
 
+    @pytest.mark.slow
     def test_interleaved_submit_and_advance(self):
         """submit() during service (the online pattern): later arrivals
         join the running batch and still match their references."""
@@ -358,6 +368,7 @@ class TestEnginePlumbing:
 # ---------------------------------------------------------------------------
 
 class TestBenchHarness:
+    @pytest.mark.slow
     def test_trace_is_deterministic_and_replay_reproduces_steps(self,
                                                                 tmp_path):
         import sys
@@ -389,6 +400,7 @@ class TestBenchHarness:
         assert tokens_a == tokens_b
         assert steps_a == steps_b      # step-clock metrics reproduce exactly
 
+    @pytest.mark.slow
     def test_replay_admits_same_step_burst_together(self):
         """An idle gap followed by a burst of same-step arrivals must be
         admitted as a burst (filling the slots), not serialized one

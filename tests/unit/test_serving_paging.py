@@ -257,6 +257,7 @@ class TestPrefixCache:
 # ---------------------------------------------------------------------------
 
 class TestChunkedPrefill:
+    @pytest.mark.slow
     def test_long_prompt_interleaves_with_decode(self):
         """A 100-token prompt prefills in page chunks; the running decode
         batch advances between every pair of chunks (never stalls more
@@ -291,6 +292,7 @@ class TestChunkedPrefill:
         for s in short:
             assert s.done and len(s.output_tokens) == 24
 
+    @pytest.mark.slow
     def test_chunk_budget_per_iteration(self):
         """max_chunks_per_iter bounds prefill work between decodes."""
         m, params = _model()
@@ -315,6 +317,7 @@ class TestChunkedPrefill:
 # ---------------------------------------------------------------------------
 
 class TestPrefixSharingEndToEnd:
+    @pytest.mark.slow
     def test_shared_system_prompt_skips_recompute(self):
         m, params = _model()
         r = np.random.RandomState(11)
@@ -411,6 +414,7 @@ class TestPrefixSharingEndToEnd:
 # ---------------------------------------------------------------------------
 
 class TestPagedDensityAcceptance:
+    @pytest.mark.slow
     def test_10x_concurrency_at_2_row_hbm_budget(self):
         """Pool = 2 full-length rows of HBM; 40 mixed requests, 32 slots.
         Full-length contiguous rows would cap concurrency at 2 — the
@@ -468,7 +472,10 @@ class TestPagedDensityAcceptance:
         assert eng._paged.allocator.pages_in_use == \
             eng._paged.stats()["prefix_nodes"]   # only the tree holds pages
 
-    @pytest.mark.parametrize("arch", ["gptj", "bloom"])
+    @pytest.mark.parametrize("arch", [
+        pytest.param("gptj", marks=pytest.mark.slow),
+        pytest.param("bloom", marks=pytest.mark.slow),
+    ])
     def test_rotary_and_alibi_variants_paged(self, arch):
         variants = {
             "gptj": dict(rotary=True, learned_pos=False,
@@ -490,6 +497,7 @@ class TestPagedDensityAcceptance:
                 np.asarray(req.output_tokens),
                 _generate_ref(m, params, p, 5), err_msg=arch)
 
+    @pytest.mark.slow
     def test_unstacked_layers_paged(self):
         m, params = _model(vocab=91, scan_layers=False)
         r = np.random.RandomState(9)
@@ -510,6 +518,7 @@ class TestPagedDensityAcceptance:
 # ---------------------------------------------------------------------------
 
 class TestPagedOffIdentity:
+    @pytest.mark.slow
     def test_disabled_paging_matches_no_paging_block(self):
         """enabled=False (or no paging block at all) runs the original
         contiguous code paths — same outputs, same iteration trace."""

@@ -258,6 +258,7 @@ class TestPreemption:
         assert snap["class/batch/preempted"] == 1
         assert snap["class/batch/resumed"] == 1
 
+    @pytest.mark.slow
     def test_preempt_resume_token_exact_paged(self):
         """Same contract on the paged engine: pages released at
         preemption, resumption re-prefills prompt + partial output
@@ -418,6 +419,7 @@ class TestFaultContainment:
         with pytest.raises(RuntimeError, match="boom"):
             eng.run()
 
+    @pytest.mark.slow
     def test_watchdog_fires_recovers_and_stays_token_exact(self,
                                                            monkeypatch):
         """An injected hung decode dispatch trips the watchdog; the next
@@ -529,6 +531,7 @@ class TestFaultContainment:
 # ---------------------------------------------------------------------------
 
 class TestElasticity:
+    @pytest.mark.slow
     def test_set_slot_cap_drains_via_preemption(self):
         m, params = _model(vocab=61)
         eng = ServingEngine(m, params, ServingConfig(

@@ -94,6 +94,7 @@ def test_seq_len_not_divisible_raises():
         FixedSparsityConfig(num_heads=2, block=16).make_layout(40)
 
 
+@pytest.mark.slow
 def test_zero_to_fp32(tmp_path):
     """Consolidation tool round-trip (reference: zero_to_fp32.py)."""
     import deepspeed_tpu as ds
@@ -161,6 +162,7 @@ class TestBlockSparseKernel:
         np.testing.assert_allclose(np.asarray(sparse), np.asarray(dense),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_gradient_parity(self):
         cfg = BigBirdSparsityConfig(num_heads=4, block=16)
         q, k, v = self._qkv(7)
@@ -282,6 +284,7 @@ class TestUnidirectionalElementwiseCausality:
                                    rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_bigbird_decode_matches_padded_forward():
     """Random-block (NON-prefix-stable) layouts: decode and the padded
     training forward must serve the SAME trained pattern (built at
@@ -311,6 +314,7 @@ def test_bigbird_decode_matches_padded_forward():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
 
 
+@pytest.mark.slow
 def test_sparse_kv_cache_decode_matches_padded_forward():
     """VERDICT r3 rough edge: KV-cache decoding with a sparsity_config
     previously raised. It now folds the trained pattern's rows into the

@@ -126,6 +126,7 @@ def test_bert_sparse_self_attention_from_bert_config():
     assert out.shape == (1, 64, 32)
 
 
+@pytest.mark.slow
 def test_replace_model_self_attention_with_sparse():
     from deepspeed_tpu.models.bert import BertConfig, BertEncoder
     cfg = BertConfig(vocab_size=64, max_seq_len=32, d_model=32, n_layers=2,

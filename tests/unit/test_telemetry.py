@@ -435,6 +435,7 @@ class TestTelemetryServer:
 # ---------------------------------------------------------------------------
 
 class TestTrainEndpoint:
+    @pytest.mark.slow
     def test_train_run_scrapeable_goodput_sums_to_one(self):
         """CPU train run with the export block: /metrics scrapes live,
         goodput fractions sum to 1.0 +- eps, and the probe counter shows
@@ -468,6 +469,7 @@ class TestTrainEndpoint:
             eng.destroy()
         assert eng.telemetry is None
 
+    @pytest.mark.slow
     def test_destroy_stops_endpoint(self):
         eng = make_engine(observability={
             "enabled": True, "export": {"enabled": True, "port": 0}})
@@ -478,6 +480,7 @@ class TestTrainEndpoint:
                             OSError)):
             urllib.request.urlopen(url, timeout=2)
 
+    @pytest.mark.slow
     def test_snapshot_carries_goodput_without_observability_block(self):
         eng = make_engine()
         try:
@@ -491,6 +494,7 @@ class TestTrainEndpoint:
 
 
 class TestRollbackAttribution:
+    @pytest.mark.slow
     def test_chaos_rollback_attributed_to_badput(self, tmp_path):
         """The acceptance chaos leg: a NaN-injected divergence rollback
         shows up in the goodput breakdown under rollback_recovery (and
@@ -536,6 +540,7 @@ class TestServingEndpoint:
         return ServingEngine(m, params, ServingConfig(
             num_slots=2, max_len=64, prefill_bucket=16, seed=0))
 
+    @pytest.mark.slow
     def test_serving_run_scrapeable_with_queue_gauges(self):
         eng = self._serving_engine()
         srv = eng.start_telemetry(port=0)

@@ -73,7 +73,9 @@ def _make(cfg, seed=0, batch=2, seq=16):
     return layer, params, x
 
 
-@pytest.mark.parametrize("pre_ln", [True, False])
+@pytest.mark.parametrize("pre_ln", [
+    pytest.param(True, marks=pytest.mark.slow),
+    False])
 def test_forward_matches_unfused(pre_ln):
     cfg = DeepSpeedTransformerConfig(
         batch_size=2, hidden_size=64, heads=4, num_hidden_layers=2,
@@ -99,8 +101,10 @@ def test_additive_hf_mask_and_2d_mask_agree():
                                rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("knob", ["gelu_checkpoint", "attn_dropout_checkpoint",
-                                  "normalize_invertible"])
+@pytest.mark.parametrize("knob", [
+    pytest.param("gelu_checkpoint", marks=pytest.mark.slow),
+    pytest.param("attn_dropout_checkpoint", marks=pytest.mark.slow),
+    "normalize_invertible"])
 def test_checkpoint_knobs_preserve_values_and_grads(knob):
     base = DeepSpeedTransformerConfig(
         hidden_size=64, heads=4, num_hidden_layers=1, training=False)
