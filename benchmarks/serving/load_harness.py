@@ -620,6 +620,10 @@ def _fleet_run_block(fleet, trace, handles):
         },
         "ttft_steps_p50": percentile(ttft_steps, 50),
         "ttft_steps_p95": percentile(ttft_steps, 95),
+        # per-request latency waterfall (observability/fleet.py): p50/
+        # p95 fleet steps per stage, from the flight recorder — WHERE
+        # each request's latency went, not just how much there was
+        "per_request_breakdown": snap.get("per_request_breakdown"),
         "prefix_hit_rate": hits / max(1, lookups),
         "handoffs_completed": snap["handoffs_completed"],
         "failovers": snap["failovers"],

@@ -21,8 +21,12 @@ package at zero findings).
 """
 
 from .config import ExportConfig, MemoryConfig, ObservabilityConfig
-from .export import (TelemetryServer, build_statusz, prometheus_name,
-                     render_prometheus)
+from .export import (MetricsScrapeClient, TelemetryServer, build_statusz,
+                     parse_prometheus, prometheus_name, render_prometheus)
+from .fleet import (FleetTelemetryAggregator, FlightRecorder,
+                    breakdown_from_trace, format_waterfall, make_trace_id,
+                    per_request_breakdown, stitch_chrome_traces,
+                    write_stitched_trace)
 from .goodput import (CATEGORIES as GOODPUT_TAXONOMY, GoodputLedger,
                       classify_spans, format_goodput, get_ledger,
                       reset_ledger)
@@ -47,8 +51,11 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
     "GoodputLedger", "GOODPUT_TAXONOMY", "classify_spans", "format_goodput",
     "get_ledger", "reset_ledger",
-    "TelemetryServer", "build_statusz", "prometheus_name",
-    "render_prometheus",
+    "MetricsScrapeClient", "TelemetryServer", "build_statusz",
+    "parse_prometheus", "prometheus_name", "render_prometheus",
+    "FleetTelemetryAggregator", "FlightRecorder", "breakdown_from_trace",
+    "format_waterfall", "make_trace_id", "per_request_breakdown",
+    "stitch_chrome_traces", "write_stitched_trace",
     "collective_tally", "diff_snapshots", "format_snapshot_diff",
     "CHIP_PEAK_TFLOPS", "PerfAccountant", "detect_chip",
     "resolve_peak_flops",

@@ -30,11 +30,20 @@ Stdlib only (``http.server``), like every module in this package.
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 _NAME_OK = set("abcdefghijklmnopqrstuvwxyz"
                "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
+
+
+def _escape_label_value(v) -> str:
+    """Prometheus label-value escaping (text format 0.0.4): backslash,
+    double-quote, and newline must travel escaped or the sample line is
+    mangled on the way back through a parser."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def prometheus_name(name: str, prefix: str = "ds_tpu_") -> str:
@@ -77,7 +86,8 @@ def render_prometheus(snapshot: dict) -> str:
             lines.append(f"# TYPE {name} {type_}")
         lab = ""
         if labels:
-            inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+            inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                             for k, v in sorted(labels.items()))
             lab = "{" + inner + "}"
         lines.append(f"{name}{lab} {val}")
 
@@ -127,7 +137,10 @@ def build_statusz(snapshot: dict) -> dict:
     queue/slot state), plus the capture meta header. Fleet snapshots
     (``ServingFleet.metrics_snapshot``) additionally carry the router-
     level ``fleet`` section — per-replica stats/roles/liveness, router
-    policy + recent decisions, handoff/failover/scaling counters."""
+    policy + recent decisions, handoff/failover/scaling counters, the
+    aggregated telemetry view (per-replica up/staleness + merged
+    totals), the flight-recorder timeline, and the per-request latency
+    waterfall (observability/fleet.py)."""
     reg = snapshot.get("registry", snapshot)
     collected = reg.get("collected") or {}
     out = {
@@ -174,13 +187,28 @@ class MetricsScrapeClient:
     ``/metrics``, ``healthz()`` answers the liveness probe the fleet's
     health sweep uses for PROCESS replicas. Stdlib urllib, short
     timeouts, and every failure degrades to None/False — a dead replica
-    must read as dead, never hang the router."""
+    must read as dead, never hang the router.
 
-    def __init__(self, base_url: str, timeout_s: float = 2.0):
+    Hardened for the aggregator: one transient failure is retried
+    before the call degrades (a single dropped scrape must not read as
+    a death), and ``last_success_unix`` stamps every successful
+    exchange so callers can tell "dead" from "stale" by age instead of
+    by one boolean."""
+
+    def __init__(self, base_url: str, timeout_s: float = 2.0,
+                 retries: int = 1):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        self.retries = max(0, int(retries))
+        self.last_success_unix: Optional[float] = None
 
-    def _get(self, path: str):
+    def staleness_s(self) -> Optional[float]:
+        """Seconds since the last successful exchange (None = never)."""
+        if self.last_success_unix is None:
+            return None
+        return time.time() - self.last_success_unix
+
+    def _get_once(self, path: str):
         import urllib.error
         import urllib.request
         try:
@@ -190,8 +218,26 @@ class MetricsScrapeClient:
         except (urllib.error.URLError, OSError, ValueError):
             return None, None
 
+    def _get(self, path: str):
+        status, body = self._get_once(path)
+        for _ in range(self.retries):
+            if status is not None:
+                break
+            status, body = self._get_once(path)
+        if status == 200:
+            self.last_success_unix = time.time()
+        return status, body
+
     def healthz(self) -> bool:
-        status, _ = self._get("/healthz")
+        """Single-shot liveness probe — deliberately NO retry: the
+        fleet health sweep runs on the dispatch thread and already has
+        its own retry policy (``max_missed_health`` consecutive
+        misses), so a retrying probe would only double the data-plane
+        stall on a wedged endpoint. A 200 still refreshes the
+        staleness stamp (it is a successful exchange)."""
+        status, _ = self._get_once("/healthz")
+        if status == 200:
+            self.last_success_unix = time.time()
         return status == 200
 
     def gauges(self):

@@ -136,6 +136,15 @@ class Tracer:
             self.dropped += 1
         self.events.append((name, t0_ns, dur_ns, tid, args))
 
+    def record_complete(self, name, t0_ns, dur_ns, args=None):
+        """Record an interval that was measured OUTSIDE a ``span()``
+        context — e.g. a queue wait reconstructed at admit time from
+        the request's submit stamp, or a decode residency closed at
+        harvest. Host clock arithmetic only; exports as a normal "X"
+        event."""
+        self._record(name, int(t0_ns), int(dur_ns),
+                     threading.get_ident(), args)
+
     def record_counter(self, name, value):
         """One counter-track sample (a Chrome-trace "C" event): the
         instantaneous ``value`` under series ``name`` — memory gauges on

@@ -86,6 +86,12 @@ class ServingConfig:
                                      # deadline_steps; None = wait forever
     metrics_interval: int = 50       # engine iterations between monitor
                                      # flushes (never per-step host syncs)
+    flight_recorder_events: int = 256
+                                     # bounded request-lifecycle ring
+                                     # (observability/fleet.py): the
+                                     # last-N-requests timeline the
+                                     # partial-snapshot/crash path dumps;
+                                     # 0 disables recording
     seed: int = 0
     paging: Optional[PagingConfig] = None
                                      # block-paged KV cache (serving/paging/):
@@ -147,6 +153,10 @@ class ServingConfig:
         if self.metrics_interval < 1:
             raise ValueError(
                 f"metrics_interval must be >= 1, got {self.metrics_interval}")
+        if self.flight_recorder_events < 0:
+            raise ValueError(
+                f"flight_recorder_events must be >= 0 (0 disables), got "
+                f"{self.flight_recorder_events}")
         if self.paging is not None:
             self.paging.validate(self.cache_len)
         if self.qos is not None:

@@ -53,6 +53,7 @@ block absent the pre-QoS FIFO engine runs untouched.
 
 import os
 import threading
+import time
 from collections import deque
 from typing import Optional
 
@@ -66,8 +67,10 @@ from ..inference.cache import (cache_max_len, make_row_cache, set_cache_index,
                                write_cache_row)
 from ..observability.goodput import get_ledger as _goodput_ledger
 from ..observability.goodput import timed as _goodput
+from ..observability.fleet import make_trace_id
 from ..observability.memory import get_accountant, is_oom_error, oom_forensics
 from ..observability.programs import track_program
+from ..observability.trace import active_tracer as _active_tracer
 from ..observability.trace import span as _span
 from ..utils.logging import log_dist
 from .config import ServingConfig
@@ -246,8 +249,9 @@ class ServingEngine:
                               if self.config.eos_token_id is not None else -1)
 
         self.scheduler = FifoScheduler(self.config)
-        self.metrics = ServingMetrics(monitor=monitor,
-                                      interval=self.config.metrics_interval)
+        self.metrics = ServingMetrics(
+            monitor=monitor, interval=self.config.metrics_interval,
+            flight_recorder_events=self.config.flight_recorder_events)
         self._slot_req = [None] * n       # host view of slot -> Request
         self._free = deque(range(n))
         self._pending = deque()           # in-flight readbacks, FIFO
@@ -481,8 +485,14 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                request_id=None, on_token=None,
                deadline_steps: Optional[int] = None,
-               priority: int = 0) -> Request:
+               priority: int = 0,
+               trace_id: Optional[str] = None) -> Request:
         """Queue one request; returns its live ``Request`` handle.
+
+        ``trace_id`` threads a distributed trace identity through
+        (the fleet router stamps one per request so spans join across
+        replicas); when absent the engine derives a deterministic one
+        from the request id + submit ordinal.
 
         ``deadline_steps`` is a queue TTL on the engine-iteration clock:
         a request still queued after that many iterations completes with
@@ -514,8 +524,11 @@ class ServingEngine:
             raise
         if request_id is None:
             request_id = self._seq
+        if trace_id is None:
+            trace_id = make_trace_id(request_id, self._seq)
         req = Request(prompt, max_new_tokens, request_id, on_token=on_token,
-                      deadline_steps=deadline_steps, priority=priority)
+                      deadline_steps=deadline_steps, priority=priority,
+                      trace_id=trace_id)
         if qos_cls is not None:
             req.qos_class = qos_cls.name
         req.submitted_iteration = self._iteration
@@ -708,6 +721,39 @@ class ServingEngine:
             fold = zlib.crc32(repr(req.request_id).encode())
         return jax.random.fold_in(self._rng, fold % (2**31))
 
+    # -- per-request distributed tracing -----------------------------------
+    def _record_queue_wait(self, req):
+        """Emit the retroactive ``serving/queue_wait`` span for the
+        period the request ACTUALLY spent queued this time — submit ->
+        first admit, or preempt -> re-admit for a resumption (measuring
+        from submit again would fold the prior RUNNING period into the
+        queue stage). Pure host clock arithmetic on stamps the request
+        already carries — no clock reads when tracing is off, never a
+        device touch."""
+        tracer = _active_tracer()
+        if tracer is None:
+            return
+        t0 = (req.preempted_at_ns if req.preempted_at_ns is not None
+              else req.submitted_at_ns)
+        now = time.perf_counter_ns()
+        tracer.record_complete(
+            "serving/queue_wait", t0, max(0, now - t0),
+            {"request_id": req.request_id, "trace_id": req.trace_id,
+             "resumed": req.preempted_at_ns is not None})
+
+    def _record_residency(self, req):
+        """Emit the retroactive ``serving/decode_residency`` span
+        (admit -> finish): how long the request held its slot."""
+        tracer = _active_tracer()
+        if tracer is None or req.admitted_at_ns is None:
+            return
+        now = time.perf_counter_ns()
+        tracer.record_complete(
+            "serving/decode_residency", req.admitted_at_ns,
+            max(0, now - req.admitted_at_ns),
+            {"request_id": req.request_id, "trace_id": req.trace_id,
+             "tokens": len(req.tokens)})
+
     # -- free-slot bookkeeping (autoscaling cap aware) ---------------------
     def _peek_free_slot(self) -> Optional[int]:
         """First free slot below the admissible cap (None when all taken
@@ -790,6 +836,10 @@ class ServingEngine:
             self._paged.release_slot(slot)
         self._slot_req[slot] = None
         self._free.append(slot)
+        # close this RUNNING period's residency span now: resumption
+        # re-stamps admitted_at_ns, so each slot tenancy is recorded
+        # exactly once (queue_wait's preempt->re-admit twin)
+        self._record_residency(req)
         req._preempted(self._iteration)
         self.scheduler.requeue(req)
         self.metrics.on_preempt(req, reason)
@@ -820,10 +870,13 @@ class ServingEngine:
             padded[0, :n] = prompt
             greedy, has_k, has_p, t, k, p = self._mode
             rng = self._req_rng(req)
-            # request_id in the span args: a trace capture can rebuild
-            # per-request latency (admit -> decode iterations -> harvest)
+            self._record_queue_wait(req)
+            # request_id + trace_id in the span args: a trace capture
+            # (or the fleet stitcher) can rebuild per-request latency
+            # (queue wait -> admit -> decode iterations -> harvest)
             try:
                 with _span("serving/admit", {"request_id": req.request_id,
+                                             "trace_id": req.trace_id,
                                              "prompt_len": n}), \
                         _goodput("compute"):
                     self._cache, self._state, tok, done = _admit_jit(
@@ -868,6 +921,7 @@ class ServingEngine:
                 return
             self.scheduler.next_request()   # actually pop it
             self._take_slot(slot)
+            self._record_queue_wait(req)
             resumed = req.status == PREEMPTED
             self._slot_req[slot] = req
             req._admitted(slot, self._iteration)
@@ -936,6 +990,7 @@ class ServingEngine:
         try:
             with _span("serving/prefill_chunk",
                        {"slot": slot, "request_id": req.request_id,
+                        "trace_id": req.trace_id,
                         "start": start, "tokens": real,
                         "last": bool(is_last)}), \
                     _goodput("compute"):
@@ -995,10 +1050,15 @@ class ServingEngine:
         dispatched >= pipeline_depth iterations ago) and stream its
         tokens/completions to their requests."""
         entry = self._pending.popleft()
-        with _span("serving/harvest",
-                   {"kind": entry[0],
-                    "active_requests": sum(r is not None
-                                           for r in self._slot_req)}), \
+        harvest_args = {"kind": entry[0],
+                        "active_requests": sum(r is not None
+                                               for r in self._slot_req)}
+        if entry[0] == "admit":
+            # first-token harvests are per-request: carry the trace id
+            # so the stitched fleet trace joins them to their admit
+            harvest_args["request_id"] = entry[2].request_id
+            harvest_args["trace_id"] = entry[2].trace_id
+        with _span("serving/harvest", harvest_args), \
                 _goodput("compute"):
             if entry[0] == "admit":
                 _, slot, req, tok, done = entry
@@ -1032,6 +1092,7 @@ class ServingEngine:
                     self._finish(slot, req)
 
     def _finish(self, slot: int, req: Request):
+        self._record_residency(req)
         req._finished(self._iteration)
         self.metrics.on_finish(req)
         if self._paged is not None:
@@ -1162,28 +1223,34 @@ class ServingEngine:
         Frees the slot — the pages travel as values, not references."""
         if self._paged is None:
             raise ValueError("export_handoff requires the paged engine")
+        from .fleet.handoff import HANDOFF_VERSION
         # what was prefilled = the effective prompt at admission; tokens
         # holds exactly one post-prefill sample (the handoff fires at
         # first-token harvest), so the frontier is one behind it
         prefill_len = len(req.prompt) + len(req.tokens) - 1
         remaining = req.max_new_tokens - len(req.tokens)
-        kv, n_filled = self._paged.export_slot(slot, prefill_len)
-        payload = {
-            "version": 1,
-            "page_len": self._paged.page_len,
-            "kv_quant": self._paged.kv_quant,
-            "prefill_len": prefill_len,
-            "n_pages_filled": n_filled,
-            "kv": kv,
-            "state": {"last_token": int(req.tokens[-1]),
-                      "remaining": int(remaining)},
-            "request": {"request_id": req.request_id,
-                        "prompt": np.asarray(req.prompt, np.int32),
-                        "generated": list(req.tokens),
-                        "max_new_tokens": int(req.max_new_tokens),
-                        "priority": int(req.priority)},
-        }
-        self._paged.release_slot(slot)
+        with _span("serving/handoff_export",
+                   {"request_id": req.request_id,
+                    "trace_id": req.trace_id,
+                    "prefill_len": prefill_len}):
+            kv, n_filled = self._paged.export_slot(slot, prefill_len)
+            payload = {
+                "version": HANDOFF_VERSION,
+                "page_len": self._paged.page_len,
+                "kv_quant": self._paged.kv_quant,
+                "prefill_len": prefill_len,
+                "n_pages_filled": n_filled,
+                "kv": kv,
+                "state": {"last_token": int(req.tokens[-1]),
+                          "remaining": int(remaining)},
+                "request": {"request_id": req.request_id,
+                            "trace_id": req.trace_id,
+                            "prompt": np.asarray(req.prompt, np.int32),
+                            "generated": list(req.tokens),
+                            "max_new_tokens": int(req.max_new_tokens),
+                            "priority": int(req.priority)},
+            }
+            self._paged.release_slot(slot)
         self._slot_req[slot] = None
         self._free.append(slot)
         self.metrics.on_handoff_export(req)
@@ -1206,9 +1273,11 @@ class ServingEngine:
         engine would have."""
         if self._paged is None:
             raise ValueError("inject_handoff requires the paged engine")
-        if payload.get("version") != 1:
+        from .fleet.handoff import COMPAT_HANDOFF_VERSIONS
+        if payload.get("version") not in COMPAT_HANDOFF_VERSIONS:
             raise ValueError(
-                f"unknown handoff payload version {payload.get('version')!r}")
+                f"unknown handoff payload version {payload.get('version')!r}"
+                f" (this build speaks {COMPAT_HANDOFF_VERSIONS})")
         if (payload["page_len"] != self._paged.page_len
                 or payload.get("kv_quant") != self._paged.kv_quant):
             raise ValueError(
@@ -1225,16 +1294,26 @@ class ServingEngine:
         prefill_len = int(payload["prefill_len"])
         remaining = int(st["remaining"])
         total = self._paged.pages_for(prefill_len, remaining)
-        if not self._paged.import_slot(slot, payload["kv"],
-                                       int(payload["n_pages_filled"]),
-                                       total):
-            return None
+        # the trace identity travels in the payload (v2); a v1 payload
+        # carries none and gets a fresh deterministic id here
+        trace_id = rq.get("trace_id") or make_trace_id(
+            rq["request_id"], self._seq)
+        with _span("serving/handoff_inject",
+                   {"request_id": rq["request_id"], "trace_id": trace_id,
+                    "prefill_len": prefill_len}):
+            if not self._paged.import_slot(slot, payload["kv"],
+                                           int(payload["n_pages_filled"]),
+                                           total):
+                return None
         if request is None:
             request = Request(np.asarray(rq["prompt"], np.int32),
                               rq["max_new_tokens"], rq["request_id"],
                               on_token=on_token,
-                              priority=rq.get("priority", 0))
+                              priority=rq.get("priority", 0),
+                              trace_id=trace_id)
             request.tokens = list(rq["generated"])
+        elif request.trace_id is None:
+            request.trace_id = trace_id
         if request.submitted_iteration is None:
             request.submitted_iteration = self._iteration
         self._take_slot(slot)

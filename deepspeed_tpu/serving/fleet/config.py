@@ -73,6 +73,30 @@ class FleetConfig:
                                      # ephemeral ports (the router-level
                                      # endpoint is separate — see
                                      # ServingFleet.start_telemetry)
+    aggregate_telemetry: bool = True # fleet telemetry aggregator
+                                     # (observability/fleet.py): poll
+                                     # every replica (scrape or direct
+                                     # snapshot) on the cadence below and
+                                     # serve the merged view from the
+                                     # router's /metrics + /statusz
+    aggregate_every_steps: int = 8   # fleet steps between aggregator
+                                     # polls (bounded cadence — never per
+                                     # engine step)
+    stale_after_s: float = 30.0      # a replica whose last successful
+                                     # sample is older than this reads
+                                     # ``stale`` in the aggregated view
+                                     # (dead vs one dropped scrape)
+    replica_trace: bool = False      # process workers activate a span
+                                     # tracer so their dumps can be
+                                     # stitched into one fleet Chrome
+                                     # trace (stitched_trace()); the
+                                     # in-process backend records into
+                                     # the router's own tracer
+    flight_recorder_events: int = 256
+                                     # fleet-level request-lifecycle ring
+                                     # (submit/admit/handoff/failover/
+                                     # finish on the fleet step clock);
+                                     # 0 disables
 
     def validate(self, serving_config=None) -> "FleetConfig":
         if self.replicas < 1:
@@ -126,6 +150,18 @@ class FleetConfig:
             raise ValueError(
                 "serving.fleet.autoscale_every_steps must be >= 1, got "
                 f"{self.autoscale_every_steps}")
+        if self.aggregate_every_steps < 1:
+            raise ValueError(
+                "serving.fleet.aggregate_every_steps must be >= 1, got "
+                f"{self.aggregate_every_steps}")
+        if self.stale_after_s <= 0:
+            raise ValueError(
+                "serving.fleet.stale_after_s must be > 0, got "
+                f"{self.stale_after_s}")
+        if self.flight_recorder_events < 0:
+            raise ValueError(
+                "serving.fleet.flight_recorder_events must be >= 0 "
+                f"(0 disables), got {self.flight_recorder_events}")
         if self.disaggregate and self.min_replicas < 2:
             # a disaggregated fleet can never drain below one prefill +
             # one decode replica

@@ -13,13 +13,17 @@ recompute:
 - ``state``: the sampler handover (last sampled token + remaining
   budget);
 - ``request``: prompt tokens, already-generated tokens, budget, id,
-  priority — enough to rebuild the ``Request`` on the receiver.
+  priority, and (v2) the distributed ``trace_id`` — enough to rebuild
+  the ``Request`` on the receiver with its trace identity intact.
 
 In-process fleets pass the payload dict by reference.
 ``serialize_handoff``/``deserialize_handoff`` flatten it to one
 self-describing ``.npz`` byte blob for a process/network boundary (the
 fleet worker protocol base64s it over the pipe). Versioned: receivers
-refuse unknown ``version`` values loudly rather than guessing.
+refuse unknown ``version`` values loudly rather than guessing, but
+accept every version in ``COMPAT_HANDOFF_VERSIONS`` — v1 payloads
+(pre-tracing) load fine, their requests simply carry no ``trace_id``
+(the injecting engine stamps a fresh one).
 """
 
 import io
@@ -28,7 +32,8 @@ from typing import Dict
 
 import numpy as np
 
-HANDOFF_VERSION = 1
+HANDOFF_VERSION = 2               # v2: request carries trace_id
+COMPAT_HANDOFF_VERSIONS = (1, 2)  # what this build's readers accept
 # payload keys that are numpy arrays at the top level
 _ARRAY_META = ("prompt",)
 
@@ -73,10 +78,10 @@ def deserialize_handoff(blob: bytes) -> Dict:
     ``serialize_handoff`` blob."""
     with np.load(io.BytesIO(blob)) as z:
         meta = json.loads(bytes(z["meta"].tobytes()).decode("utf-8"))
-        if meta.get("version") != HANDOFF_VERSION:
+        if meta.get("version") not in COMPAT_HANDOFF_VERSIONS:
             raise ValueError(
                 f"unknown handoff wire version {meta.get('version')!r} "
-                f"(this build speaks {HANDOFF_VERSION})")
+                f"(this build speaks {COMPAT_HANDOFF_VERSIONS})")
         kv = []
         for i in range(meta["n_units"]):
             prefix = f"kv/{i}/"

@@ -29,6 +29,9 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ...observability.fleet import (FleetTelemetryAggregator,
+                                    FlightRecorder, make_trace_id,
+                                    per_request_breakdown)
 from ...utils.logging import log_dist
 from ..request import Request
 from .config import FleetConfig
@@ -48,8 +51,13 @@ class FleetRequest:
     work on either."""
 
     def __init__(self, prompt, max_new_tokens: int, request_id,
-                 priority: int = 0, on_token=None):
+                 priority: int = 0, on_token=None, trace_id=None):
         self.request_id = request_id
+        # the distributed trace identity: stamped by the fleet at
+        # submit, propagated to every replica that ever serves this
+        # request (worker protocol + handoff wire) so one id joins its
+        # spans and lifecycle events fleet-wide
+        self.trace_id = trace_id
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
         self.priority = int(priority)
@@ -70,6 +78,7 @@ class FleetRequest:
         self.finished_at: Optional[float] = None
         # fleet-clock stamps (deterministic run-to-run)
         self.submitted_iteration: Optional[int] = None
+        self.admitted_iteration: Optional[int] = None
         self.first_token_iteration: Optional[int] = None
         self.finished_iteration: Optional[int] = None
 
@@ -168,6 +177,17 @@ class ServingFleet:
         self.requests_finished = 0
         self.last_scale_decision: Optional[dict] = None
         self.telemetry = None
+        # fleet-level flight recorder: request lifecycle events on the
+        # FLEET step clock (submit/admit/first_token/handoff/failover/
+        # terminal) — the per-request waterfall's input and the crash
+        # path's last-N-requests timeline
+        self.recorder = FlightRecorder(self.fcfg.flight_recorder_events)
+        # bounded-cadence telemetry aggregator: every replica's metrics
+        # (scraped or direct) merged into one fleet view served from
+        # the router process
+        self._aggregator = (
+            FleetTelemetryAggregator(stale_after_s=self.fcfg.stale_after_s)
+            if self.fcfg.aggregate_telemetry else None)
         self._scaler = None
         if self.fcfg.autoscale:
             from ...elasticity.serving_autoscaler import (
@@ -195,14 +215,28 @@ class ServingFleet:
         self._next_rid += 1
         role = role or self.fcfg.role_for(rid)
         if self.fcfg.backend == "process":
-            port = 0 if self.fcfg.replica_telemetry else None
+            # the aggregator needs a scrape target, so a process
+            # replica under aggregation always gets an endpoint even
+            # when per-replica telemetry wasn't asked for explicitly
+            want_port = (self.fcfg.replica_telemetry
+                         or self._aggregator is not None)
             rep = ProcessReplica(rid, role,
-                                 {**self._spec, "telemetry_port": port})
+                                 {**self._spec,
+                                  "telemetry_port": 0 if want_port
+                                  else None,
+                                  "trace": self.fcfg.replica_trace})
         else:
             rep = LocalReplica(rid, role, self._module, self._params,
                                self._replica_config,
                                telemetry=self.fcfg.replica_telemetry)
         self._replicas[rid] = rep
+        if self._aggregator is not None:
+            if rep.backend == "process" and rep.telemetry_port:
+                # reuse the replica's cached client: health sweeps and
+                # aggregator polls accumulate one staleness stamp
+                self._aggregator.add_scrape(rid, client=rep.scrape_client)
+            else:
+                self._aggregator.add_direct(rid, rep.metrics_sample)
         self.replicas_spawned += 1
         return rep
 
@@ -239,11 +273,17 @@ class ServingFleet:
             np.asarray(prompt, np.int32), self._stats(eligible),
             step=self._iteration, request_id=request_id)
         handle = FleetRequest(prompt, max_new_tokens, request_id,
-                              priority=priority, on_token=on_token)
+                              priority=priority, on_token=on_token,
+                              trace_id=make_trace_id(
+                                  request_id, self.requests_submitted))
         handle.submitted_iteration = self._iteration
         self.requests_submitted += 1
         self.dispatch_log.append((request_id, target))
         del self.dispatch_log[:-LOG_LIMIT]
+        self.recorder.record("submit", request_id=request_id,
+                             trace_id=handle.trace_id, replica_id=target,
+                             iteration=self._iteration,
+                             prompt_len=int(handle.prompt.shape[0]))
         self._dispatch(handle, target, handle.prompt, max_new_tokens)
         return handle
 
@@ -252,6 +292,11 @@ class ServingFleet:
             if handle.first_token_at is None:
                 handle.first_token_at = time.perf_counter()
                 handle.first_token_iteration = self._iteration
+                self.recorder.record(
+                    "first_token", request_id=handle.request_id,
+                    trace_id=handle.trace_id,
+                    replica_id=handle.replica_id,
+                    iteration=self._iteration)
             handle.tokens.append(int(token))
             if handle.on_token is not None:
                 handle.on_token(handle, int(token))
@@ -267,7 +312,8 @@ class ServingFleet:
             inner = rep.submit(prompt, max_new,
                                request_id=handle.request_id,
                                priority=handle.priority,
-                               on_token=self._on_token_cb(handle))
+                               on_token=self._on_token_cb(handle),
+                               trace_id=handle.trace_id)
             handle._inner = inner
             if inner.done:          # QoS shed/refused at submit
                 self._finalize(handle, inner.status, inner.shed_reason)
@@ -276,7 +322,8 @@ class ServingFleet:
             try:
                 reply = rep.submit(prompt, max_new,
                                    request_id=handle.request_id,
-                                   priority=handle.priority)
+                                   priority=handle.priority,
+                                   trace_id=handle.trace_id)
             except ReplicaDead:
                 # undetected death discovered at dispatch time (e.g. an
                 # OOM-killed worker between health sweeps): reroute NOW
@@ -307,6 +354,14 @@ class ServingFleet:
         handle._inner = None
         if status == "finished":
             self.requests_finished += 1
+        self.recorder.record(status, request_id=handle.request_id,
+                             trace_id=handle.trace_id,
+                             replica_id=handle.replica_id,
+                             iteration=self._iteration,
+                             tokens=len(handle.tokens),
+                             handoffs=handle.handoffs,
+                             failovers=handle.failovers,
+                             shed_reason=shed_reason)
         self._handles.pop(handle.request_id, None)
 
     # -- the fleet step ----------------------------------------------------
@@ -346,6 +401,7 @@ class ServingFleet:
                 handoff_ready.extend((rid, hid)
                                      for hid in reply.get("handoff_ready",
                                                           []))
+        self._record_admissions()
         self._harvest_local()
         self._pump_handoffs(handoff_ready)
         if self._iteration % self.fcfg.health_every_steps == 0:
@@ -353,6 +409,11 @@ class ServingFleet:
         if self._scaler is not None and \
                 self._iteration % self.fcfg.autoscale_every_steps == 0:
             self._autoscale_tick()
+        if self._aggregator is not None and \
+                self._iteration % self.fcfg.aggregate_every_steps == 0:
+            # off-thread: a wedged replica endpoint (scrape timeout x
+            # retry) must never stall the dispatch/harvest data plane
+            self._aggregator.poll_async()
         self._iteration += 1
 
     @property
@@ -373,6 +434,26 @@ class ServingFleet:
             if max_iterations is not None and it >= max_iterations:
                 break
 
+    def _record_admissions(self):
+        """Stamp the fleet-clock admit mark for handles whose replica
+        admitted them this step (in-process: the inner request
+        transitioned out of the queue during ``rep.advance()``; process
+        replicas report admitted ids in their advance reply). First
+        admission only — the waterfall's queue stage ends exactly
+        once."""
+        for handle in self._handles.values():
+            inner = handle._inner
+            if (handle.admitted_iteration is None and inner is not None
+                    and inner.admitted_iteration is not None):
+                self._mark_admitted(handle)
+
+    def _mark_admitted(self, handle: FleetRequest):
+        handle.admitted_iteration = self._iteration
+        self.recorder.record("admit", request_id=handle.request_id,
+                             trace_id=handle.trace_id,
+                             replica_id=handle.replica_id,
+                             iteration=self._iteration)
+
     # -- harvest -----------------------------------------------------------
     def _harvest_local(self):
         for handle in list(self._handles.values()):
@@ -381,6 +462,11 @@ class ServingFleet:
                 self._finalize(handle, inner.status, inner.shed_reason)
 
     def _apply_worker_reply(self, rid: int, reply: dict):
+        for hid in reply.get("admitted", []):
+            handle = self._handles.get(hid)
+            if (handle is not None and handle.replica_id == rid
+                    and handle.admitted_iteration is None):
+                self._mark_admitted(handle)
         for hid, token, _it in reply.get("events", []):
             handle = self._handles.get(hid)
             if handle is None or handle.replica_id != rid:
@@ -388,6 +474,10 @@ class ServingFleet:
             if handle.first_token_at is None:
                 handle.first_token_at = time.perf_counter()
                 handle.first_token_iteration = self._iteration
+                self.recorder.record("first_token", request_id=hid,
+                                     trace_id=handle.trace_id,
+                                     replica_id=rid,
+                                     iteration=self._iteration)
             handle.tokens.append(int(token))
             if handle.on_token is not None:
                 handle.on_token(handle, int(token))
@@ -412,6 +502,7 @@ class ServingFleet:
                 payload = rep.export_handoff(slot, req)
                 if handle is not None:
                     handle.replica_id = None       # in transit
+                self._record_handoff_export(payload, rid)
                 self._handoff_backlog.append((payload, handle))
         for rid, hid in process_ready:
             rep = self._replicas[rid]
@@ -424,6 +515,7 @@ class ServingFleet:
                 continue       # the death sweep requeues from the handle
             if handle is not None:
                 handle.replica_id = None
+            self._record_handoff_export(payload, rid)
             self._handoff_backlog.append((payload, handle))
         retry = deque()
         while self._handoff_backlog:
@@ -447,10 +539,22 @@ class ServingFleet:
             self.handoffs_completed += 1
             self.handoff_log.append((hid, src, target))
             del self.handoff_log[:-LOG_LIMIT]
+            self.recorder.record(
+                "handoff_inject", request_id=hid,
+                trace_id=payload["request"].get("trace_id"),
+                replica_id=target, iteration=self._iteration, src=src)
             if handle is not None:
                 handle.replica_id = target
                 handle.handoffs += 1
         self._handoff_backlog = retry
+
+    def _record_handoff_export(self, payload: dict, src_rid: int):
+        self.recorder.record(
+            "handoff_export",
+            request_id=payload["request"]["request_id"],
+            trace_id=payload["request"].get("trace_id"),
+            replica_id=src_rid, iteration=self._iteration,
+            prefill_len=int(payload["prefill_len"]))
 
     def _inject(self, rep, payload, handle) -> bool:
         if rep.backend == "inprocess":
@@ -499,6 +603,10 @@ class ServingFleet:
         self._failed.add(rid)
         self.dead_replicas += 1
         self.router.forget_replica(rid)
+        if self._aggregator is not None:
+            self._aggregator.mark_dead(rid)
+        self.recorder.record("replica_dead", replica_id=rid,
+                             iteration=self._iteration)
         victims = [h for h in self._handles.values()
                    if h.replica_id == rid and not h.done]
         for handle in victims:
@@ -518,6 +626,11 @@ class ServingFleet:
         handle.preemptions += 1
         self.failovers += 1
         handle._inner = None
+        self.recorder.record("failover", request_id=handle.request_id,
+                             trace_id=handle.trace_id,
+                             replica_id=handle.replica_id,
+                             iteration=self._iteration,
+                             tokens_retained=len(handle.tokens))
         remaining = handle.remaining_budget()
         if remaining <= 0:          # owed nothing more: call it finished
             self._finalize(handle, "finished")
@@ -607,6 +720,10 @@ class ServingFleet:
         rep.alive = False                   # no more routing to it
         self._failed.add(rid)               # failover already handled here
         self.router.forget_replica(rid)
+        if self._aggregator is not None:
+            self._aggregator.mark_dead(rid)
+        self.recorder.record("replica_retired", replica_id=rid,
+                             iteration=self._iteration)
         for handle in victims:
             self._failover(handle)
         rep.stop()
@@ -615,10 +732,20 @@ class ServingFleet:
                  f"({len(victims)} requests re-dispatched)", ranks=[0])
 
     # -- telemetry ---------------------------------------------------------
+    def per_request_breakdown(self, include_requests: bool = True) -> dict:
+        """The per-request latency waterfall (observability/fleet.py):
+        queue -> prefill -> handoff -> decode stage steps per traced
+        request plus per-stage p50/p95 — stage sums telescope exactly
+        to each request's end-to-end fleet steps. Derived from the
+        flight recorder, so it covers the last-N completed requests."""
+        return per_request_breakdown(self.recorder.events,
+                                     include_requests=include_requests)
+
     def snapshot(self) -> dict:
         """The fleet section of /statusz: per-replica stats + serving
         snapshots, router policy/decisions, handoff + failover + scaling
-        counters. Host state only."""
+        counters, the aggregated telemetry view, the flight-recorder
+        timeline, and the per-request waterfall. Host state only."""
         replicas = {}
         for rid, rep in sorted(self._replicas.items()):
             entry = {"role": rep.role, "alive": rep.alive,
@@ -630,7 +757,7 @@ class ServingFleet:
                 entry["serving"] = rep.engine.metrics.snapshot()
             entry["telemetry_port"] = rep.telemetry_port
             replicas[str(rid)] = entry
-        return {
+        out = {
             "iteration": self._iteration,
             "backend": self.fcfg.backend,
             "disaggregate": self.fcfg.disaggregate,
@@ -645,14 +772,57 @@ class ServingFleet:
             "requests_submitted": self.requests_submitted,
             "requests_finished": self.requests_finished,
             "autoscale": self.last_scale_decision,
+            "flight_recorder": self.recorder.snapshot(),
+            "per_request_breakdown": self.per_request_breakdown(
+                include_requests=False),
         }
+        if self._aggregator is not None:
+            out["telemetry"] = self._aggregator.snapshot()
+        return out
 
     def metrics_snapshot(self) -> dict:
         """The router-level /statusz payload: the process registry plus
-        the fleet section (observability/export.py renders it)."""
+        the fleet section (observability/export.py renders it). The
+        aggregator's per-replica up/staleness gauges and merged totals
+        fold into the registry view, so the router's /metrics carries
+        ``ds_tpu_fleet_replica_*`` and ``ds_tpu_fleet_merged_*``
+        series — the fleet-wide scrape surface."""
         from ...observability.metrics import get_registry
-        return {"registry": get_registry().snapshot(),
-                "fleet": self.snapshot()}
+        reg = get_registry().snapshot()
+        if self._aggregator is not None:
+            reg.setdefault("gauges", {}).update(self._aggregator.gauges())
+        return {"registry": reg, "fleet": self.snapshot()}
+
+    # -- fleet-wide trace stitching ----------------------------------------
+    def trace_dumps(self):
+        """Collect the per-lane Chrome-trace dumps: the router
+        process's own active tracer (which, on the in-process backend,
+        also holds every replica's spans — one process, one stream)
+        plus each process replica's ``trace_dump`` (workers record when
+        ``serving.fleet.replica_trace`` is on)."""
+        from ...observability.trace import active_tracer, chrome_trace_events
+        dumps = []
+        tracer = active_tracer()
+        if tracer is not None and tracer.events:
+            dumps.append(("router", chrome_trace_events(tracer.events)))
+        for rid, rep in sorted(self._replicas.items()):
+            events = rep.trace_dump()
+            if events:
+                dumps.append((f"replica{rid}:{rep.role}", events))
+        return dumps
+
+    def stitched_trace(self) -> dict:
+        """ONE Chrome trace for the whole fleet: one process lane per
+        replica (plus the router), request spans joined across lanes by
+        their ``args.trace_id``. Load it in chrome://tracing or
+        Perfetto; ``breakdown_from_trace`` rebuilds the per-request
+        waterfall from it."""
+        from ...observability.fleet import stitch_chrome_traces
+        return stitch_chrome_traces(self.trace_dumps())
+
+    def write_stitched_trace(self, path: str) -> str:
+        from ...observability.fleet import write_stitched_trace
+        return write_stitched_trace(self.trace_dumps(), path)
 
     def start_telemetry(self, port: int = 0, host: str = "127.0.0.1"):
         """Router-level /metrics + /healthz + /statusz (the fleet

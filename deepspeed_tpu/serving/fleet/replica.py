@@ -126,10 +126,10 @@ class LocalReplica:
         return t.port if t is not None else None
 
     def submit(self, prompt, max_new_tokens, request_id, priority=0,
-               on_token=None):
+               on_token=None, trace_id=None):
         return self.engine.submit(prompt, max_new_tokens,
                                   request_id=request_id, on_token=on_token,
-                                  priority=priority)
+                                  priority=priority, trace_id=trace_id)
 
     def advance(self):
         if self.fail_at is not None and \
@@ -157,6 +157,24 @@ class LocalReplica:
     @property
     def busy(self) -> bool:
         return self.alive and self.engine.busy
+
+    def trace_dump(self):
+        """In-process replicas record into the ROUTER's tracer (one
+        process, one span stream) — there is no per-replica dump; the
+        stitcher gives the whole in-process fleet one lane."""
+        return None
+
+    def metrics_sample(self):
+        """Direct host-dict snapshot for the telemetry aggregator (the
+        in-process analog of a /metrics scrape). Keys are normalized to
+        the SAME ``serving_*`` names a worker's scraped /metrics parses
+        to, so `ds_tpu_fleet_merged_*` series keep one name space
+        whichever backend serves them. Stays readable after death —
+        the work a dead replica served must not vanish."""
+        from ...observability.export import prometheus_name
+        return {prometheus_name(f"serving/{k}", prefix=""): v
+                for k, v in self.engine.metrics.snapshot().items()
+                if isinstance(v, (int, float))}
 
     # -- handoff -----------------------------------------------------------
     def take_handoff_ready(self) -> List:
@@ -201,6 +219,8 @@ class ProcessReplica:
         self.missed_health = 0
         self.reply_timeout_s = reply_timeout_s
         self.telemetry_port: Optional[int] = None
+        self._scrape = None   # cached MetricsScrapeClient (staleness
+                              # stamps accumulate across probes)
         self._last_stats: Optional[ReplicaStats] = None
         self._inflight = 0    # submits since the last advance reply —
                               # folded into queue_depth so a same-step
@@ -274,14 +294,16 @@ class ProcessReplica:
 
     # -- the replica surface ----------------------------------------------
     def submit(self, prompt, max_new_tokens, request_id, priority=0,
-               on_token=None):
+               on_token=None, trace_id=None):
         """Forward one submission; token streaming arrives as events in
         later ``advance()`` replies (``on_token`` is ignored here — the
-        manager applies events to its fleet handles)."""
+        manager applies events to its fleet handles). ``trace_id``
+        crosses the pipe so the worker's spans join the fleet trace."""
         self._send({"op": "submit", "id": request_id,
                     "prompt": np.asarray(prompt, np.int32).tolist(),
                     "max_new_tokens": int(max_new_tokens),
-                    "priority": int(priority)})
+                    "priority": int(priority),
+                    "trace_id": trace_id})
         self._inflight += 1
         return self._read_reply()
 
@@ -314,6 +336,20 @@ class ProcessReplica:
             return False
         return True
 
+    @property
+    def scrape_client(self):
+        """Cached scrape client over this worker's telemetry endpoint
+        (one client per replica so its ``last_success_unix`` staleness
+        stamp accumulates across health sweeps and aggregator polls);
+        None without a telemetry port."""
+        if self.telemetry_port is None:
+            return None
+        if self._scrape is None:
+            from ...observability.export import MetricsScrapeClient
+            self._scrape = MetricsScrapeClient(
+                f"http://127.0.0.1:{self.telemetry_port}")
+        return self._scrape
+
     def probe_health(self) -> str:
         """Health-sweep probe: a dead process (exit/kill/pipe loss) is
         ``"dead"`` immediately; a live worker whose telemetry endpoint
@@ -323,12 +359,27 @@ class ProcessReplica:
         only signal and a live one reads ``"ok"``."""
         if not self.healthy():
             return "dead"
-        if self.telemetry_port:
-            from ...observability.export import MetricsScrapeClient
-            probe = MetricsScrapeClient(
-                f"http://127.0.0.1:{self.telemetry_port}")
+        probe = self.scrape_client
+        if probe is not None:
             return "ok" if probe.healthz() else "miss"
         return "ok"
+
+    def trace_dump(self):
+        """Pull the worker's recorded span stream (Chrome-trace event
+        dicts) for stitching; [] when the worker records no spans or
+        has died (a dead lane is simply absent from the stitched
+        trace)."""
+        try:
+            self._send({"op": "trace_dump"})
+            return self._read_reply().get("events") or []
+        except (ReplicaDead, RuntimeError):
+            return []
+
+    def metrics_sample(self):
+        """Aggregator source: parsed /metrics scrape, or None when the
+        endpoint is unreachable/absent."""
+        probe = self.scrape_client
+        return probe.gauges() if probe is not None else None
 
     @property
     def busy(self) -> bool:

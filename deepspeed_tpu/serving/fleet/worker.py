@@ -59,6 +59,14 @@ class _Worker:
     def __init__(self, spec: dict):
         self.replica_id = spec.get("replica_id", 0)
         self.role = spec.get("role", "full")
+        if spec.get("trace"):
+            # fleet-wide tracing: this worker's spans (queue wait,
+            # admit, prefill chunks, handoff inject, decode residency —
+            # each tagged with its request's trace_id) record into a
+            # process-local tracer the parent pulls via ``trace_dump``
+            # and stitches into one fleet Chrome trace
+            from ...observability.trace import Tracer, activate
+            activate(Tracer())
         self.engine = _build_engine(spec)
         if self.role == "prefill":
             self.engine.set_prefill_role(True)
@@ -68,6 +76,7 @@ class _Worker:
             telemetry_port = self.engine.start_telemetry(port=port).port
         self._handles = {}           # id -> Request
         self._reported = set()       # ids whose completion already went out
+        self._admit_reported = set() # ids whose first admission went out
         self._events = []            # [[id, token, engine iteration]]
         self._staged = {}            # id -> (slot, req) awaiting export
         _reply({"op": "ready", "replica_id": self.replica_id,
@@ -96,9 +105,22 @@ class _Worker:
         req = self.engine.submit(
             np.asarray(msg["prompt"], np.int32), msg["max_new_tokens"],
             request_id=msg["id"], priority=msg.get("priority", 0),
-            on_token=self._on_token)
+            on_token=self._on_token, trace_id=msg.get("trace_id"))
         self._handles[msg["id"]] = req
         _reply({"op": "submitted", "id": msg["id"], "status": req.status})
+
+    def _admissions(self):
+        """Ids admitted since the last advance reply (first admission
+        only — a preempt/resume cycle is not a fresh queue->admit
+        transition): the parent stamps its fleet-clock admit mark for
+        the per-request waterfall from these."""
+        out = []
+        for rid, req in self._handles.items():
+            if (req.admitted_iteration is not None
+                    and rid not in self._admit_reported):
+                self._admit_reported.add(rid)
+                out.append(rid)
+        return sorted(out, key=str)
 
     def op_advance(self, msg):
         self.engine.advance()
@@ -110,6 +132,7 @@ class _Worker:
             if k not in ("replica_id", "alive", "role")}
         _reply({"op": "advanced", "iteration": self.engine.iteration,
                 "events": events, "finished": self._completions(),
+                "admitted": self._admissions(),
                 "handoff_ready": sorted(self._staged, key=str),
                 "stats": stats})
 
@@ -128,8 +151,18 @@ class _Worker:
                                           on_token=self._on_token)
         if live is not None:
             self._handles[rid] = live
+            self._admit_reported.add(rid)   # injection IS the admission
         _reply({"op": "injected", "id": rid,
                 "accepted": live is not None})
+
+    def op_trace_dump(self, msg):
+        """Ship this worker's recorded span stream as Chrome-trace
+        event dicts (JSON-able) for fleet-level stitching."""
+        from ...observability.trace import active_tracer, chrome_trace_events
+        tracer = active_tracer()
+        events = chrome_trace_events(tracer.events) if tracer else []
+        _reply({"op": "trace", "replica_id": self.replica_id,
+                "events": events})
 
     def serve(self):
         for line in sys.stdin:

@@ -19,6 +19,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from ..observability.fleet import FlightRecorder
 from ..observability.metrics import get_registry
 from ..observability.metrics import percentile as _percentile_impl
 
@@ -42,10 +43,16 @@ def _percentile(values, q):
 
 class ServingMetrics:
     def __init__(self, monitor=None, interval: int = 50,
-                 history_window: int = HISTORY_WINDOW, registry=None):
+                 history_window: int = HISTORY_WINDOW, registry=None,
+                 flight_recorder_events: int = 256):
         self.monitor = monitor
         self.interval = max(1, int(interval))
         self.history_window = max(1, int(history_window))
+        # bounded request-lifecycle ring (observability/fleet.py): the
+        # last-N-requests timeline the partial-snapshot/crash path dumps
+        # — admit/preempt/handoff/shed/finish with trace_ids, stamped on
+        # the deterministic engine clock. 0 disables.
+        self.flight = FlightRecorder(flight_recorder_events)
         # mirror into the process-wide observability registry so one
         # snapshot covers train + serve + resilience; registry=False
         # opts out (isolated tests)
@@ -64,6 +71,7 @@ class ServingMetrics:
             self.registry.register_collector("serving", _collect)
 
     def reset(self):
+        self.flight.clear()
         self.requests_submitted = 0
         self.requests_admitted = 0
         self.requests_finished = 0
@@ -149,6 +157,17 @@ class ServingMetrics:
             return None
         return _percentile(self.ttft_steps_under_load, 95)
 
+    # -- flight recorder ---------------------------------------------------
+    def _flight(self, event, request, iteration=None, **extra):
+        """One lifecycle breadcrumb into the bounded recorder ring
+        (host ints + the request's own stamps — no clock beyond the
+        recorder's wall stamp, never a device read)."""
+        if request is None:
+            return
+        self.flight.record(event, request_id=request.request_id,
+                           trace_id=getattr(request, "trace_id", None),
+                           iteration=iteration, **extra)
+
     # -- engine hooks ------------------------------------------------------
     def on_submit(self, request=None):
         if self.started_at is None:
@@ -157,6 +176,9 @@ class ServingMetrics:
         c = self._cls(request)
         if c is not None:
             c["submitted"] += 1
+        self._flight("submit", request,
+                     iteration=getattr(request, "submitted_iteration",
+                                       None))
 
     def on_admit(self, request=None, shared_tokens: int = 0):
         self.requests_admitted += 1
@@ -165,6 +187,9 @@ class ServingMetrics:
         c = self._cls(request)
         if c is not None:
             c["admitted"] += 1
+        self._flight("admit", request,
+                     iteration=getattr(request, "admitted_iteration",
+                                       None))
 
     def on_prefill_chunk(self, tokens_computed: int):
         self.prefill_chunks += 1
@@ -182,9 +207,13 @@ class ServingMetrics:
         c = self._cls(request)
         if c is not None:
             c["timed_out"] += 1
+        self._flight("timeout", request,
+                     iteration=request.finished_iteration)
 
     def on_cancel(self, request):
         self.requests_cancelled += 1
+        self._flight("cancelled", request,
+                     iteration=request.finished_iteration)
 
     def on_reject(self):
         self.requests_rejected += 1
@@ -202,6 +231,9 @@ class ServingMetrics:
             c["shed"] += 1
         if self.registry is not None:
             self.registry.counter("serving/requests_shed").inc()
+        self._flight("shed", request,
+                     iteration=request.finished_iteration,
+                     reason=key)
 
     def on_preempt(self, request, reason="priority"):
         self.requests_preempted += 1
@@ -210,6 +242,9 @@ class ServingMetrics:
             c["preempted"] += 1
         if self.registry is not None:
             self.registry.counter("serving/requests_preempted").inc()
+        self._flight("preempt", request,
+                     iteration=request.preempted_iteration,
+                     reason=reason, tokens_retained=len(request.tokens))
 
     def on_resume(self, request):
         self.requests_resumed += 1
@@ -218,6 +253,8 @@ class ServingMetrics:
             c["resumed"] += 1
         if self.registry is not None:
             self.registry.counter("serving/requests_resumed").inc()
+        self._flight("resume", request,
+                     iteration=request.admitted_iteration)
 
     def on_handoff_export(self, request):
         """One prefilled request shipped out as a page handoff (the
@@ -227,6 +264,8 @@ class ServingMetrics:
         self.handoffs_exported += 1
         if self.registry is not None:
             self.registry.counter("serving/handoffs_exported").inc()
+        self._flight("handoff_export", request,
+                     iteration=request.first_token_iteration)
 
     def on_handoff_import(self, request, prefill_tokens: int):
         """One page handoff continued on this engine: counts as an
@@ -241,6 +280,9 @@ class ServingMetrics:
             c["admitted"] += 1
         if self.registry is not None:
             self.registry.counter("serving/handoffs_imported").inc()
+        self._flight("handoff_inject", request,
+                     iteration=request.admitted_iteration,
+                     prefill_tokens=prefill_tokens)
 
     def on_fault(self, kind: str, detail: str, iteration: int):
         """One containment event (watchdog fire, OOM shed, recovery):
@@ -262,6 +304,15 @@ class ServingMetrics:
 
     def on_finish(self, request):
         self.requests_finished += 1
+        # retroactive first_token mark + the terminal event: together
+        # with submit/admit above these give the recorder (and
+        # per_request_breakdown) a complete stage chain per request
+        if request.first_token_iteration is not None:
+            self._flight("first_token", request,
+                         iteration=request.first_token_iteration)
+        self._flight("finished", request,
+                     iteration=request.finished_iteration,
+                     tokens=len(request.tokens))
         if request.ttft_s is not None:
             self.ttft_s.append(request.ttft_s)
         if (request.first_token_iteration is not None
@@ -412,6 +463,12 @@ class ServingMetrics:
             # breadcrumb list (capped): /statusz and the BENCH artifact
             # show WHAT fired, not just that a counter moved
             out["faults"] = list(self.faults)
+        if self.flight.events:
+            # the last-N-requests lifecycle timeline (bounded ring):
+            # rides every snapshot, so the partial-snapshot/crash path
+            # dumps it for free — a dead engine leaves a reconstructable
+            # tail of admits/preempts/handoffs/sheds/finishes
+            out["flight_recorder"] = self.flight.snapshot()
         # per-priority-class breakdown as flat numeric keys so the
         # registry collector, /metrics (Prometheus), /statusz, and
         # ds_tpu_report all surface it without schema changes

@@ -38,8 +38,13 @@ class Request:
     def __init__(self, prompt, max_new_tokens: int, request_id,
                  on_token: Optional[Callable] = None,
                  deadline_steps: Optional[int] = None,
-                 priority: int = 0):
+                 priority: int = 0, trace_id: Optional[str] = None):
         self.request_id = request_id
+        # distributed trace id (observability/fleet.py): follows the
+        # request across replicas — through the worker protocol and the
+        # handoff wire format — so one id joins its spans fleet-wide.
+        # None until the engine (or fleet) stamps one at submit.
+        self.trace_id = trace_id
         self.prompt = prompt                      # 1-D int32 numpy array
         self.max_new_tokens = int(max_new_tokens)
         self.on_token = on_token
@@ -68,8 +73,14 @@ class Request:
         # population the p95-TTFT-under-load gauge aggregates (an idle
         # server's instant TTFTs would wash the load signal out)
         self.submitted_under_load = False
-        # host wall-clock stamps (time.perf_counter)
+        # host wall-clock stamps (time.perf_counter); the _ns twins are
+        # perf_counter_ns on the SAME clock so the tracer can emit
+        # retroactive queue-wait / decode-residency spans without any
+        # extra clock reads on the hot path
         self.submitted_at = time.perf_counter()
+        self.submitted_at_ns = time.perf_counter_ns()
+        self.admitted_at_ns: Optional[int] = None
+        self.preempted_at_ns: Optional[int] = None
         self.admitted_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
@@ -86,6 +97,7 @@ class Request:
         self.slot = slot
         self.status = RUNNING
         self.admitted_at = time.perf_counter()
+        self.admitted_at_ns = time.perf_counter_ns()
         self.admitted_iteration = iteration
 
     def _emit(self, token: int, iteration: int):
@@ -127,6 +139,7 @@ class Request:
         self.status = PREEMPTED
         self.preemptions += 1
         self.preempted_iteration = iteration
+        self.preempted_at_ns = time.perf_counter_ns()
 
     def deadline_iteration(self) -> Optional[int]:
         """Absolute engine iteration past which a still-queued request

@@ -247,6 +247,79 @@ def test_report_diff_two_snapshots(tmp_path):
     assert "no such snapshot" in r2.stderr
 
 
+def test_report_fleet_snapshot_and_trace(tmp_path):
+    """ds_tpu_report --fleet: renders per-replica health + aggregated
+    totals + the per-request waterfall from a fleet snapshot, and the
+    wall-ms waterfall from a stitched trace (stdlib path, no jax)."""
+    snap = {"iteration": 12, "backend": "inprocess",
+            "replicas": {"0": {"role": "full", "alive": True,
+                               "queue_depth": 0, "active_slots": 1,
+                               "num_slots": 2}},
+            "router": {"policy": "prefix_affinity"},
+            "handoffs_completed": 1, "failovers": 0, "dead_replicas": 0,
+            "requests_submitted": 2, "requests_finished": 2,
+            "telemetry": {"replicas": {"0": {"up": True,
+                                             "staleness_s": 0.5}},
+                          "merged": {"requests_finished": 2}},
+            "flight_recorder": {"dropped": 0, "events": [
+                {"event": "submit", "request_id": "r", "trace_id": "t",
+                 "iteration": 0, "replica_id": 0},
+                {"event": "admit", "request_id": "r", "trace_id": "t",
+                 "iteration": 1, "replica_id": 0},
+                {"event": "first_token", "request_id": "r",
+                 "trace_id": "t", "iteration": 3, "replica_id": 0},
+                {"event": "finished", "request_id": "r", "trace_id": "t",
+                 "iteration": 9, "replica_id": 0}]}}
+    path = tmp_path / "fleet.json"
+    path.write_text(json.dumps(snap))
+    r = _run([os.path.join(BIN, "ds_tpu_report"), "--fleet", str(path)])
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "replica 0 [full]" in r.stdout and "up" in r.stdout
+    assert "requests_finished: 2" in r.stdout
+    assert "per-request waterfall (fleet steps)" in r.stdout
+    assert "queue" in r.stdout and "decode" in r.stdout
+    assert "flight recorder" in r.stdout
+    # stitched-trace form: the wall-ms waterfall
+    trace = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "replica0:prefill"}},
+        {"name": "serving/queue_wait", "ph": "X", "ts": 0.0,
+         "dur": 1500.0, "pid": 0, "tid": 0, "args": {"trace_id": "t"}},
+        {"name": "serving/decode_residency", "ph": "X", "ts": 0.0,
+         "dur": 4000.0, "pid": 1, "tid": 0, "args": {"trace_id": "t"}}]}
+    tpath = tmp_path / "trace.json"
+    tpath.write_text(json.dumps(trace))
+    r2 = _run([os.path.join(BIN, "ds_tpu_report"), "--fleet",
+               str(tpath)])
+    assert r2.returncode == 0, r2.stderr[-500:]
+    assert "wall ms" in r2.stdout and "replica0:prefill" in r2.stdout
+    # missing file: readable exit 2, not a traceback
+    r3 = _run([os.path.join(BIN, "ds_tpu_report"), "--fleet",
+               str(tmp_path / "nope.json")])
+    assert r3.returncode == 2 and "no such fleet artifact" in r3.stderr
+
+
+@pytest.mark.slow
+def test_serve_fleet_trace_out_stitched(tmp_path):
+    """--trace-out on a disaggregated fleet run writes ONE stitched
+    Chrome trace and prints the waterfall in the exit summary."""
+    out = tmp_path / "fleet_trace.json"
+    r = _run([os.path.join(BIN, "ds_tpu_serve"), "--synthetic", "3",
+              "--replicas", "2", "--disaggregate", *FLEET_ARGS,
+              "--trace-out", str(out)], timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "per-request waterfall (fleet steps)" in r.stdout
+    assert "# stitched fleet trace:" in r.stdout
+    trace = json.loads(out.read_text())
+    names = {e["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    assert "serving/handoff_inject" in names
+    tagged = [e for e in trace["traceEvents"]
+              if e.get("ph") == "X"
+              and (e.get("args") or {}).get("trace_id")]
+    assert tagged, "spans must carry trace ids"
+
+
 def test_chaos_smoke_torn_scenario(tmp_path):
     """Fast chaos smoke (tier-1): the torn-save scenario must recover —
     the CLI exits 0 only when the fallback restored a verified tag —

@@ -378,6 +378,73 @@ class TestPrometheusFormat:
         assert 'category="rollback_recovery",kind="badput"' in text
         assert "ds_tpu_probe_host_reads 2.0" in text
 
+    def test_render_parse_roundtrip_preserves_every_family(self):
+        """The satellite acceptance: ``render -> parse`` preserves
+        every counter/gauge family — sanitized names, labeled series,
+        histogram summaries, collected numerics — value-exactly."""
+        from deepspeed_tpu.observability.export import parse_prometheus
+        reg = MetricsRegistry()
+        reg.counter("train/steps_total").inc(5)
+        reg.counter("comm/traced_bytes/all_reduce:data").inc(4096)
+        reg.counter("1weird name!").inc(2)        # sanitized name
+        reg.gauge("serving/queue_depth").set(3)
+        reg.gauge("mem/hbm_used").set(1.25e9)
+        reg.gauge("flag").set(True)               # bool -> 1
+        reg.histogram("lat").observe(1.0)
+        reg.histogram("lat").observe(3.0)
+        reg.register_collector("serving", lambda: {"tokens": 7,
+                                                   "frac": 0.5})
+        led = GoodputLedger().start()
+        led.note("compute", 2.0)
+        led.note("compile", 1.0)
+        snap = {"registry": reg.snapshot(), "goodput": led.breakdown(),
+                "perf": {"mfu": 0.5}}
+        parsed = parse_prometheus(render_prometheus(snap))
+        # every counter family survives, sanitized, value-exact
+        assert parsed["ds_tpu_train_steps_total"] == 5.0
+        assert parsed["ds_tpu_comm_traced_bytes_all_reduce:data"] \
+            == 4096.0
+        assert parsed["ds_tpu__1weird_name_"] == 2.0
+        # every gauge family (incl. bool coercion + big floats)
+        assert parsed["ds_tpu_serving_queue_depth"] == 3.0
+        assert parsed["ds_tpu_mem_hbm_used"] == 1.25e9
+        assert parsed["ds_tpu_flag"] == 1.0
+        # histogram summaries: quantile series + count + sum
+        assert parsed['ds_tpu_lat{quantile="0.5"}'] == 1.0
+        assert parsed['ds_tpu_lat{quantile="0.95"}'] == 3.0
+        assert parsed["ds_tpu_lat_count"] == 2.0
+        assert parsed["ds_tpu_lat_sum"] == 4.0
+        # collected numerics + perf + labeled goodput series
+        assert parsed["ds_tpu_serving_tokens"] == 7.0
+        assert parsed["ds_tpu_serving_frac"] == 0.5
+        assert parsed["ds_tpu_perf_mfu"] == 0.5
+        compute = parsed['ds_tpu_goodput_seconds{category="compute",'
+                         'kind="goodput"}']
+        assert compute == 1.0   # compile re-attributed out of compute
+        # nothing in the rendered text failed to parse back: every
+        # non-comment line's value is accounted for
+        rendered_samples = [
+            line for line in render_prometheus(snap).splitlines()
+            if line and not line.startswith("#")]
+        assert len(rendered_samples) == len(parsed)
+
+    def test_label_values_escape_roundtrip(self):
+        """Label values with quotes/backslashes/newlines used to mangle
+        the sample line (an unescaped ``"`` ends the label early);
+        render now escapes them and the value still parses back."""
+        from deepspeed_tpu.observability.export import parse_prometheus
+        text = render_prometheus({
+            "registry": {"counters": {}, "gauges": {}, "histograms": {}},
+            "goodput": {"fractions": {'we"ird\\cat': 1.0},
+                        "seconds": {'we"ird\\cat': 2.0}},
+        })
+        assert '\\"' in text and "\\\\" in text
+        assert "\n" == text[-1]                   # no raw newlines mid-line
+        parsed = parse_prometheus(text)
+        labeled = [k for k in parsed
+                   if k.startswith("ds_tpu_goodput_fraction{")]
+        assert labeled and parsed[labeled[0]] == 1.0
+
     def test_statusz_sections(self):
         snap = {"registry": {"meta": {"capture_seq": 1},
                              "counters": {"c": 1}, "gauges": {},
