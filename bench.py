@@ -652,6 +652,177 @@ def bench_fused_epilogue(np, jax, jnp, d=4096, reps=400):
                            "dropped"} if invalid else {})}
 
 
+def bench_offload(np, jax, jnp, ds, models, steps=10, warmup=2,
+                  d_model=192, n_layers=4, seq=128, batch_rows=16):
+    """Tiered-residency offload scenario (runtime/tiering/,
+    docs/offload.md) on the CPU backend: the same model + batches train
+    under {all_resident, host_offload, host_disk} plans against a
+    SYNTHETIC device budget smaller than params+optimizer state, plus a
+    prefetch-off control arm at the host_disk plan.
+
+    What the artifact proves (and how):
+    - steps/s per plan — the residency cost in wall clock;
+    - the goodput ledger's ``data_stall`` fraction per arm (PR 8's
+      instrument, reset after warmup so the window is clean): prefetch
+      ON vs OFF at the SAME plan must show the stall fraction dropping —
+      overlap measured, not claimed;
+    - bitwise parity: every plan's final params equal the all_resident
+      arm's (the tiering acceptance invariant);
+    - per-tier residency (``mem/by_tier/*``) and transfer-byte deltas
+      from the metrics registry.
+    """
+    import tempfile
+    from deepspeed_tpu.models import GPT, GPTConfig, gpt_loss_fn
+    from deepspeed_tpu.observability.goodput import get_ledger, reset_ledger
+    from deepspeed_tpu.observability.metrics import get_registry
+
+    vocab = 512
+    mc = GPTConfig(vocab_size=vocab, max_seq_len=seq, d_model=d_model,
+                   n_layers=n_layers, n_heads=d_model // 32,
+                   dtype=jnp.float32, scan_layers=True)
+
+    def loss_fn(model, params, batch, rng, train):
+        ids = batch["input_ids"]
+        logits = model.apply(params, ids, deterministic=not train)
+        return gpt_loss_fn(logits[:, :-1], ids[:, 1:])
+
+    def make_batch(seed):
+        r = np.random.default_rng(seed)
+        return {"input_ids": r.integers(0, vocab, size=(batch_rows, seq),
+                                        dtype="int32")}
+
+    # size the synthetic hierarchy so the model does NOT fit the device
+    # budget and the host budget forces a real disk spill
+    n_params = 12 * d_model * d_model * n_layers + vocab * d_model * 2 \
+        + seq * d_model
+    state_bytes = n_params * 4 * 3          # params + two fp32 moments
+    hbm_budget = state_bytes // 3           # < params + moments
+    host_budget = state_bytes // 3
+
+    work = tempfile.mkdtemp(prefix="ds_tpu_bench_offload_")
+    arms = {
+        "all_resident": {"plan": "all_resident"},
+        "host_offload": {"plan": "host_offload"},
+        "host_disk": {"plan": "host_disk",
+                      "host_budget_bytes": host_budget},
+        "host_disk_noprefetch": {"plan": "host_disk",
+                                 "host_budget_bytes": host_budget,
+                                 "prefetch": False},
+    }
+    results, params_by_arm = {}, {}
+    for arm, knobs in arms.items():
+        tiering = {"enabled": True, "probe_bandwidth": arm == "all_resident",
+                   "hbm_budget_bytes": hbm_budget,
+                   "disk_path": os.path.join(work, arm), **knobs}
+        cfg = {"train_batch_size": batch_rows,
+               "train_micro_batch_size_per_gpu":
+                   batch_rows // jax.device_count(),
+               "gradient_accumulation_steps": 1,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 10 ** 9, "tiering": tiering}
+        engine, _, _, _ = ds.initialize(
+            model=GPT(mc), config=cfg, loss_fn=loss_fn,
+            sample_batch=make_batch(0), rng=jax.random.PRNGKey(0))
+        for s in range(warmup):
+            engine.train_batch(make_batch(s))
+        reg = get_registry()
+
+        def xfer():
+            snap = reg.snapshot().get("counters") or {}
+            return {k: v for k, v in snap.items()
+                    if k.startswith("tiering/transfer_bytes/")}
+        before = xfer()
+        reset_ledger()
+        t0 = time.time()
+        for s in range(warmup, warmup + steps):
+            engine.train_batch(make_batch(s))
+        wall = time.time() - t0
+        breakdown = get_ledger().breakdown()
+        after = xfer()
+        if engine.tiering is not None:
+            engine.params, engine.optimizer_state = engine.tiering.stage_in(
+                engine.params, engine.optimizer_state)
+        params_by_arm[arm] = [np.array(x)
+                              for x in jax.tree.leaves(engine.params)]
+        gauges = reg.snapshot().get("gauges") or {}
+        results[arm] = {
+            "steps_per_sec": round(steps / wall, 3),
+            "wall_s": round(wall, 3),
+            "goodput": {
+                "fractions": {k: round(v, 5)
+                              for k, v in breakdown["fractions"].items()},
+                "seconds": {k: round(v, 5)
+                            for k, v in breakdown["seconds"].items()},
+            },
+            "data_stall_fraction": round(
+                breakdown["fractions"]["data_stall"], 5),
+            "mem_by_tier": {k.split("/")[-1]: int(v)
+                            for k, v in gauges.items()
+                            if k.startswith("mem/by_tier/")},
+            "transfer_bytes": {k.split("/")[-1]:
+                               int(after.get(k, 0) - before.get(k, 0))
+                               for k in after},
+            "plan": engine.tiering.report()["plan"]["name"],
+        }
+        engine.destroy()
+    ref = params_by_arm["all_resident"]
+    for arm, leaves in params_by_arm.items():
+        results[arm]["bitwise_match_all_resident"] = bool(
+            all(np.array_equal(a, b) for a, b in zip(ref, leaves)))
+    stall_on = results["host_disk"]["data_stall_fraction"]
+    stall_off = results["host_disk_noprefetch"]["data_stall_fraction"]
+    return {
+        "model": {"params": int(n_params), "d_model": d_model,
+                  "n_layers": n_layers, "seq": seq,
+                  "state_bytes": int(state_bytes)},
+        "budgets": {"hbm_budget_bytes": int(hbm_budget),
+                    "host_budget_bytes": int(host_budget)},
+        "arms": results,
+        "prefetch_stall_fraction_on": stall_on,
+        "prefetch_stall_fraction_off": stall_off,
+        "prefetch_overlap_proven": bool(stall_on < stall_off),
+    }
+
+
+def offload_main(argv):
+    """``python bench.py --offload [--out PATH] [--steps N]``: the
+    tiering scenario on the CPU backend (no device watchdog — this
+    bench's whole point is to run where HBM is synthetic). The partial-
+    artifact crash path is the same as the main harness."""
+    out_path = "BENCH_offload.json"
+    steps = 10
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    if "--steps" in argv:
+        steps = int(argv[argv.index("--steps") + 1])
+    extra = {}
+    install_failure_handlers(extra)
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")   # env alone loses to sitecustomize
+    import jax.numpy as jnp
+    import deepspeed_tpu as ds
+    import deepspeed_tpu.models as models
+    try:
+        extra["offload"] = bench_offload(np, jax, jnp, ds, models,
+                                         steps=steps)
+    except BaseException as e:
+        emit_failure(f"offload bench crashed: {type(e).__name__}: {e}",
+                     extra)
+        raise
+    artifact = {
+        "metric": "offload_data_stall_fraction_prefetch_on",
+        "value": extra["offload"]["prefetch_stall_fraction_on"],
+        "unit": "fraction of wall clock (goodput ledger)",
+        "vs_baseline": None,
+        "extra": extra,
+    }
+    line = json.dumps(artifact)
+    print(line)
+    with open(out_path, "w") as f:
+        f.write(line + "\n")
+
+
 def _device_watchdog(probe_timeout_s=None, interval_s=None, window_s=None):
     """Probe-and-retry across a long window instead of failing on one
     probe: the tunneled TPU backend on this rig flaps for minutes at a
@@ -789,6 +960,9 @@ def main():
 
 if __name__ == "__main__":
     try:
+        if "--offload" in sys.argv[1:]:
+            offload_main(sys.argv[1:])
+            raise SystemExit(0)
         main()
     except SystemExit:
         raise           # the watchdog already emitted its artifact

@@ -86,7 +86,8 @@ class Autotuner:
     def build_space(base_config: Dict[str, Any], zero_stages: List[int],
                     micro_batches: List[int], dp_world_size: int = 1,
                     gas_values: Optional[List[int]] = None,
-                    remat_policies: Optional[List[Optional[str]]] = None
+                    remat_policies: Optional[List[Optional[str]]] = None,
+                    tiering_plans: Optional[List[Optional[str]]] = None
                     ) -> List[Dict[str, Any]]:
         """gas_values extends the space over gradient_accumulation_steps —
         the amortization axis for once-per-step costs (host-offload moment
@@ -98,13 +99,23 @@ class Autotuner:
         REMAT_POLICIES keys) — the real TPU recompute/memory trade knob:
         cheaper policies free HBM for bigger micro batches but recompute
         less, so it must be costed JOINTLY with micro_batch. Entries may
-        include None (keep the base config's policy)."""
+        include None (keep the base config's policy).
+
+        tiering_plans extends the space over the residency plan
+        (runtime/tiering/ PLAN_NAMES, docs/offload.md) — the memory-
+        hierarchy axis: deeper plans free HBM for bigger micro batches
+        at a measured transfer cost, so like remat it must be costed
+        jointly. Entries: None (keep the base config's tiering block
+        untouched) or a plan name ('all_resident'/'host_offload'/
+        'host_disk'/'auto'), merged over the base config's tiering
+        block with enabled=True."""
         space = []
         gases = gas_values or [base_config.get(
             "gradient_accumulation_steps", 1)]
         remats = remat_policies if remat_policies else [None]
-        for stage, mb, gas, rp in itertools.product(
-                zero_stages, micro_batches, gases, remats):
+        plans = tiering_plans if tiering_plans else [None]
+        for stage, mb, gas, rp, plan in itertools.product(
+                zero_stages, micro_batches, gases, remats, plans):
             cfg = {k: (dict(v) if isinstance(v, dict) else v)
                    for k, v in base_config.items()}
             cfg.setdefault("zero_optimization", {})
@@ -117,6 +128,9 @@ class Autotuner:
                 cfg["activation_checkpointing"] = dict(
                     cfg.get("activation_checkpointing") or {},
                     remat_policy=rp)
+            if plan is not None:
+                cfg["tiering"] = dict(cfg.get("tiering") or {},
+                                      enabled=True, plan=plan)
             space.append(cfg)
         return space
 
@@ -153,10 +167,19 @@ class Autotuner:
         zero = config.get("zero_optimization") or {}
         dtype_b = 2 if (config.get("bf16") or {}).get("enabled") or \
             (config.get("fp16") or {}).get("enabled") else 4
-        total = p * dtype_b                      # params
+        tier = config.get("tiering") or {}
+        tier_plan = tier.get("plan", "auto") if tier.get("enabled") else None
+        tier_off = tier_plan in ("host_offload", "host_disk")
+        if tier_off and tier.get("offload_params", True):
+            # stacked block params leave HBM under the plan; embeddings
+            # and small leaves stay resident (~1/4 of a GPT's params is a
+            # conservative resident share for the pre-pass)
+            total = p * dtype_b // 4
+        else:
+            total = p * dtype_b                  # params
         total += p * 4                           # fp32 grad accumulation
         off_opt = (zero.get("offload_optimizer") or {}).get("device") \
-            in ("cpu", "nvme")
+            in ("cpu", "nvme") or tier_off
         if not off_opt:
             total += 3 * p * 4                   # master + 2 Adam moments
         hidden = model_info.get("hidden_size")
@@ -239,6 +262,7 @@ class Autotuner:
              early_stop: Optional[int] = None,
              gas_values: Optional[List[int]] = None,
              remat_policies: Optional[List[Optional[str]]] = None,
+             tiering_plans: Optional[List[Optional[str]]] = None,
              model=None, sample_batch=None,
              model_info: Optional[Dict[str, Any]] = None,
              memory_budget_bytes: Optional[float] = None) -> TuneResult:
@@ -254,7 +278,9 @@ class Autotuner:
                                  gas_values=(list(gas_values)
                                              if gas_values else None),
                                  remat_policies=(list(remat_policies)
-                                                 if remat_policies else None))
+                                                 if remat_policies else None),
+                                 tiering_plans=(list(tiering_plans)
+                                                if tiering_plans else None))
         if model is not None and model_info is None:
             model_info = self.profile_model_info(model, sample_batch or {})
         if model_info is not None and memory_budget_bytes is not None:

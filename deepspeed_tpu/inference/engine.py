@@ -124,16 +124,20 @@ class InferenceEngine:
         semantics, and required on TPU (host-space scan xs with ndim<3
         leaves hit XLA layout bugs; see models/gpt.py offload branch)."""
         import jax
-        from ..utils.streaming import to_host_tree
+        from ..utils.streaming import HAS_MEMORY_SPACE, to_host_tree
         from flax.core import meta as _meta
         params = dict(_meta.unbox(params))
         if "h" not in params:
             raise ValueError(
                 "offload_params serving expects scan-stacked block params "
                 f"under 'h'; got keys {sorted(params)}")
+        # routing is version-independent; only the small-leaf device
+        # pinning needs typed memory spaces (to_host_tree degrades to
+        # identity on jax versions without them)
         params["h"] = jax.tree.map(
             lambda a: (to_host_tree(a) if getattr(a, "ndim", 0) >= 3
-                       else jax.device_put(a, jax.memory.Space.Device)),
+                       else (jax.device_put(a, jax.memory.Space.Device)
+                             if HAS_MEMORY_SPACE else a)),
             params["h"])
         return params
 

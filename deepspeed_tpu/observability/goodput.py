@@ -68,6 +68,12 @@ SPAN_CATEGORIES = {
     "serving/prefill_chunk": "compute",
     "serving/decode_iter": "compute",
     "serving/harvest": "compute",    # waiting on dispatched decode output
+    # residency-manager disk transfers (runtime/tiering/): the blocking
+    # waits are I/O stalls. stage_in/stage_out themselves are left
+    # uncategorized so the outermost-span rule books only the nested
+    # swap waits, not the compute wait stage_out also contains.
+    "tiering/swap_in": "data_stall",
+    "tiering/swap_out": "data_stall",
 }
 
 

@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 
 from .config_utils import DeepSpeedConfigError, dict_to_dataclass, dataclass_to_dict
 from .resilience.config import ResilienceConfig
+from .tiering.config import TieringConfig
 from ..observability.config import ObservabilityConfig
 from ..serving.config import ServingConfig
 from ..utils.logging import logger
@@ -396,6 +397,11 @@ class DeepSpeedConfig:
     # accounting (deepspeed_tpu/observability/, docs/observability.md);
     # absent/disabled leaves only the near-free no-op span path
     observability: Optional[ObservabilityConfig] = None
+    # NEW (TPU): tiered parameter/optimizer residency manager — one
+    # plan for where every leaf lives across HBM / host RAM / disk
+    # (runtime/tiering/, docs/offload.md). Supersedes the per-device
+    # offload_optimizer/offload_param blocks when enabled.
+    tiering: Optional[TieringConfig] = None
 
     # free-form blocks consumed by their subsystems
     sparse_attention: Optional[Dict[str, Any]] = None
@@ -432,6 +438,7 @@ class DeepSpeedConfig:
         "serving": ServingConfig,
         "resilience": ResilienceConfig,
         "observability": ObservabilityConfig,
+        "tiering": TieringConfig,
     }
 
     @classmethod
@@ -504,6 +511,20 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError("gradient_clipping must be >= 0")
         if self.zero_optimization.stage > 0 and not (self.fp16.enabled or self.bf16.enabled):
             logger.info("ZeRO enabled with fp32 training (no fp16/bf16 block)")
+        if self.tiering is not None and self.tiering.enabled:
+            zero = self.zero_optimization
+            if zero.offload_optimizer_device in ("cpu", "nvme"):
+                raise DeepSpeedConfigError(
+                    "tiering and zero_optimization.offload_optimizer both "
+                    "set: the residency manager owns optimizer-state "
+                    "placement — remove the offload_optimizer block (its "
+                    "capability is the tiering plan's host/disk tiers)")
+            if zero.offload_param_device in ("cpu", "nvme"):
+                raise DeepSpeedConfigError(
+                    "tiering and zero_optimization.offload_param both set: "
+                    "the residency manager owns parameter placement — "
+                    "remove the offload_param block "
+                    "(tiering.offload_params covers it)")
         if self.serving is not None:
             # fail at config parse, not at ServingEngine construction —
             # the paging sub-block's page/chunk arithmetic in particular

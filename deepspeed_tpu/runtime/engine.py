@@ -87,6 +87,7 @@ class DeepSpeedEngine:
         self._skipped_steps_dev = None   # on-device fp16-skip accumulator
         self._monitor_buffer = []        # queued (label, device value, step)
         self._compiled = {}
+        self.tiering = None              # TieredResidencyManager when configured
 
         dist.init_distributed()
 
@@ -404,6 +405,7 @@ class DeepSpeedEngine:
         self._params_on_disk = False
         if off is None or off.device not in ("cpu", "nvme"):
             self._offload_params = False
+            self._apply_tiering_param_offload()
             return
         if self.config.fp16.enabled:
             raise DeepSpeedConfigError(
@@ -431,16 +433,60 @@ class DeepSpeedEngine:
             # in-step window does not compose with whole-tree autodiff
             # under jit; the in-step h2d window is still per-block via
             # stream_in). Constructed AFTER the config validations so a
-            # rejected config never spawns the aio thread pool.
+            # rejected config never spawns the aio thread pool. The
+            # tiering DiskTier wraps the raw swapper with verified reads
+            # + transfer accounting (runtime/tiering/disk.py) — one disk
+            # tier implementation for every consumer.
             import os as _os
-            from .swap_tensor.swapper import AsyncTensorSwapper
-            self._param_swapper = AsyncTensorSwapper(
+            from .tiering.disk import DiskTier
+            # own counter namespace: offload_param traffic must not
+            # render as an active residency manager in ds_tpu_report
+            self._param_swapper = DiskTier(
                 _os.path.join(off.nvme_path, "zero_params"),
-                n_threads=max(2, int(off.buffer_count)))
+                n_threads=max(2, int(off.buffer_count)),
+                counter_prefix="offload_param_nvme")
         log_dist("ZeRO-Infinity param offload: block params in host "
                  "memory, streamed per scan step"
                  + (" (NVMe tier between steps)"
                     if self._param_swapper else ""), ranks=[0])
+
+    def _apply_tiering_param_offload(self):
+        """Tiering's parameter tier: when the residency plan can move
+        stacked block params off-device (plan forced past all_resident,
+        or auto with a declared HBM budget), rebuild the module for
+        per-scan-step streaming — the same mechanism as offload_param,
+        owned by the plan instead of a device string. Deliberately
+        PLAN-INDEPENDENT: any tiering-enabled engine with
+        ``offload_params`` uses the streamed forward even under an
+        all_resident plan (the fetch is identity there), so switching
+        plans changes PLACEMENT only, never the traced program — the
+        invariant behind the cross-plan bitwise guarantee. Models
+        without streaming support silently keep params resident (the
+        plan reports them hbm-tier); the manager's plan is built against
+        whatever this decided (``params_offloaded``)."""
+        tcfg = self.config.tiering
+        if tcfg is None or not tcfg.enabled or not tcfg.offload_params:
+            return
+        mcfg = getattr(self.module, "config", None)
+        if (mcfg is None or not hasattr(mcfg, "offload_params")
+                or not getattr(mcfg, "scan_layers", False)):
+            logger.warning(
+                "tiering: model does not support parameter streaming "
+                "(needs a deepspeed_tpu.models model with "
+                "scan_layers=True) — params stay HBM-resident; only "
+                "optimizer state is tiered")
+            return
+        if self.config.fp16.enabled:
+            raise DeepSpeedConfigError(
+                "tiering.offload_params with fp16 is unsupported (fp16 "
+                "overflow checks would pull host grads to device) — "
+                "train bf16/fp32 or set tiering.offload_params=false")
+        from ..utils.streaming import ensure_streaming_module
+        self.module = ensure_streaming_module(
+            self.module, error_cls=DeepSpeedConfigError, context="tiering")
+        self._offload_params = True
+        log_dist("tiering: stacked block params host-tiered, streamed "
+                 "per scan step", ranks=[0])
 
     def _warn_inert_zero_knobs(self):
         """Stage-3 fetch-coordinator knobs are subsumed by the
@@ -563,6 +609,56 @@ class DeepSpeedEngine:
         self.streamed_offload = None
         off = cfg.zero_optimization.offload_optimizer
         opt_type = (cfg.optimizer.type if cfg.optimizer else "Adam")
+
+        # Tiered residency manager (runtime/tiering/, docs/offload.md):
+        # ONE plan owns param + optimizer placement across HBM / host /
+        # disk; supersedes the offload_* blocks (config.validate rejects
+        # the combination). Math is StreamedHostAdam's, so any plan is
+        # bitwise-identical to all-resident training.
+        tcfg = cfg.tiering
+        if tcfg is not None and tcfg.enabled:
+            if client_optimizer is not None:
+                raise DeepSpeedConfigError(
+                    "tiering is incompatible with a client optimizer — "
+                    "configure the optimizer via the config dict")
+            if opt_type.lower() not in ("adam", "adamw"):
+                raise DeepSpeedConfigError(
+                    f"tiering supports Adam/AdamW, got {opt_type}")
+            from .tiering.manager import TieredResidencyManager
+            opt_params = dict(cfg.optimizer.params) if cfg.optimizer else {}
+            adamw = _resolve_adamw(opt_type, opt_params)
+            self.tiering = TieredResidencyManager(
+                tcfg, opt_params, adamw, self.param_specs,
+                self._param_shapes, self.mesh, self.zero_stage,
+                param_names=self._param_names,
+                offload_mask=self._offload_mask,
+                params_offloaded=getattr(self, "_offload_params", False))
+            self.streamed_offload = self.tiering  # duck-typed apply surface
+            if (getattr(self, "_offload_params", False)
+                    and not any(l.param_tier != "hbm"
+                                for l in self.tiering.plan.leaves)):
+                # the plan kept every param leaf device-resident (e.g.
+                # auto resolved to all_resident): strip the host memory
+                # kinds the streaming setup staged — the streamed
+                # forward's fetch is identity for device leaves, so the
+                # traced program is unchanged, only placement reverts
+                from .zero.offload_optimizer import _device_memory
+                self.param_shardings = jax.tree.map(
+                    _device_memory, self.param_shardings,
+                    is_leaf=lambda x: isinstance(x, NamedSharding))
+                self.params = jax.device_put(self.params,
+                                             self.param_shardings)
+            self.opt_shardings = self.tiering.state_shardings()
+            self.optimizer_state = jax.jit(
+                self.tiering.init,
+                out_shardings=self.opt_shardings)(self.params)
+            # evict the fresh zeros now: step 1 then runs the same
+            # staged path (stage_in -> dispatch -> stage_out) as every
+            # later step — one compiled program, uniform residency
+            self.params, self.optimizer_state = self.tiering.stage_out(
+                self.params, self.optimizer_state)
+            return
+
         if off is not None and getattr(off, "native", False):
             if off.device not in ("cpu", "nvme"):
                 raise DeepSpeedConfigError(
@@ -663,7 +759,13 @@ class DeepSpeedEngine:
     def _ensure_params_resident(self):
         """Page NVMe-evicted param leaves back into host memory. Reads
         are all issued first (the aio thread pool overlaps them), then
-        consumed in order — the reference's prefetch pipelining."""
+        consumed in order — the reference's prefetch pipelining. Also
+        the residency manager's stage-in point: disk-tier optimizer
+        moments page back (verified reads) before any dispatch or
+        checkpoint save consumes them."""
+        if self.tiering is not None:
+            self.params, self.optimizer_state = self.tiering.stage_in(
+                self.params, self.optimizer_state)
         if not self._params_on_disk:
             return
         flat, treedef = jax.tree.flatten(self.params)
@@ -1059,6 +1161,9 @@ class DeepSpeedEngine:
             self._report_step(metrics)
         self._write_monitor(metrics)
         self._evict_params_to_nvme()
+        if self.tiering is not None:
+            self.params, self.optimizer_state = self.tiering.stage_out(
+                self.params, self.optimizer_state)
         if self.resilience is not None:
             # device-side health fold every step; host check (and possible
             # rollback) only on the bounded check_interval cadence
@@ -1287,6 +1392,9 @@ class DeepSpeedEngine:
         self.timers(STEP_GLOBAL_TIMER).start()
         if self.resilience is not None:
             self.resilience.on_step_start()
+        if self.tiering is not None:
+            self.params, self.optimizer_state = self.tiering.stage_in(
+                self.params, self.optimizer_state)
         scaler = self.loss_scale_state or init_loss_scale(1.0)
         with _span("step"), _goodput("compute"):
             if self.native_offload is not None:
@@ -1303,6 +1411,9 @@ class DeepSpeedEngine:
         self._last_grad_norm = gnorm
         self._apply_weight_projections()
         self._evict_params_to_nvme()
+        if self.tiering is not None:
+            self.params, self.optimizer_state = self.tiering.stage_out(
+                self.params, self.optimizer_state)
         self.timers(STEP_GLOBAL_TIMER).stop()
         metrics = {"loss": self._last_loss, "grad_norm": gnorm,
                    "skipped": skipped,
@@ -1512,6 +1623,10 @@ class DeepSpeedEngine:
         if swapper is not None:
             self._param_swapper = None
             swapper.close()
+        tiering = getattr(self, "tiering", None)
+        if tiering is not None:
+            self.tiering = None
+            tiering.close()
         native = getattr(self, "native_offload", None)
         if native is not None:
             inner = getattr(native, "swapper", None)
@@ -1526,6 +1641,12 @@ class DeepSpeedEngine:
         # the on-disk flag (restore templates come from _param_shapes, so
         # paging the stale tree back in would be wasted SSD traffic)
         self._params_on_disk = False
+        if self.tiering is not None:
+            # disk-tier moment placeholders must be concrete before the
+            # restore template is built; the restored values re-evict at
+            # the next step's stage_out
+            self.params, self.optimizer_state = self.tiering.stage_in(
+                self.params, self.optimizer_state)
         self.wait_checkpoint()   # an in-flight async save must land first
         from .checkpointing import load_engine_checkpoint
         return load_engine_checkpoint(self, load_dir, tag=tag,

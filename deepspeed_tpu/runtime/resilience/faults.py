@@ -23,6 +23,12 @@ Fault kinds:
   watchdog without a real deadlock).
 - ``preempt``    — raise ``signum`` against this process at step k
   (exercises the emergency-save path with a real signal delivery).
+- ``torn_swap``  — at step k, truncate the largest ``.swp`` file in the
+  engine's tiering disk tier (runtime/tiering/): the on-disk state a
+  crash/filesystem fault leaves mid-swap. The residency manager must
+  detect the short read at the next stage-in and re-materialize from
+  the protected host copy or raise ``TornSwapError`` — never load
+  garbage into a master shard.
 
 Usage::
 
@@ -58,7 +64,8 @@ class Fault:
     fires_left: int = field(init=False)
 
     def __post_init__(self):
-        kinds = ("nan_grads", "torn_write", "delay_step", "preempt")
+        kinds = ("nan_grads", "torn_write", "delay_step", "preempt",
+                 "torn_swap")
         if self.kind not in kinds:
             raise ValueError(f"fault kind must be one of {kinds}, "
                              f"got {self.kind!r}")
@@ -100,6 +107,14 @@ class FaultInjector:
                 logger.warning(f"FAULT preempt: raising signal {f.signum} "
                                f"at step {step}")
                 os.kill(os.getpid(), f.signum)
+            elif f.kind == "torn_swap":
+                f.fires_left -= 1
+                victim = _truncate_swap_file(engine, f.target_index)
+                if victim is None:
+                    logger.warning("FAULT torn_swap: engine has no disk-"
+                                   "tier .swp files to damage")
+                    continue
+                self.fired.append(("torn_swap", victim))
 
     def on_step_end(self, step: int, engine) -> None:
         """After the optimizer applied: gradient-poisoning faults. The
@@ -155,6 +170,28 @@ def _poison_params(params):
             return p * jnp.asarray(float("nan"), p.dtype)
         return p
     return jax.tree.map(one, params)
+
+
+def _truncate_swap_file(engine, target_index: int) -> Optional[str]:
+    """torn_swap: halve the largest ``.swp`` in the engine's tiering
+    disk tier (deterministic victim: size-ranked, like torn_write)."""
+    tier = getattr(getattr(engine, "tiering", None), "disk", None)
+    swap_dir = getattr(tier, "swap_dir", None)
+    if swap_dir is None or not os.path.isdir(swap_dir):
+        return None
+    files = sorted(
+        ((-os.path.getsize(os.path.join(swap_dir, n)),
+          os.path.join(swap_dir, n))
+         for n in os.listdir(swap_dir) if n.endswith(".swp")))
+    if not files:
+        return None
+    victim = files[min(target_index, len(files) - 1)][1]
+    size = os.path.getsize(victim)
+    logger.warning(f"FAULT torn_swap: truncating {victim} "
+                   f"({size} -> {size // 2} bytes)")
+    with open(victim, "r+b") as fh:
+        fh.truncate(size // 2)
+    return victim
 
 
 def _pick_victim(tag_path: str, target_index: int) -> Optional[str]:

@@ -33,9 +33,13 @@ class AsyncTensorSwapper:
         self._write_tickets: Dict[str, int] = {}
         self._read_tickets: Dict[str, tuple] = {}  # name -> (ticket, buf)
 
-    def _path(self, name: str) -> str:
+    def path(self, name: str) -> str:
+        """On-disk path for ``name`` (the tiering layer verifies file
+        sizes against it)."""
         safe = name.replace("/", "__")
         return os.path.join(self.swap_dir, f"{safe}.swp")
+
+    _path = path
 
     def swap_out(self, name: str, array: np.ndarray):
         """Async write; the array must not be mutated until flush()."""
@@ -68,6 +72,18 @@ class AsyncTensorSwapper:
         ticket, buf = self._read_tickets.pop(name)
         self.handle.wait(ticket)
         return buf
+
+    def discard_read(self, name: str):
+        """Drop an in-flight read of ``name`` without trusting its
+        result (the tiering layer calls this when the file failed size
+        verification — the read may have errored or filled a short
+        buffer)."""
+        if name in self._read_tickets:
+            ticket, _buf = self._read_tickets.pop(name)
+            try:
+                self.handle.wait(ticket)
+            except OSError:
+                pass   # a short/failed read of a torn file is expected
 
     def flush(self):
         """Join all outstanding WRITES (call before reusing source

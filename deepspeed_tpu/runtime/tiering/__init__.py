@@ -1,0 +1,23 @@
+"""Tiered parameter/optimizer residency (HBM <-> host RAM <-> disk).
+
+One manager owns where every parameter and optimizer-state leaf lives
+and when it moves — the ZeRO-Infinity memory hierarchy (arXiv
+2104.07857) expressed as a per-leaf ``ResidencyPlan`` plus a prefetch
+schedule whose overlap is *measured* by the goodput ledger's
+``data_stall`` fraction, not claimed. See docs/offload.md.
+"""
+
+from .bandwidth import BandwidthEstimate, probe_bandwidths  # noqa: F401
+from .config import PLAN_NAMES, TieringConfig  # noqa: F401
+from .disk import DiskTier, TornSwapError  # noqa: F401
+from .plan import (ResidencyPlan, TIER_DISK, TIER_HBM,  # noqa: F401
+                   TIER_HOST, build_plan)
+
+
+def __getattr__(name):
+    # the manager pulls jax (via StreamedHostAdam); keep this package
+    # importable from jax-free tooling (config parsing, the linter)
+    if name == "TieredResidencyManager":
+        from .manager import TieredResidencyManager
+        return TieredResidencyManager
+    raise AttributeError(name)

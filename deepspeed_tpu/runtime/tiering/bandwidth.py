@@ -1,0 +1,121 @@
+"""Bandwidth probes: measure the memory hierarchy once, price plans.
+
+ZeRO-Infinity's partitioning is *bandwidth-centric* (arXiv 2104.07857
+§5): what a tier costs per step is bytes-moved / measured-bandwidth, so
+the plan builder needs real numbers for host<->device and disk. Probes
+run ONCE per process at manager construction (cached — autotuner
+candidates building many engines must not re-pay them) and never inside
+the step path, so the compile-once and host-sync disciplines are
+untouched.
+
+On backends where a probe cannot run (no writable disk path, jax
+absent) the declared config fallbacks are used and ``probed`` stays
+False — plan costing degrades to deterministic estimates instead of
+failing.
+"""
+
+import os
+import tempfile
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ...utils.logging import logger
+
+
+@dataclass
+class BandwidthEstimate:
+    h2d_bytes_per_s: float
+    d2h_bytes_per_s: float
+    disk_write_bytes_per_s: float
+    disk_read_bytes_per_s: float
+    probed: bool = False
+
+    def to_dict(self):
+        return {
+            "h2d_bytes_per_s": self.h2d_bytes_per_s,
+            "d2h_bytes_per_s": self.d2h_bytes_per_s,
+            "disk_write_bytes_per_s": self.disk_write_bytes_per_s,
+            "disk_read_bytes_per_s": self.disk_read_bytes_per_s,
+            "probed": self.probed,
+        }
+
+
+_CACHE: Optional[BandwidthEstimate] = None
+
+
+def _probe_host_device(nbytes: int):
+    """Time one h2d placement and one d2h materialization of a pinned
+    host buffer. A handful of ms at init; never on the step path."""
+    import jax
+    import numpy as np
+    buf = np.zeros(max(1, nbytes // 4), dtype=np.float32)
+    t0 = time.perf_counter()
+    arr = jax.device_put(buf)
+    arr.block_until_ready()
+    h2d = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    np.array(arr)
+    d2h = time.perf_counter() - t0
+    return buf.nbytes / max(h2d, 1e-9), buf.nbytes / max(d2h, 1e-9)
+
+
+def _probe_disk(path: str, nbytes: int):
+    """Synchronous write+fsync then read of one probe file — the
+    sustained-bandwidth floor the async swapper improves on."""
+    os.makedirs(path, exist_ok=True)
+    data = b"\0" * nbytes
+    fd, probe_path = tempfile.mkstemp(dir=path, suffix=".probe")
+    try:
+        t0 = time.perf_counter()
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        write = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with open(probe_path, "rb") as f:
+            f.read()
+        read = time.perf_counter() - t0
+    finally:
+        try:
+            os.unlink(probe_path)
+        except OSError:
+            pass
+    return nbytes / max(write, 1e-9), nbytes / max(read, 1e-9)
+
+
+def probe_bandwidths(disk_path: str, nbytes: int = 4 << 20, *,
+                     fallback_host: float = 8e9, fallback_disk: float = 1e9,
+                     enabled: bool = True,
+                     force: bool = False) -> BandwidthEstimate:
+    """Measure (or recall) the process's bandwidth estimate.
+    ``enabled=False`` ALWAYS returns the caller's declared fallbacks
+    with ``probed=False`` (deterministic costing for tests/autotuning
+    regardless of what other engines in the process did); ``enabled=
+    True`` probes once per process and caches ONLY a successful probe,
+    so call order between enabled and disabled managers cannot leak
+    measurements either way."""
+    global _CACHE
+    fallback = BandwidthEstimate(fallback_host, fallback_host,
+                                 fallback_disk, fallback_disk,
+                                 probed=False)
+    if not enabled:
+        return fallback
+    if _CACHE is not None and not force:
+        return _CACHE
+    try:
+        h2d, d2h = _probe_host_device(int(nbytes))
+        dw, dr = _probe_disk(disk_path, int(nbytes))
+        _CACHE = BandwidthEstimate(h2d, d2h, dw, dr, probed=True)
+        return _CACHE
+    except Exception as e:  # ds-tpu: lint-ok[PY001] — a probe failure of any kind must degrade to fallbacks, never block engine construction
+        logger.warning(f"tiering bandwidth probe failed ({e}); using "
+                       "declared fallback bandwidths")
+        return fallback
+
+
+def reset_bandwidth_cache():
+    """Test isolation: forget the cached probe."""
+    global _CACHE
+    _CACHE = None

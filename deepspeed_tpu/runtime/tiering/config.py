@@ -1,0 +1,81 @@
+"""``tiering`` config block — the residency-manager knobs.
+
+Stdlib-only (the dependency-free config contract: ``DeepSpeedConfig``
+must parse and validate without jax), consumed by
+``runtime/tiering/manager.py``. Reference semantics: ZeRO-Infinity's
+offload configuration (arXiv 2104.07857 §5 — bandwidth-centric
+partitioning across GPU/CPU/NVMe), expressed as explicit per-tier byte
+budgets plus a plan selector instead of the reference's
+offload_param/offload_optimizer device strings.
+"""
+
+from dataclasses import dataclass
+from typing import Optional
+
+PLAN_NAMES = ("auto", "all_resident", "host_offload", "host_disk")
+
+
+@dataclass
+class TieringConfig:
+    """One residency manager for parameters + optimizer state.
+
+    - ``plan``: ``auto`` picks the cheapest plan whose residency fits
+      the budgets (all_resident -> host_offload -> host_disk); a named
+      plan forces that tier layout regardless of fit.
+    - ``hbm_budget_bytes``: device bytes the plan may occupy with
+      params + optimizer state. None = the device's reported memory
+      limit when available, else unbounded (all_resident always fits).
+      Tests and the offload bench set a SYNTHETIC budget here to train
+      models "larger than HBM" on the CPU backend.
+    - ``host_budget_bytes``: host-RAM bytes for host-tier leaves; the
+      overflow spills to the disk tier. None = unbounded.
+    - ``disk_path``: the disk tier's swap directory (one subdir per
+      process, like the NVMe offload paths).
+    - ``prefetch``: double-buffer the in-step host->device moment walk
+      (``utils.streaming.double_buffered``) AND issue the disk tier's
+      read-ahead right after the post-step write-back, so reads overlap
+      the inter-step host work. Off = every transfer is waited for at
+      its use site (the bench's stall-fraction control arm).
+    - ``write_protection``: keep the last written host buffer of every
+      disk-tier leaf until the NEXT read verifies; a torn/truncated
+      ``.swp`` is then re-materialized from the host copy instead of
+      raising. Costs one transient host copy of the disk-tier state —
+      turn off to reclaim that RAM and get a hard
+      ``TornSwapError`` instead (docs/offload.md).
+    - ``probe_bandwidth``: measure host<->device and disk bandwidth at
+      manager construction (one-shot, cached process-wide) to price
+      plans; off = cost estimates use the declared fallbacks below.
+    - ``host_bytes_per_s`` / ``disk_bytes_per_s``: declared bandwidths
+      used when probing is off (or fails) — deterministic plan costing
+      for tests and the autotuner.
+    """
+    enabled: bool = False
+    plan: str = "auto"
+    hbm_budget_bytes: Optional[int] = None
+    host_budget_bytes: Optional[int] = None
+    disk_path: str = "/tmp/ds_tpu_tiering"
+    prefetch: bool = True
+    write_protection: bool = True
+    probe_bandwidth: bool = True
+    probe_bytes: int = 4 << 20
+    aio_threads: int = 4
+    host_bytes_per_s: float = 8e9     # ~PCIe3 x16 order of magnitude
+    disk_bytes_per_s: float = 1e9     # ~NVMe order of magnitude
+    offload_params: bool = True       # stacked block params may leave HBM
+
+    def __post_init__(self):
+        if self.plan not in PLAN_NAMES:
+            raise ValueError(
+                f"tiering.plan must be one of {PLAN_NAMES}, got "
+                f"{self.plan!r}")
+        for knob in ("hbm_budget_bytes", "host_budget_bytes"):
+            v = getattr(self, knob)
+            if v is not None and int(v) < 0:
+                raise ValueError(f"tiering.{knob} must be >= 0, got {v}")
+        if int(self.probe_bytes) <= 0:
+            raise ValueError("tiering.probe_bytes must be > 0")
+        if int(self.aio_threads) < 1:
+            raise ValueError("tiering.aio_threads must be >= 1")
+        for knob in ("host_bytes_per_s", "disk_bytes_per_s"):
+            if float(getattr(self, knob)) <= 0:
+                raise ValueError(f"tiering.{knob} must be > 0")

@@ -1,0 +1,197 @@
+"""DiskTier: the residency manager's SSD tier over the aio swapper.
+
+``swap_tensor/swapper.py``'s ``AsyncTensorSwapper`` is the raw
+primitive (async pwrite/pread with same-name hazard handling); this
+wrapper is what every disk-tier consumer in the runtime goes through —
+the residency manager, the engine's param NVMe eviction, and the
+native offload optimizer's moment swap — adding the three things the
+raw swapper deliberately does not do:
+
+1. **Integrity**: every read is verified against the written byte
+   count (``os.path.getsize`` before AND after the read — a truncated
+   ``.swp`` mid-run must never be loaded into a master shard). A short
+   read either re-materializes from the retained host copy
+   (``protect=True``) or raises the named ``TornSwapError``.
+2. **Accounting**: per-direction transfer counters
+   (``tiering/transfer_bytes/{host_to_disk,disk_to_host}``) and trace
+   spans (``tiering/swap_out`` / ``tiering/swap_in``) on every
+   transfer, plus goodput-ledger ``data_stall`` sites on every
+   BLOCKING wait — the issue-side of an async write/read is free, so
+   the ledger measures exactly the non-overlapped remainder. That is
+   what makes prefetch-on vs prefetch-off comparable on the PR-8
+   instrument.
+3. **Protection** (optional): the last written buffer of each name is
+   retained until its next read verifies, so a torn file recovers
+   bitwise (docs/offload.md, chaos ``torn_swap`` scenario).
+"""
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ...observability.goodput import timed as _goodput
+from ...observability.metrics import get_registry
+from ...observability.trace import span as _span
+from ...utils.logging import logger
+from ..swap_tensor.swapper import AsyncTensorSwapper
+
+
+class _NullCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+class TornSwapError(RuntimeError):
+    """A disk-tier file failed read verification (truncated / short /
+    unreadable) and no protected host copy was available to
+    re-materialize from. Raised INSTEAD of returning garbage."""
+
+    def __init__(self, name: str, path: str, expected: int, actual):
+        self.name, self.path = name, path
+        self.expected_bytes, self.actual_bytes = expected, actual
+        super().__init__(
+            f"torn swap file for '{name}': {path} holds {actual} bytes, "
+            f"expected {expected} (truncated mid-run?) and no protected "
+            "host copy is retained — refusing to load garbage; restore "
+            "from checkpoint or enable tiering.write_protection")
+
+
+class DiskTier:
+    """Named numpy buffers on SSD with verification + accounting."""
+
+    def __init__(self, swap_dir: str, n_threads: int = 4,
+                 protect: bool = False, counter_prefix: str = "tiering",
+                 ledger_category: Optional[str] = "data_stall"):
+        """``counter_prefix`` namespaces the transfer counters — the
+        residency manager uses the default ``tiering`` namespace (what
+        ``ds_tpu_report`` renders as the tiering section); the legacy
+        NVMe consumers pass their own so their traffic is not mistaken
+        for an active residency manager. ``ledger_category=None``
+        disables the goodput sites — for callers whose blocking waits
+        already run inside a ``timed("compute")`` window (the native
+        cpu_adam step), where booking them again would double-count."""
+        self._swapper = AsyncTensorSwapper(swap_dir, n_threads=n_threads)
+        self.swap_dir = self._swapper.swap_dir
+        self.protect = bool(protect)
+        self._nbytes: Dict[str, int] = {}
+        self._protected: Dict[str, np.ndarray] = {}
+        self._prefix = counter_prefix
+        self._ledger_category = ledger_category
+        self.recoveries = 0
+
+    def _timed_wait(self):
+        if self._ledger_category is None:
+            return _NULL_CTX
+        return _goodput(self._ledger_category)
+
+    # -- write side ----------------------------------------------------
+    def swap_out(self, name: str, array: np.ndarray):
+        """Issue the async write (non-blocking). The array must not be
+        mutated until ``flush()``; with ``protect`` it is additionally
+        retained until the NEXT verified read of ``name``."""
+        array = np.ascontiguousarray(array)
+        with _span("tiering/swap_out", {"name": name,
+                                        "bytes": array.nbytes}):
+            self._swapper.swap_out(name, array)
+        self._nbytes[name] = int(array.nbytes)
+        if self.protect:
+            self._protected[name] = array
+        reg = get_registry()
+        reg.counter(f"{self._prefix}/transfer_bytes/host_to_disk").inc(
+            array.nbytes)
+        reg.counter(f"{self._prefix}/transfers/host_to_disk").inc()
+
+    def flush(self):
+        """Join outstanding writes — the blocking (ledger-visible) half
+        of the write path. Prefetch reads stay in flight."""
+        with self._timed_wait():
+            self._swapper.flush()
+
+    # -- read side -----------------------------------------------------
+    def prefetch(self, name: str):
+        self._swapper.prefetch(name)
+
+    def _file_bytes(self, name: str):
+        try:
+            return os.path.getsize(self._swapper.path(name))
+        except OSError:
+            return None
+
+    def _recover(self, name: str, actual):
+        expected = self._nbytes.get(name, -1)
+        path = self._swapper.path(name)
+        # a prefetched read of the torn file may still be in flight; its
+        # buffer/status is untrustworthy either way
+        self._swapper.discard_read(name)
+        copy = self._protected.get(name)
+        if copy is None:
+            raise TornSwapError(name, path, expected, actual)
+        logger.warning(
+            f"tiering: torn swap file for '{name}' ({path}: {actual} vs "
+            f"{expected} expected bytes) — re-materializing from the "
+            "protected host copy and re-writing the tier")
+        self.recoveries += 1
+        get_registry().counter(
+            f"{self._prefix}/torn_swap_recovered_total").inc()
+        self.swap_out(name, copy)    # heal the file for the next reader
+        self.flush()
+        return copy
+
+    def swap_in(self, name: str) -> np.ndarray:
+        """Blocking read with verification. Returns the host buffer
+        (bitwise what was written, or the protected copy on a detected
+        tear)."""
+        expected = self._nbytes.get(name)
+        if expected is None:
+            # the tier has no in-memory metadata for cross-process
+            # reads, so a name never written through THIS instance has
+            # no verification basis — refuse rather than read unverified
+            raise KeyError(
+                f"nothing swapped out under '{name}' through this "
+                "DiskTier")
+        size = self._file_bytes(name)
+        if size != expected:
+            return self._recover(name, size)
+        try:
+            with _span("tiering/swap_in", {"name": name,
+                                           "bytes": expected}), \
+                    self._timed_wait():
+                buf = self._swapper.swap_in(name)
+        except OSError as e:
+            logger.warning(f"tiering: disk-tier read of '{name}' failed "
+                           f"({e})")
+            return self._recover(name, self._file_bytes(name))
+        # re-check: a truncation landing between the size check and the
+        # read completion left the buffer tail unwritten
+        size = self._file_bytes(name)
+        if size != expected or buf.nbytes != expected:
+            return self._recover(name, size)
+        self._protected.pop(name, None)   # the disk copy proved good
+        reg = get_registry()
+        reg.counter(f"{self._prefix}/transfer_bytes/disk_to_host").inc(
+            expected)
+        reg.counter(f"{self._prefix}/transfers/disk_to_host").inc()
+        return buf
+
+    # -- lifecycle -----------------------------------------------------
+    def resident_bytes(self) -> int:
+        """Bytes currently on the tier (written names)."""
+        return sum(self._nbytes.values())
+
+    def remove(self, name: str):
+        self._nbytes.pop(name, None)
+        self._protected.pop(name, None)
+        self._swapper.remove(name)
+
+    def close(self):
+        self._protected.clear()
+        self._swapper.close()

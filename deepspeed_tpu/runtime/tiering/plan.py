@@ -1,0 +1,190 @@
+"""Residency plans: per-leaf tier assignment over the memory hierarchy.
+
+A plan answers, for every parameter leaf and its optimizer-state
+(Adam moment) leaves, *where it lives between uses*:
+
+- ``hbm``  — device-resident (the all-fits default),
+- ``host`` — the accelerator host's pinned memory; streamed per leaf
+  through HBM inside the jitted step (the StreamedHostAdam walk,
+  double-buffered so leaf N+1's h2d hides under leaf N's math),
+- ``disk`` — on SSD between steps via the aio swapper; staged through
+  host RAM around the step with async prefetched reads (the
+  ZeRO-Infinity NVMe tier, arXiv 2104.07857).
+
+Assignment is budget-driven and follows LAYER EXECUTION ORDER (the
+pytree flatten order the streamed walk consumes — scan-carry models
+stack all blocks into one leaf, unrolled models enumerate them): HBM
+fills first, then host, and the *tail* of the walk spills to disk —
+tail leaves are the ones whose prefetched reads have the longest
+compute window ahead of their use. ``auto`` picks the first named plan
+whose footprint fits the budgets, priced by the bandwidth probes.
+
+Stdlib-only: plan construction is pure arithmetic over names/sizes so
+the autotuner and tests can walk plan spaces without jax.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ...utils.logging import logger
+
+TIER_HBM = "hbm"
+TIER_HOST = "host"
+TIER_DISK = "disk"
+TIERS = (TIER_HBM, TIER_HOST, TIER_DISK)
+
+# forced plans, in cost order; "auto" resolves to the first that fits
+PLAN_LADDER = ("all_resident", "host_offload", "host_disk")
+
+
+@dataclass
+class LeafPlan:
+    """One parameter leaf's residency: the param itself and its two
+    fp32 Adam moments (which always share a tier)."""
+    name: str
+    param_bytes: int
+    opt_bytes: int
+    param_tier: str = TIER_HBM
+    opt_tier: str = TIER_HBM
+    offloadable: bool = True   # stacked block kernels may leave HBM
+
+    def to_dict(self):
+        return {"name": self.name, "param_bytes": self.param_bytes,
+                "opt_bytes": self.opt_bytes, "param_tier": self.param_tier,
+                "opt_tier": self.opt_tier}
+
+
+@dataclass
+class ResidencyPlan:
+    name: str
+    leaves: List[LeafPlan] = field(default_factory=list)
+    hbm_budget_bytes: Optional[int] = None
+    host_budget_bytes: Optional[int] = None
+
+    def bytes_by_tier(self) -> Dict[str, int]:
+        out = {t: 0 for t in TIERS}
+        for leaf in self.leaves:
+            out[leaf.param_tier] += leaf.param_bytes
+            out[leaf.opt_tier] += leaf.opt_bytes
+        return out
+
+    def fits(self) -> bool:
+        by_tier = self.bytes_by_tier()
+        if (self.hbm_budget_bytes is not None
+                and by_tier[TIER_HBM] > self.hbm_budget_bytes):
+            return False
+        if (self.host_budget_bytes is not None
+                and by_tier[TIER_HOST] > self.host_budget_bytes):
+            return False
+        return True
+
+    def est_step_seconds(self, bw) -> float:
+        """Per-step transfer cost (seconds) under a ``BandwidthEstimate``:
+        host-tier leaves round-trip host<->device inside the step; disk
+        leaves additionally round-trip SSD<->host between steps. An
+        upper bound — overlap (the whole point) only reduces it — used
+        to ORDER plans, not to predict wall clock."""
+        by_tier = self.bytes_by_tier()
+        host_rt = by_tier[TIER_HOST] * (1.0 / bw.h2d_bytes_per_s
+                                        + 1.0 / bw.d2h_bytes_per_s)
+        disk_rt = by_tier[TIER_DISK] * (
+            1.0 / bw.disk_read_bytes_per_s + 1.0 / bw.disk_write_bytes_per_s
+            + 1.0 / bw.h2d_bytes_per_s + 1.0 / bw.d2h_bytes_per_s)
+        return host_rt + disk_rt
+
+    def disk_leaf_names(self) -> List[str]:
+        return [l.name for l in self.leaves if l.opt_tier == TIER_DISK]
+
+    def to_dict(self):
+        return {"name": self.name,
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+                "host_budget_bytes": self.host_budget_bytes,
+                "bytes_by_tier": self.bytes_by_tier(),
+                "leaves": [l.to_dict() for l in self.leaves]}
+
+
+def _fresh_leaves(names, param_nbytes, opt_nbytes, offloadable):
+    return [LeafPlan(n, int(pb), int(ob), offloadable=bool(off))
+            for n, pb, ob, off in zip(names, param_nbytes, opt_nbytes,
+                                      offloadable)]
+
+
+def _apply_named_plan(plan_name, leaves, hbm_budget, host_budget,
+                      offload_params=True):
+    """Mutate ``leaves`` into the named layout. Budget-driven within the
+    plan's shape: host_offload moves every moment host-side (the
+    ZeRO-Offload contract) and only as many offloadable param leaves as
+    the HBM budget demands; host_disk additionally spills the tail of
+    the host walk to disk until host RAM fits."""
+    if plan_name == "all_resident":
+        return
+    # --- host_offload and beyond: moments leave HBM; offloadable
+    # (stacked-block) params move host-side as a unit — the scan-xs
+    # placement the streaming module implements is whole-tree, so the
+    # plan mirrors the mechanism instead of pretending per-leaf
+    # granularity the engine cannot deliver -----------------------------
+    for leaf in leaves:
+        leaf.opt_tier = TIER_HOST
+        if offload_params and leaf.offloadable:
+            leaf.param_tier = TIER_HOST
+    if plan_name == "host_offload":
+        return
+    # --- host_disk: spill the tail of the host walk to SSD ------------
+    if host_budget is not None:
+        host_used = sum(l.opt_bytes for l in leaves
+                        if l.opt_tier == TIER_HOST)
+        host_used += sum(l.param_bytes for l in leaves
+                         if l.param_tier == TIER_HOST)
+        for leaf in reversed(leaves):
+            if host_used <= host_budget:
+                break
+            if leaf.opt_tier == TIER_HOST:
+                leaf.opt_tier = TIER_DISK
+                host_used -= leaf.opt_bytes
+    else:
+        # no host budget given but the plan was FORCED: spill the last
+        # moment leaf so the disk path is actually exercised
+        if leaves:
+            leaves[-1].opt_tier = TIER_DISK
+
+
+def build_plan(names, param_nbytes, opt_nbytes, *,
+               offloadable=None, plan: str = "auto",
+               hbm_budget_bytes: Optional[int] = None,
+               host_budget_bytes: Optional[int] = None,
+               bandwidths=None, offload_params: bool = True
+               ) -> ResidencyPlan:
+    """Derive the residency plan for a model.
+
+    ``names``/``param_nbytes``/``opt_nbytes`` are aligned with the
+    pytree flatten order (= execution order of the streamed walk);
+    ``offloadable`` marks leaves whose params may leave HBM (the
+    engine's stacked-block mask). ``plan="auto"`` walks the ladder and
+    returns the first layout that fits both budgets (priced for the
+    report by ``bandwidths``); a named plan is honored even when it
+    does not fit (the caller asked for it)."""
+    if offloadable is None:
+        offloadable = [True] * len(names)
+    candidates = PLAN_LADDER if plan == "auto" else (plan,)
+    chosen = None
+    for cand in candidates:
+        p = ResidencyPlan(cand,
+                          _fresh_leaves(names, param_nbytes, opt_nbytes,
+                                        offloadable),
+                          hbm_budget_bytes, host_budget_bytes)
+        _apply_named_plan(cand, p.leaves, hbm_budget_bytes,
+                          host_budget_bytes, offload_params=offload_params)
+        chosen = p
+        if plan != "auto" or p.fits():
+            break
+    if plan == "auto" and not chosen.fits():
+        logger.warning(
+            "tiering: no plan fits the declared budgets "
+            f"(hbm={hbm_budget_bytes}, host={host_budget_bytes}); "
+            f"using {chosen.name} (deepest ladder rung) anyway")
+    if bandwidths is not None:
+        cost = chosen.est_step_seconds(bandwidths)
+        logger.info(f"tiering plan {chosen.name}: "
+                    f"{chosen.bytes_by_tier()} est transfer "
+                    f"{cost * 1e3:.2f} ms/step")
+    return chosen

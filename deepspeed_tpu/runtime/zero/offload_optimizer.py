@@ -52,10 +52,19 @@ class CPUAdamOffloadOptimizer:
 
         self.swapper = None
         if nvme_swap_dir is not None:
-            from ..swap_tensor import AsyncTensorSwapper
-            self.swapper = AsyncTensorSwapper(
+            # the residency manager's DiskTier (verified reads, transfer
+            # accounting, ledger stalls) IS the NVMe path now — this
+            # optimizer no longer owns a private swapper flavor
+            from ..tiering.disk import DiskTier
+            # own counter namespace (this is not the residency manager)
+            # and NO ledger sites: these waits run inside the engine's
+            # timed("compute") dispatch window — booking them again as
+            # data_stall would double-count wall clock
+            self.swapper = DiskTier(
                 os.path.join(nvme_swap_dir, f"proc{jax.process_index()}"),
-                n_threads=aio_threads)
+                n_threads=aio_threads,
+                counter_prefix="offload_native_nvme",
+                ledger_category=None)
 
         # Host state per leaf: {index_key: [master, m, v, devices]}
         flat_params, self._treedef = jax.tree.flatten(params)
@@ -116,12 +125,19 @@ class CPUAdamOffloadOptimizer:
         flat_grads = jax.tree.leaves(grads_tree)
         flat_psh = jax.tree.leaves(self.param_shardings)
         new_leaves = []
+        # one-leaf-ahead NVMe read pipelining via the SHARED double-buffer
+        # helper (utils/streaming.py): leaf li+1's moment reads are issued
+        # before leaf li's cpu_adam math — the same overlap contract the
+        # streamed host walk and the tiering manager use.
         if self.swapper is not None and self._state:
-            self._prefetch_leaf(0)
-        for li, (g_leaf, per_leaf, psh) in enumerate(
-                zip(flat_grads, self._state, flat_psh)):
-            if self.swapper is not None and li + 1 < len(self._state):
-                self._prefetch_leaf(li + 1)   # overlap SSD read with compute
+            from ...utils.streaming import double_buffered
+            walk = double_buffered(range(len(self._state)),
+                                   self._prefetch_leaf)
+        else:
+            walk = ((li, None) for li in range(len(self._state)))
+        for li, _prefetched in walk:
+            g_leaf, per_leaf, psh = (flat_grads[li], self._state[li],
+                                     flat_psh[li])
             shards = {(_index_key(s.index)): s for s in g_leaf.addressable_shards}
             bufs = []
             for key, ent in per_leaf.items():
@@ -129,7 +145,10 @@ class CPUAdamOffloadOptimizer:
                 if self.swapper is not None:
                     m = self.swapper.swap_in(self._swap_name(li, key, "m"))
                     v = self.swapper.swap_in(self._swap_name(li, key, "v"))
-                g = np.array(shards[key].data, dtype=np.float32)
+                # host cpu_adam consumes the grad shard host-side: the
+                # d2h here is the native-offload contract, one per leaf
+                # per optimizer step (docs/config.md offload_optimizer)
+                g = np.array(shards[key].data, dtype=np.float32)  # ds-tpu: lint-ok[TS002]
                 flat_master = master.reshape(-1)
                 out_dtype = self._dtypes[li]
                 out_bf16 = (np.empty(flat_master.shape, np.uint16)

@@ -359,8 +359,14 @@ class TestStreamedHostOffload:
             loss = engine.train_batch(batch)
         return engine, float(loss)
 
-    @pytest.mark.parametrize("wd,clip", [(0.0, 0.0), (0.01, 0.0), (0.0, 1.0)],
-                             ids=["plain", "weight_decay", "clipped"])
+    # the jax.memory.Space compat shim (PR 14) un-broke this class on
+    # the pinned jax; the wd/clip variants ride the slow lane per the
+    # tier-1 budget note (the plain arm stays in-lane as the core proof)
+    @pytest.mark.parametrize("wd,clip", [
+        (0.0, 0.0),
+        pytest.param(0.01, 0.0, marks=pytest.mark.slow),
+        pytest.param(0.0, 1.0, marks=pytest.mark.slow),
+    ], ids=["plain", "weight_decay", "clipped"])
     def test_matches_default_path(self, wd, clip):
         ea, la = self._train(False, wd, clip)
         eb, lb = self._train(True, wd, clip)
@@ -428,6 +434,7 @@ class TestParamOffload:
         _, losses = self._train(True, steps=5)
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_dropout_composes(self):
         """offload_params + dropout: per-layer rng threading via fold_in
         (r3 refusal at models/gpt.py; nn.scan split_rngs analog)."""
@@ -745,6 +752,7 @@ class TestParamNVMeTier:
                   for s in range(steps)]
         return engine, losses
 
+    @pytest.mark.slow
     def test_nvme_matches_cpu_offload_trajectory(self, tmp_path):
         _, cpu_losses = self._train("cpu", tmp_path / "a")
         _, nvme_losses = self._train("nvme", tmp_path / "b")

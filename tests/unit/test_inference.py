@@ -921,6 +921,7 @@ class TestZeroInference:
             0, 256, (2, 12)), jnp.int32)
         return eng, prompt
 
+    @pytest.mark.slow
     def test_greedy_parity_with_resident(self):
         e_res, prompt = self._setup(False)
         e_off, _ = self._setup(True)
