@@ -401,6 +401,13 @@ class FleetTelemetryAggregator:
             self.replicas[replica_id]["dead"] = True
             self.replicas[replica_id]["up"] = False
 
+    def forget(self, replica_id: int):
+        """Drop a replica's entry entirely — the manager's bounded
+        corpse history prunes old dead replicas, and their last samples
+        leave the merged view with them (a supervised fleet restarts
+        without bound; the aggregator must not grow with it)."""
+        self.replicas.pop(int(replica_id), None)
+
     # -- the poll ----------------------------------------------------------
     def poll_async(self):
         """Fire one poll on a daemon thread — the serving data plane
@@ -452,6 +459,25 @@ class FleetTelemetryAggregator:
                 e["client"].last_success_unix if e["mode"] == "scrape"
                 else time.time())
         return self.snapshot()
+
+    def healthy(self, replica_id) -> bool:
+        """Dispatch-health verdict for the router: False when the
+        replica is marked dead, its ``up`` gauge is down, or its last
+        successful sample is older than ``stale_after_s``. A replica
+        that has never been polled reads healthy until its first
+        FAILED poll — a fresh spawn must not be quarantined before its
+        first scrape window."""
+        e = self.replicas.get(int(replica_id))
+        if e is None:
+            return True
+        if e["dead"]:
+            return False
+        last = e["last_success_unix"]
+        if last is None:
+            return e["scrapes_failed"] == 0
+        if not e["up"]:
+            return False
+        return (time.time() - last) <= self.stale_after_s
 
     def merged(self) -> dict:
         return merge_numeric({rid: e.get("sample")

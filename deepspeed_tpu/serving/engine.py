@@ -272,6 +272,11 @@ class ServingEngine:
         # KV handoff to a decode replica (set via set_prefill_role)
         self.prefill_only = False
         self._handoff_ready = []          # [(slot, req)] awaiting export
+        self._handoff_injected = {}       # request_id -> injected Request
+                                          # (bounded; the idempotence
+                                          # guard — a re-sent payload
+                                          # dedupes even after the
+                                          # original already finished)
         self._preempts_this_iter = 0
         self._watchdog = None
         self._watchdog_report = None      # set by the watchdog thread;
@@ -1286,11 +1291,29 @@ class ServingEngine:
                 f" vs pool page_len={self._paged.page_len}/kv_quant="
                 f"{self._paged.kv_quant!r} — fleet replicas must share "
                 "one serving config")
+        st = payload["state"]
+        rq = payload["request"]
+        # idempotence guard: a payload re-sent after an AMBIGUOUS
+        # failure (reply lost or timed out mid-inject) must not run the
+        # same request twice — if its id was already injected here
+        # (still decoding, requeued by QoS/preemption, or ALREADY
+        # finished before the retry landed), hand the existing request
+        # back instead of double-injecting
+        dup = self._handoff_injected.get(rq["request_id"])
+        if dup is None:
+            dup = next((r for r in self._slot_req
+                        if r is not None
+                        and r.request_id == rq["request_id"]), None)
+        if dup is None:
+            dup = next((r for r in self.scheduler.queued()
+                        if r.request_id == rq["request_id"]), None)
+        if dup is not None:
+            from ..observability.metrics import get_registry
+            get_registry().counter("serving/handoff_dedup").inc()
+            return dup
         slot = self._peek_free_slot()
         if slot is None:
             return None
-        st = payload["state"]
-        rq = payload["request"]
         prefill_len = int(payload["prefill_len"])
         remaining = int(st["remaining"])
         total = self._paged.pages_for(prefill_len, remaining)
@@ -1336,6 +1359,13 @@ class ServingEngine:
                                                         np.int32)
         self._paged.publish(slot, prefilled)
         self.metrics.on_handoff_import(request, prefill_len)
+        # remember the injection (bounded) so a duplicate payload is
+        # recognized even after this request finishes and leaves the
+        # slot/queue scans above
+        self._handoff_injected[request.request_id] = request
+        while len(self._handoff_injected) > 256:
+            self._handoff_injected.pop(
+                next(iter(self._handoff_injected)))
         return request
 
     # -- construction helpers ---------------------------------------------

@@ -7,10 +7,13 @@ must work in dependency-free tooling jobs.
 """
 
 from .config import FleetConfig
+from .supervision import ReplicaSupervisor, SupervisionConfig
 
-__all__ = ["FleetConfig", "ServingFleet", "FleetRequest", "Router",
+__all__ = ["FleetConfig", "SupervisionConfig", "ReplicaSupervisor",
+           "ServingFleet", "FleetRequest", "Router",
            "ReplicaStats", "LocalReplica", "ProcessReplica",
-           "serialize_handoff", "deserialize_handoff"]
+           "ReplicaCrash", "ReplicaDead", "WorkerProtocolError",
+           "serialize_handoff", "deserialize_handoff", "HandoffError"]
 
 _LAZY = {
     "ServingFleet": ".manager",
@@ -19,8 +22,12 @@ _LAZY = {
     "ReplicaStats": ".replica",
     "LocalReplica": ".replica",
     "ProcessReplica": ".replica",
+    "ReplicaCrash": ".replica",
+    "ReplicaDead": ".replica",
+    "WorkerProtocolError": ".replica",
     "serialize_handoff": ".handoff",
     "deserialize_handoff": ".handoff",
+    "HandoffError": ".handoff",
 }
 
 

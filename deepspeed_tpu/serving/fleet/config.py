@@ -13,7 +13,10 @@ decode replicas so bursty prompt traffic cannot starve steady-state
 decode (docs/serving.md "Multi-replica fleet").
 """
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .supervision import SupervisionConfig
 
 ROUTERS = ("prefix_affinity", "least_loaded")
 BACKENDS = ("inprocess", "process")
@@ -97,6 +100,30 @@ class FleetConfig:
                                      # (submit/admit/handoff/failover/
                                      # finish on the fleet step clock);
                                      # 0 disables
+    worker_reply_timeout_s: float = 120.0
+                                     # process backend: how long the
+                                     # manager waits on one worker reply
+                                     # before declaring the pipe wedged
+                                     # (WorkerProtocolError -> death ->
+                                     # supervision)
+    supervision: Optional[SupervisionConfig] = field(default=None)
+                                     # self-healing policy (restart with
+                                     # backoff, crash-loop retirement,
+                                     # degraded disaggregation, handoff
+                                     # retry budget); absent = defaults
+                                     # (ENABLED — supervision.enabled:
+                                     # false restores fatal/no-respawn
+                                     # PR-12 semantics)
+
+    def __post_init__(self):
+        # nested-dict lift, same contract as ServingConfig.__post_init__
+        # ({"serving": {"fleet": {"supervision": {...}}}} arrives as a
+        # plain dict); None means "all defaults", which keeps the
+        # manager's config reads unconditional
+        if self.supervision is None:
+            self.supervision = SupervisionConfig()
+        elif isinstance(self.supervision, dict):
+            self.supervision = SupervisionConfig(**self.supervision)
 
     def validate(self, serving_config=None) -> "FleetConfig":
         if self.replicas < 1:
@@ -162,6 +189,11 @@ class FleetConfig:
             raise ValueError(
                 "serving.fleet.flight_recorder_events must be >= 0 "
                 f"(0 disables), got {self.flight_recorder_events}")
+        if self.worker_reply_timeout_s <= 0:
+            raise ValueError(
+                "serving.fleet.worker_reply_timeout_s must be > 0, got "
+                f"{self.worker_reply_timeout_s}")
+        self.supervision.validate()
         if self.disaggregate and self.min_replicas < 2:
             # a disaggregated fleet can never drain below one prefill +
             # one decode replica

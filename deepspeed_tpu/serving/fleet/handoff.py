@@ -38,6 +38,15 @@ COMPAT_HANDOFF_VERSIONS = (1, 2)  # what this build's readers accept
 _ARRAY_META = ("prompt",)
 
 
+class HandoffError(ValueError):
+    """A handoff payload that cannot be decoded: truncated blob,
+    corrupt archive, missing record, or an unknown wire version. Named
+    so the fleet's injection-retry path can tell transfer corruption
+    (bounded retry, then re-prefill through failover) from a
+    programming error — raw ``BadZipFile``/``KeyError`` never reach the
+    fleet loop."""
+
+
 def handoff_nbytes(payload: Dict) -> int:
     """Wire bytes of the page transfer itself (the figure the fleet
     bench reports): KV page contents + scale planes only."""
@@ -75,20 +84,32 @@ def serialize_handoff(payload: Dict) -> bytes:
 
 def deserialize_handoff(blob: bytes) -> Dict:
     """Rebuild the payload dict ``inject_handoff`` consumes from a
-    ``serialize_handoff`` blob."""
-    with np.load(io.BytesIO(blob)) as z:
-        meta = json.loads(bytes(z["meta"].tobytes()).decode("utf-8"))
-        if meta.get("version") not in COMPAT_HANDOFF_VERSIONS:
-            raise ValueError(
-                f"unknown handoff wire version {meta.get('version')!r} "
-                f"(this build speaks {COMPAT_HANDOFF_VERSIONS})")
-        kv = []
-        for i in range(meta["n_units"]):
-            prefix = f"kv/{i}/"
-            kv.append({k[len(prefix):]: z[k] for k in z.files
-                       if k.startswith(prefix)})
-        request = dict(meta["request"])
-        request["prompt"] = z["request/prompt"]
+    ``serialize_handoff`` blob. Raises the NAMED :class:`HandoffError`
+    on a truncated or corrupt blob — the fleet retries/fails over on
+    it; it never injects garbage pages."""
+    try:
+        with np.load(io.BytesIO(blob)) as z:
+            meta = json.loads(bytes(z["meta"].tobytes()).decode("utf-8"))
+            if meta.get("version") not in COMPAT_HANDOFF_VERSIONS:
+                raise HandoffError(
+                    f"unknown handoff wire version {meta.get('version')!r} "
+                    f"(this build speaks {COMPAT_HANDOFF_VERSIONS})")
+            kv = []
+            for i in range(meta["n_units"]):
+                prefix = f"kv/{i}/"
+                kv.append({k[len(prefix):]: z[k] for k in z.files
+                           if k.startswith(prefix)})
+            request = dict(meta["request"])
+            request["prompt"] = z["request/prompt"]
+    except HandoffError:
+        raise
+    except Exception as e:   # ds-tpu: lint-ok[PY001] — np.load on a torn
+        # blob raises anything from BadZipFile to KeyError to OSError;
+        # the wire boundary maps them ALL to the one named error the
+        # retry path understands
+        raise HandoffError(
+            f"truncated or corrupt handoff payload ({len(blob)} bytes): "
+            f"{type(e).__name__}: {e}") from e
     return {
         "version": meta["version"],
         "page_len": meta["page_len"],

@@ -32,16 +32,26 @@ import numpy as np
 from ...observability.fleet import (FleetTelemetryAggregator,
                                     FlightRecorder, make_trace_id,
                                     per_request_breakdown)
+from ...observability.metrics import get_registry
 from ...utils.logging import log_dist
 from ..request import Request
 from .config import FleetConfig
-from .replica import LocalReplica, ProcessReplica, ReplicaDead
+from .handoff import HandoffError, deserialize_handoff, serialize_handoff
+from .replica import (LocalReplica, ProcessReplica, ReplicaCrash,
+                      ReplicaDead)
 from .router import Router
+from .supervision import ReplicaSupervisor, SupervisionConfig
 
 TERMINAL = ("finished", "timeout", "cancelled", "shed")
 LOG_LIMIT = 4096     # dispatch/handoff log entries kept (replay asserts
                      # run over bounded traces; a long-lived server must
                      # not grow them forever)
+DEAD_REPLICAS_KEPT = 16   # corpse history: dead replicas stay readable
+                          # in snapshots (their served work must not
+                          # vanish) up to this many; older ones are
+                          # pruned — a supervised fleet restarts without
+                          # bound and must not do O(ever-spawned) work
+                          # per step
 
 
 class FleetRequest:
@@ -163,7 +173,8 @@ class ServingFleet:
         self._next_rid = 0
         self._failed = set()            # rids whose failover already ran
         self._handles: Dict[object, FleetRequest] = {}   # LIVE handles
-        self._handoff_backlog = deque() # [(payload, handle|None)]
+        self._handoff_backlog = deque() # [{"payload","handle","attempts",
+                                        #   "not_before"}]
         self._iteration = 0
         self.dispatch_log: List[tuple] = []   # (request_id, replica_id)
         self.handoff_log: List[tuple] = []    # (request_id, src, dst) —
@@ -177,6 +188,28 @@ class ServingFleet:
         self.requests_finished = 0
         self.last_scale_decision: Optional[dict] = None
         self.telemetry = None
+        # -- supervision (the self-healing layer) --------------------------
+        self.scfg: SupervisionConfig = self.fcfg.supervision
+        self._supervised = bool(self.scfg.enabled)
+        self.supervisor = ReplicaSupervisor(self.scfg)
+        self._lineage: Dict[int, int] = {}   # rid -> lineage id
+        self.replica_restarts = 0       # incarnations respawned
+        self.handoffs_dropped = 0       # payloads past the retry budget
+        self.handoff_retries = 0        # FAILED injection attempts
+        self.degraded = False           # prefill pool empty: decode
+                                        # replicas run their own chunked
+                                        # prefill until one returns
+        self.degraded_entered = 0
+        self._orphans = deque()         # handles waiting for a restart
+                                        # (no dispatchable replica when
+                                        # they needed one)
+        self._protocol_errors_pruned = 0
+                                        # protocol errors carried from
+                                        # pruned corpses (the snapshot
+                                        # counter must never decrease)
+        self.chaos_corrupt_handoffs = 0 # chaos hook: truncate the next N
+                                        # handoff payloads in transit
+                                        # (models wire corruption)
         # fleet-level flight recorder: request lifecycle events on the
         # FLEET step clock (submit/admit/first_token/handoff/failover/
         # terminal) — the per-request waterfall's input and the crash
@@ -210,10 +243,14 @@ class ServingFleet:
             ranks=[0])
 
     # -- replica lifecycle -------------------------------------------------
-    def _spawn_replica(self, role: Optional[str] = None):
+    def _spawn_replica(self, role: Optional[str] = None,
+                       lineage: Optional[int] = None):
         rid = self._next_rid
         self._next_rid += 1
         role = role or self.fcfg.role_for(rid)
+        if lineage is None:
+            lineage = self.supervisor.register(role)
+        self._lineage[rid] = lineage
         if self.fcfg.backend == "process":
             # the aggregator needs a scrape target, so a process
             # replica under aggregation always gets an endpoint even
@@ -224,7 +261,9 @@ class ServingFleet:
                                  {**self._spec,
                                   "telemetry_port": 0 if want_port
                                   else None,
-                                  "trace": self.fcfg.replica_trace})
+                                  "trace": self.fcfg.replica_trace},
+                                 reply_timeout_s=self.fcfg
+                                 .worker_reply_timeout_s)
         else:
             rep = LocalReplica(rid, role, self._module, self._params,
                                self._replica_config,
@@ -254,7 +293,46 @@ class ServingFleet:
         return [self._replicas[r].stats() for r in rids]
 
     def _submit_roles(self):
-        return ("prefill",) if self.fcfg.disaggregate else ("full",)
+        if not self.fcfg.disaggregate:
+            return ("full",)
+        # degraded disaggregation: with the prefill pool empty, decode
+        # replicas temporarily take submissions end-to-end (their own
+        # chunked prefill) instead of stranding the queue
+        return ("decode",) if self.degraded else ("prefill",)
+
+    def _dispatchable(self, rids: List[int]) -> List[int]:
+        """Filter a live-replica list down to the ones the aggregated
+        telemetry considers dispatch-healthy (``up`` and not stale).
+        Never empties the list on telemetry alone — with every replica
+        stale the fleet still dispatches rather than bricking on its
+        own observability plane."""
+        if self._aggregator is None:
+            return rids
+        healthy = [r for r in rids if self._aggregator.healthy(r)]
+        return healthy if healthy else rids
+
+    def _park(self, handle: FleetRequest):
+        """No dispatchable replica right now but capacity is coming
+        back (a pending restart, or degraded mode about to cover the
+        missing role): hold the handle until it does (re-dispatched
+        FIFO from ``advance()``)."""
+        handle.replica_id = None
+        self._handles[handle.request_id] = handle
+        self._orphans.append(handle)
+        self.recorder.record("parked", request_id=handle.request_id,
+                             trace_id=handle.trace_id,
+                             iteration=self._iteration)
+
+    def _can_wait_for_capacity(self) -> bool:
+        """Parking beats raising when capacity will return: a restart
+        is scheduled, or the fleet is disaggregated with live decode
+        replicas (degraded mode covers a lost prefill pool on the next
+        fleet step)."""
+        if not self._supervised:
+            return False
+        if self.supervisor.pending():
+            return True
+        return bool(self.fcfg.disaggregate and self._alive(("decode",)))
 
     # -- client API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -266,18 +344,26 @@ class ServingFleet:
             max_new_tokens = self.config.default_max_new_tokens
         if request_id is None:
             request_id = f"f{self.requests_submitted}"
-        eligible = self._alive(self._submit_roles())
-        if not eligible:
+        eligible = self._dispatchable(self._alive(self._submit_roles()))
+        if not eligible and not self._can_wait_for_capacity():
             raise RuntimeError("fleet: no live replica accepts submissions")
-        target = self.router.route(
-            np.asarray(prompt, np.int32), self._stats(eligible),
-            step=self._iteration, request_id=request_id)
         handle = FleetRequest(prompt, max_new_tokens, request_id,
                               priority=priority, on_token=on_token,
                               trace_id=make_trace_id(
                                   request_id, self.requests_submitted))
         handle.submitted_iteration = self._iteration
         self.requests_submitted += 1
+        if not eligible:
+            self.recorder.record("submit", request_id=request_id,
+                                 trace_id=handle.trace_id,
+                                 replica_id=None,
+                                 iteration=self._iteration,
+                                 prompt_len=int(handle.prompt.shape[0]))
+            self._park(handle)      # supervision will bring one back
+            return handle
+        target = self.router.route(
+            np.asarray(prompt, np.int32), self._stats(eligible),
+            step=self._iteration, request_id=request_id)
         self.dispatch_log.append((request_id, target))
         del self.dispatch_log[:-LOG_LIMIT]
         self.recorder.record("submit", request_id=request_id,
@@ -330,8 +416,12 @@ class ServingFleet:
                 # — the request must not ride a corpse or get lost; the
                 # death sweep reaps the replica next advance. Bounded:
                 # each retry excludes one more dead replica.
-                eligible = self._alive(self._submit_roles())
+                eligible = self._dispatchable(
+                    self._alive(self._submit_roles()))
                 if not eligible:
+                    if self._can_wait_for_capacity():
+                        self._park(handle)
+                        return
                     raise RuntimeError(
                         "fleet: no live replica accepts submissions")
                 target = self.router.route(
@@ -366,37 +456,82 @@ class ServingFleet:
 
     # -- the fleet step ----------------------------------------------------
     def advance(self):
-        """One fleet iteration: detect deaths and fail their requests
-        over, advance every live replica one engine step (lockstep),
-        harvest completions, pump page handoffs, run the health sweep
-        and the autoscaler on their cadences."""
+        """One fleet iteration: respawn replicas whose restart backoff
+        elapsed, detect deaths and fail their requests over, advance
+        every live replica one engine step (lockstep), harvest
+        completions, pump page handoffs, run the health sweep and the
+        autoscaler on their cadences."""
+        self._supervise_tick()
         for rid, rep in sorted(self._replicas.items()):
             if not rep.alive and rid not in self._failed:
                 self._fail_replica(rid)
         if not self._alive():
+            if self._supervised and self.supervisor.pending():
+                # every incarnation is down but restarts are scheduled:
+                # this step only advances the backoff clock
+                self._iteration += 1
+                return
             raise RuntimeError(
                 "fleet: every replica is dead — nothing left to serve "
                 "the backlog")
+        self._update_degraded()
         if self.fcfg.disaggregate and self.busy:
             for role in ("prefill", "decode"):
-                if not self._alive((role,)):
-                    # a one-sided fleet can neither prefill nor finish:
-                    # fail loudly (containment = partial snapshot +
-                    # restart) instead of spinning on a stalled backlog
-                    raise RuntimeError(
-                        f"fleet: disaggregated fleet lost every {role} "
-                        "replica — in-flight work cannot complete")
+                if self._alive((role,)):
+                    continue
+                if role == "prefill" and self.degraded:
+                    continue     # decode replicas are covering prefill
+                if self._supervised and \
+                        self.supervisor.pending((role, "full")):
+                    continue     # a restart is due: wait, don't brick
+                # a one-sided fleet can neither prefill nor finish and
+                # nothing is coming back: fail loudly (containment =
+                # partial snapshot + restart) instead of spinning on a
+                # stalled backlog
+                raise RuntimeError(
+                    f"fleet: disaggregated fleet lost every {role} "
+                    "replica — in-flight work cannot complete")
+        self._redispatch_orphans()
         handoff_ready = []   # [(rid, id)] from process replicas
         for rid in self._alive():
             rep = self._replicas[rid]
             if rep.backend == "inprocess":
-                rep.advance()    # ReplicaCrash propagates: in-process
-                                 # crashes are fatal (see replica.py)
+                try:
+                    rep.advance()
+                except Exception as e:   # ds-tpu: lint-ok[PY001] — the
+                    # supervision boundary: ANY engine fault mid-advance
+                    # (the ReplicaCrash chaos hook or a real XLA/host
+                    # error) is one replica's death, not the fleet's
+                    if not self._supervised:
+                        raise    # PR-12 semantics: in-process crashes
+                                 # are fatal without supervision
+                    # contain it: the crashed engine is discarded
+                    # wholesale (state untrustworthy), its requests fail
+                    # over with tokens retained, and supervision decides
+                    # restart vs crash-loop retirement
+                    rep.alive = False
+                    log_dist(f"fleet: replica {rid} crashed mid-advance "
+                             f"({type(e).__name__}: {e}) — containing",
+                             ranks=[0])
+                    self._fail_replica(rid)
+                    continue
             else:
                 try:
                     reply = rep.advance()
                 except ReplicaDead:
                     continue     # detected at the top of the next step
+                except RuntimeError as e:
+                    # the worker answered the advance op with a typed
+                    # error reply: its ENGINE faulted mid-step (the pipe
+                    # itself is fine, but the engine state is suspect) —
+                    # one replica's fault must not kill the fleet loop
+                    if not self._supervised:
+                        raise
+                    rep.alive = False
+                    log_dist(f"fleet: replica {rid} advance failed "
+                             f"({e}) — containing", ranks=[0])
+                    self._fail_replica(rid)
+                    continue
                 self._apply_worker_reply(rid, reply)
                 handoff_ready.extend((rid, hid)
                                      for hid in reply.get("handoff_ready",
@@ -424,7 +559,96 @@ class ServingFleet:
 
     @property
     def busy(self) -> bool:
-        return bool(self._handles) or bool(self._handoff_backlog)
+        return (bool(self._handles) or bool(self._handoff_backlog)
+                or bool(self._orphans))
+
+    # -- supervision (restart, backoff, crash-loop, degraded mode) ---------
+    def _supervise_tick(self):
+        """Spawn every lineage whose restart backoff elapsed. A spawn
+        that fails (a worker that dies at init, say) reports straight
+        back to the supervisor — it counts as another crash, so a
+        deterministic init-crasher backs off and eventually retires
+        instead of spinning the fleet step."""
+        if not self._supervised:
+            return
+        for lid, role in self.supervisor.take_due(self._iteration):
+            try:
+                rep = self._spawn_replica(role=role, lineage=lid)
+            except Exception as e:   # ds-tpu: lint-ok[PY001] — a failed
+                # respawn must feed the crash-loop detector, never kill
+                # the fleet step serving the survivors
+                verdict = self.supervisor.on_death(lid, self._iteration)
+                if verdict == "retired":
+                    self._note_crash_loop_retirement(lid, role)
+                log_dist(f"fleet: restart of lineage {lid} ({role}) "
+                         f"failed ({e}) — {verdict}", ranks=[0])
+                continue
+            self.replica_restarts += 1
+            get_registry().counter("fleet/replica_restarts").inc()
+            self.recorder.record("replica_restarted",
+                                 replica_id=rep.replica_id,
+                                 iteration=self._iteration, lineage=lid)
+            log_dist(f"fleet: supervision respawned lineage {lid} as "
+                     f"replica {rep.replica_id} ({role})", ranks=[0])
+
+    def _note_crash_loop_retirement(self, lid: int, role: str):
+        self.replicas_retired += 1
+        get_registry().counter("fleet/replicas_retired").inc()
+        self.recorder.record("replica_retired", replica_id=None,
+                             iteration=self._iteration, lineage=lid,
+                             crash_loop=True)
+        log_dist(f"fleet: lineage {lid} ({role}) crash-looped "
+                 f"(> {self.scfg.max_restarts} deaths within "
+                 f"{self.scfg.crash_window_steps} steps) — permanently "
+                 "retired; serving continues on the survivors",
+                 ranks=[0])
+
+    def _update_degraded(self):
+        """Degraded disaggregation: when the prefill pool empties while
+        decode replicas survive, submissions run end-to-end on decode
+        replicas (their own chunked prefill) instead of stranding the
+        queue; exits automatically the step a prefill replica returns."""
+        if not (self.fcfg.disaggregate and self._supervised):
+            return
+        prefill = self._alive(("prefill",))
+        decode = self._alive(("decode",))
+        if not self.degraded and not prefill and decode:
+            self.degraded = True
+            self.degraded_entered += 1
+            get_registry().gauge("fleet/degraded_mode").set(1)
+            get_registry().counter("fleet/degraded_entered").inc()
+            self.recorder.record("degraded_enter",
+                                 iteration=self._iteration)
+            log_dist("fleet: prefill pool empty — degraded mode: decode "
+                     "replicas run their own chunked prefill until a "
+                     "prefill replica returns", ranks=[0])
+        elif self.degraded and prefill:
+            self.degraded = False
+            get_registry().gauge("fleet/degraded_mode").set(0)
+            self.recorder.record("degraded_exit",
+                                 iteration=self._iteration)
+            log_dist("fleet: prefill replica back — leaving degraded "
+                     "mode", ranks=[0])
+
+    def _redispatch_orphans(self):
+        """Re-dispatch requests that were parked with no dispatchable
+        replica (FIFO on the fleet clock — deterministic re-admission
+        through the ordinary router/failover path, tokens retained)."""
+        while self._orphans:
+            eligible = self._dispatchable(
+                self._alive(self._submit_roles()))
+            if not eligible:
+                return
+            handle = self._orphans.popleft()
+            if handle.done:
+                continue
+            target = self.router.route(
+                handle.effective_prompt(), self._stats(eligible),
+                step=self._iteration, request_id=handle.request_id)
+            self.dispatch_log.append((handle.request_id, target))
+            del self.dispatch_log[:-LOG_LIMIT]
+            self._dispatch(handle, target, handle.effective_prompt(),
+                           handle.remaining_budget())
 
     def run(self, max_iterations: Optional[int] = None):
         it = 0
@@ -488,11 +712,31 @@ class ServingFleet:
                                rec.get("shed_reason"))
 
     # -- disaggregated handoff pump ---------------------------------------
+    def _stage_handoff(self, payload: dict, handle):
+        """Queue one exported payload for injection (the chaos hook
+        models wire corruption here — a truncated blob in transit)."""
+        if self.chaos_corrupt_handoffs > 0:
+            self.chaos_corrupt_handoffs -= 1
+            blob = serialize_handoff(payload)
+            payload = {"_truncated": blob[:max(8, len(blob) // 3)],
+                       "request": payload["request"]}
+        self._handoff_backlog.append(
+            {"payload": payload, "handle": handle, "attempts": 0,
+             "not_before": 0})
+
     def _pump_handoffs(self, process_ready):
         """Export every staged prefill and inject into the least-loaded
-        decode replica; page-starved injections stay in the backlog and
-        retry next step (deterministic: backlog order is FIFO on the
-        fleet clock)."""
+        dispatch-healthy decode replica. Backlog discipline
+        (deterministic — FIFO on the fleet clock):
+
+        - page/slot STARVATION on the target is backpressure, not a
+          failure: the payload retries next step, unbudgeted;
+        - injection ERRORS (corrupt payload, dead replica, worker error
+          reply) are retried with exponential fleet-step backoff and a
+          bounded budget (``supervision.handoff_max_retries``); past it
+          the payload is dropped and the request re-prefills through
+          the ordinary failover path — tokens retained, token-exact,
+          never stranded."""
         for rid in self._alive(("prefill",)):
             rep = self._replicas[rid]
             if rep.backend != "inprocess":
@@ -503,7 +747,7 @@ class ServingFleet:
                 if handle is not None:
                     handle.replica_id = None       # in transit
                 self._record_handoff_export(payload, rid)
-                self._handoff_backlog.append((payload, handle))
+                self._stage_handoff(payload, handle)
         for rid, hid in process_ready:
             rep = self._replicas[rid]
             if not rep.alive:
@@ -513,39 +757,92 @@ class ServingFleet:
                 payload = rep.export_handoff_by_id(hid)
             except ReplicaDead:
                 continue       # the death sweep requeues from the handle
+            except (HandoffError, RuntimeError, ValueError) as e:
+                # the export failed without killing the pipe: a torn
+                # blob (HandoffError/binascii), or the worker's op_export
+                # faulted and answered with a typed error reply
+                # (RuntimeError). The staged state is gone either way —
+                # nothing to retry; re-prefill the request elsewhere
+                # rather than letting one replica's fault crash the
+                # fleet loop
+                log_dist(f"fleet: handoff export from replica {rid} "
+                         f"failed ({e}) — failing the request over",
+                         ranks=[0])
+                self.handoffs_dropped += 1
+                get_registry().counter("fleet/handoffs_dropped").inc()
+                if handle is not None and not handle.done:
+                    self._failover(handle)
+                continue
             if handle is not None:
                 handle.replica_id = None
             self._record_handoff_export(payload, rid)
-            self._handoff_backlog.append((payload, handle))
+            self._stage_handoff(payload, handle)
         retry = deque()
         while self._handoff_backlog:
-            payload, handle = self._handoff_backlog.popleft()
-            decode = self._alive(("decode",))
+            ent = self._handoff_backlog.popleft()
+            if ent["not_before"] > self._iteration:
+                retry.append(ent)       # still backing off
+                continue
+            payload, handle = ent["payload"], ent["handle"]
+            if handle is not None and handle.done:
+                continue    # finished via an earlier (ambiguously
+                            # reported) injection: nothing left to send
+            decode = self._dispatchable(self._alive(("decode",)))
             # refresh load per injection: a burst of handoffs must fan
             # out across decode replicas, not pile onto one snapshot
             target = self.router.pick_least_loaded(self._stats(decode)) \
                 if decode else None
             if target is None:
-                retry.append((payload, handle))
+                retry.append(ent)       # no target yet: wait, free
                 continue
             rep = self._replicas[target]
-            accepted = self._inject(rep, payload, handle)
-            if not accepted:
-                retry.append((payload, handle))
+            error = None
+            try:
+                accepted = self._inject(rep, payload, handle)
+            except (HandoffError, ReplicaDead, RuntimeError,
+                    ValueError) as e:
+                accepted, error = False, e
+            if accepted:
+                src = (handle.prefill_replica_id if handle is not None
+                       else None)
+                hid = payload["request"]["request_id"]
+                self.handoffs_completed += 1
+                self.handoff_log.append((hid, src, target))
+                del self.handoff_log[:-LOG_LIMIT]
+                self.recorder.record(
+                    "handoff_inject", request_id=hid,
+                    trace_id=payload["request"].get("trace_id"),
+                    replica_id=target, iteration=self._iteration,
+                    src=src)
+                if handle is not None:
+                    handle.replica_id = target
+                    handle.handoffs += 1
                 continue
-            src = (handle.prefill_replica_id if handle is not None
-                   else None)
+            if error is None:
+                retry.append(ent)       # starvation: retry next step
+                continue
+            ent["attempts"] += 1
+            self.handoff_retries += 1
+            get_registry().counter("fleet/handoff_retries").inc()
             hid = payload["request"]["request_id"]
-            self.handoffs_completed += 1
-            self.handoff_log.append((hid, src, target))
-            del self.handoff_log[:-LOG_LIMIT]
-            self.recorder.record(
-                "handoff_inject", request_id=hid,
-                trace_id=payload["request"].get("trace_id"),
-                replica_id=target, iteration=self._iteration, src=src)
-            if handle is not None:
-                handle.replica_id = target
-                handle.handoffs += 1
+            if ent["attempts"] > self.scfg.handoff_max_retries:
+                self.handoffs_dropped += 1
+                get_registry().counter("fleet/handoffs_dropped").inc()
+                self.recorder.record(
+                    "handoff_dropped", request_id=hid,
+                    trace_id=payload["request"].get("trace_id"),
+                    iteration=self._iteration,
+                    attempts=ent["attempts"], error=str(error))
+                log_dist(f"fleet: handoff for {hid!r} dropped after "
+                         f"{ent['attempts']} failed injections "
+                         f"({error}) — re-prefilling through failover",
+                         ranks=[0])
+                if handle is not None and not handle.done:
+                    self._failover(handle)
+                continue
+            ent["not_before"] = self._iteration + \
+                self.scfg.handoff_retry_delay_steps(ent["attempts"])
+            retry.append(ent)
         self._handoff_backlog = retry
 
     def _record_handoff_export(self, payload: dict, src_rid: int):
@@ -557,6 +854,11 @@ class ServingFleet:
             prefill_len=int(payload["prefill_len"]))
 
     def _inject(self, rep, payload, handle) -> bool:
+        blob = payload.get("_truncated")
+        if blob is not None:
+            # chaos-corrupted in transit: decoding raises the named
+            # HandoffError exactly as a real torn wire transfer would
+            payload = deserialize_handoff(blob)
         if rep.backend == "inprocess":
             live = rep.inject_handoff(
                 payload, on_token=(self._on_token_cb(handle)
@@ -566,10 +868,7 @@ class ServingFleet:
             if handle is not None:
                 handle._inner = live
             return True
-        try:
-            return rep.inject_handoff(payload)
-        except ReplicaDead:
-            return False
+        return rep.inject_handoff(payload)
 
     # -- failure containment ----------------------------------------------
     def _health_sweep(self):
@@ -607,17 +906,50 @@ class ServingFleet:
             self._aggregator.mark_dead(rid)
         self.recorder.record("replica_dead", replica_id=rid,
                              iteration=self._iteration)
-        victims = [h for h in self._handles.values()
-                   if h.replica_id == rid and not h.done]
-        for handle in victims:
-            self._failover(handle)
+        # hand the death to the supervision policy FIRST — restart after
+        # backoff, or permanent retirement on a crash loop — so the
+        # failovers below can park on the pending restart when this was
+        # the last live replica instead of declaring total loss
+        lid = self._lineage.pop(rid, None)
+        if self._supervised and lid is not None:
+            verdict = self.supervisor.on_death(lid, self._iteration)
+            if verdict == "retired":
+                self._note_crash_loop_retirement(lid, rep.role)
+        # reap the corpse BEFORE failing its work over: kill() drains
+        # the worker's partial-metrics line and closes the pipe fds, and
+        # a total-loss RuntimeError out of the failover below must not
+        # leave a zombie (or lose the partial snapshot)
         try:
             rep.kill()
         except Exception:   # ds-tpu: lint-ok[PY001] — reaping a corpse
             # must never take the fleet down with it
             pass
+        victims = [h for h in self._handles.values()
+                   if h.replica_id == rid and not h.done]
+        for handle in victims:
+            self._failover(handle)
+        self._prune_dead()
         log_dist(f"fleet: replica {rid} dead — {len(victims)} requests "
                  "requeued through the router", ranks=[0])
+
+    def _prune_dead(self):
+        """Trim the corpse history to ``DEAD_REPLICAS_KEPT``: the most
+        recent dead replicas stay in ``self._replicas`` (snapshots read
+        their metrics and partial snapshots), everything older is
+        dropped from the replica map, the failed set, the lineage map,
+        and the aggregator."""
+        dead = [rid for rid, rep in sorted(self._replicas.items())
+                if not rep.alive]
+        for rid in dead[:max(0, len(dead) - DEAD_REPLICAS_KEPT)]:
+            rep = self._replicas.pop(rid, None)
+            # the pruned corpse's protocol-error count rolls into the
+            # carried total so snapshot()'s counter never goes DOWN
+            self._protocol_errors_pruned += getattr(
+                rep, "protocol_errors", 0)
+            self._failed.discard(rid)
+            self._lineage.pop(rid, None)
+            if self._aggregator is not None:
+                self._aggregator.forget(rid)
 
     def _failover(self, handle: FleetRequest):
         """Re-dispatch one orphaned request: continuation = original
@@ -635,8 +967,11 @@ class ServingFleet:
         if remaining <= 0:          # owed nothing more: call it finished
             self._finalize(handle, "finished")
             return
-        eligible = self._alive(self._submit_roles())
+        eligible = self._dispatchable(self._alive(self._submit_roles()))
         if not eligible:
+            if self._can_wait_for_capacity():
+                self._park(handle)
+                return
             raise RuntimeError(
                 "fleet: no live replica left to fail requests over to")
         target = self.router.route(
@@ -719,6 +1054,9 @@ class ServingFleet:
                    if h.replica_id == rid and not h.done]
         rep.alive = False                   # no more routing to it
         self._failed.add(rid)               # failover already handled here
+        # a deliberate drain is not a crash: the supervisor must neither
+        # respawn this lineage nor count it toward a crash loop
+        self.supervisor.deregister(self._lineage.pop(rid, None))
         self.router.forget_replica(rid)
         if self._aggregator is not None:
             self._aggregator.mark_dead(rid)
@@ -728,6 +1066,7 @@ class ServingFleet:
             self._failover(handle)
         rep.stop()
         self.replicas_retired += 1
+        self._prune_dead()
         log_dist(f"fleet: scale-down -> retired replica {rid} "
                  f"({len(victims)} requests re-dispatched)", ranks=[0])
 
@@ -756,19 +1095,35 @@ class ServingFleet:
                 # per-replica breakdown (or the kill-run bench block)
                 entry["serving"] = rep.engine.metrics.snapshot()
             entry["telemetry_port"] = rep.telemetry_port
+            entry["lineage"] = self._lineage.get(rid)
+            pm = getattr(rep, "last_partial_metrics", None)
+            if pm is not None:
+                # the worker's SIGTERM snapshot: what a supervised
+                # teardown managed to say on its way down
+                entry["partial_metrics"] = pm
             replicas[str(rid)] = entry
         out = {
             "iteration": self._iteration,
             "backend": self.fcfg.backend,
             "disaggregate": self.fcfg.disaggregate,
+            "degraded_mode": self.degraded,
+            "degraded_entered": self.degraded_entered,
             "replicas": replicas,
             "router": self.router.stats(),
             "handoffs_in_transit": len(self._handoff_backlog),
             "handoffs_completed": self.handoffs_completed,
+            "handoff_retries": self.handoff_retries,
+            "handoffs_dropped": self.handoffs_dropped,
             "failovers": self.failovers,
             "dead_replicas": self.dead_replicas,
             "replicas_spawned": self.replicas_spawned,
             "replicas_retired": self.replicas_retired,
+            "replica_restarts": self.replica_restarts,
+            "requests_parked": len(self._orphans),
+            "worker_protocol_errors": self._protocol_errors_pruned + sum(
+                getattr(rep, "protocol_errors", 0)
+                for rep in self._replicas.values()),
+            "supervision": self.supervisor.snapshot(),
             "requests_submitted": self.requests_submitted,
             "requests_finished": self.requests_finished,
             "autoscale": self.last_scale_decision,

@@ -19,13 +19,21 @@ Failure matrix (docs/serving.md "Multi-replica fleet"):
 - a DETECTED dead replica (missed health checks, worker process exit,
   ``kill()``) is contained — the manager requeues its in-flight
   requests through the router, the fleet-level mirror of
-  ``engine.recover()``;
-- an UNHANDLED exception out of an in-process replica's ``advance()``
-  is fatal by design: replicas share the process, so a crash mid-
-  dispatch means shared state (donated device buffers, watchdog
-  threads) can no longer be trusted — the serve CLI emits its partial
-  fleet snapshot and exits nonzero for the orchestrator to restart
-  (``ReplicaCrash`` is the chaos hook's vehicle).
+  ``engine.recover()`` — and, under supervision
+  (``serving.fleet.supervision``), a fresh incarnation respawns after
+  exponential backoff;
+- a pipe PROTOCOL failure (malformed or truncated frame, reply
+  timeout) is a named ``WorkerProtocolError`` carrying the replica id:
+  the pipe is desynchronized, so the replica is declared dead and the
+  same containment + supervision path runs — raw decode errors never
+  propagate into the fleet loop;
+- an in-process ``ReplicaCrash`` out of ``advance()`` is recoverable
+  under supervision: the crashed engine is discarded wholesale (its
+  donated device buffers are untrustworthy), its requests fail over
+  with tokens retained, and a FRESH engine respawns after backoff —
+  reusing the process-global jit cache, so a restart never recompiles.
+  With supervision disabled it stays fatal-by-design (partial fleet
+  snapshot + nonzero exit), the pre-supervision PR-12 contract.
 """
 
 import base64
@@ -47,12 +55,29 @@ PROTOCOL_SENTINEL = "@fleet "
 
 class ReplicaCrash(RuntimeError):
     """An in-process replica died mid-advance (chaos injection or a real
-    engine fault): the fleet process is compromised — containment is a
-    partial snapshot + nonzero exit, not failover."""
+    engine fault). Under supervision the manager contains it — failover
+    with tokens retained, then a fresh engine after backoff; with
+    supervision disabled it is fatal (partial snapshot + nonzero
+    exit)."""
 
 
 class ReplicaDead(RuntimeError):
     """A process replica stopped answering the pipe protocol."""
+
+
+class WorkerProtocolError(ReplicaDead):
+    """The worker pipe protocol broke: a malformed or truncated frame,
+    or a reply timeout. Subclasses ``ReplicaDead`` on purpose — a
+    desynchronized pipe cannot be resynchronized, so every containment
+    site treats it as a death and supervision takes over; the named
+    type and ``replica_id``/``kind`` keep the failure attributable
+    instead of a raw ``JSONDecodeError`` in the fleet loop."""
+
+    def __init__(self, replica_id: int, kind: str, detail: str):
+        self.replica_id = int(replica_id)
+        self.kind = kind            # "timeout" | "malformed" | "truncated"
+        super().__init__(f"replica {replica_id} worker protocol error "
+                         f"({kind}): {detail}")
 
 
 @dataclass
@@ -219,6 +244,12 @@ class ProcessReplica:
         self.missed_health = 0
         self.reply_timeout_s = reply_timeout_s
         self.telemetry_port: Optional[int] = None
+        self.protocol_errors = 0   # malformed/truncated frames + reply
+                                   # timeouts observed on this pipe
+        self.last_partial_metrics: Optional[dict] = None
+                                   # the worker's SIGTERM snapshot (the
+                                   # PR-4 emergency-save analog), drained
+                                   # at kill time
         self._scrape = None   # cached MetricsScrapeClient (staleness
                               # stamps accumulate across probes)
         self._last_stats: Optional[ReplicaStats] = None
@@ -233,7 +264,7 @@ class ProcessReplica:
         # reply line in userspace while select blocks on a drained fd
         self._buf = b""
         self._proc = subprocess.Popen(
-            [sys.executable, "-m", "deepspeed_tpu.serving.fleet.worker"],
+            self._worker_argv(),
             stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__))))))
@@ -245,6 +276,22 @@ class ProcessReplica:
                  f"{self._proc.pid} ready (role={role}, telemetry port "
                  f"{self.telemetry_port})", ranks=[0])
 
+    @staticmethod
+    def _worker_argv():
+        """The worker subprocess command line — overridable so
+        protocol/lifecycle tests can drive a stub worker without
+        building an engine."""
+        return [sys.executable, "-m", "deepspeed_tpu.serving.fleet.worker"]
+
+    def _protocol_error(self, kind: str, detail: str):
+        """Declare the pipe desynchronized: count it, mark the replica
+        dead, raise the NAMED error supervision restarts on."""
+        self.alive = False
+        self.protocol_errors += 1
+        from ...observability.metrics import get_registry
+        get_registry().counter("fleet/worker_protocol_errors").inc()
+        raise WorkerProtocolError(self.replica_id, kind, detail)
+
     # -- protocol plumbing -------------------------------------------------
     def _send(self, msg: dict):
         if self._proc.stdin is None or self._proc.poll() is not None:
@@ -253,7 +300,9 @@ class ProcessReplica:
         try:
             self._proc.stdin.write((json.dumps(msg) + "\n").encode("utf-8"))
             self._proc.stdin.flush()
-        except (BrokenPipeError, OSError) as e:
+        except (BrokenPipeError, OSError, ValueError) as e:
+            # ValueError: write on a pipe a teardown branch already
+            # closed — same verdict as a broken pipe
             self.alive = False
             raise ReplicaDead(
                 f"replica {self.replica_id} pipe closed: {e}") from e
@@ -266,12 +315,19 @@ class ProcessReplica:
         while b"\n" not in self._buf:
             ready, _, _ = select.select([fd], [], [], self.reply_timeout_s)
             if not ready:
-                self.alive = False
-                raise ReplicaDead(
-                    f"replica {self.replica_id} worker silent past "
-                    f"{self.reply_timeout_s}s")
+                self._protocol_error(
+                    "timeout", f"worker silent past "
+                    f"{self.reply_timeout_s}s (pid {self._proc.pid} "
+                    "may be wedged)")
             chunk = os.read(fd, 1 << 16)
             if not chunk:                     # EOF — the worker died
+                if self._buf:
+                    # bytes stranded without a newline: the worker died
+                    # MID-frame — a truncated frame, not a clean exit
+                    self._protocol_error(
+                        "truncated", f"worker exited mid-frame with "
+                        f"{len(self._buf)} unterminated bytes "
+                        f"(rc={self._proc.poll()})")
                 self.alive = False
                 raise ReplicaDead(
                     f"replica {self.replica_id} worker exited "
@@ -284,7 +340,18 @@ class ProcessReplica:
         while True:
             line = self._read_line().decode("utf-8", "replace")
             if line.startswith(PROTOCOL_SENTINEL):
-                reply = json.loads(line[len(PROTOCOL_SENTINEL):])
+                try:
+                    reply = json.loads(line[len(PROTOCOL_SENTINEL):])
+                except ValueError:
+                    self._protocol_error(
+                        "malformed",
+                        f"undecodable protocol frame: {line[:120]!r}")
+                if reply.get("op") == "partial_metrics":
+                    # out-of-band: the worker's SIGTERM handler shipped
+                    # its partial snapshot — stash it and keep waiting
+                    # for the actual reply
+                    self.last_partial_metrics = reply
+                    continue
                 if reply.get("op") == "error":
                     raise RuntimeError(
                         f"replica {self.replica_id} worker error: "
@@ -314,9 +381,15 @@ class ProcessReplica:
         self._send({"op": "advance"})
         reply = self._read_reply()
         self._inflight = 0
-        self._last_stats = ReplicaStats(
-            replica_id=self.replica_id, alive=True, role=self.role,
-            **reply["stats"])
+        try:
+            self._last_stats = ReplicaStats(
+                replica_id=self.replica_id, alive=True, role=self.role,
+                **reply["stats"])
+        except (KeyError, TypeError) as e:
+            # a structurally wrong advance reply is a protocol break,
+            # not a crash in the fleet loop
+            self._protocol_error(
+                "malformed", f"advance reply missing/bad stats: {e}")
         return reply
 
     def stats(self) -> ReplicaStats:
@@ -398,11 +471,68 @@ class ProcessReplica:
         return bool(self._read_reply().get("accepted"))
 
     # -- lifecycle ---------------------------------------------------------
+    def _close_pipes(self):
+        """Release both pipe fds — EVERY teardown branch must land here
+        or repeated spawn/stop cycles leak two fds per replica."""
+        for f in (self._proc.stdin, self._proc.stdout):
+            try:
+                if f is not None:
+                    f.close()
+            except OSError:
+                pass
+
+    def _drain_partial(self):
+        """Best-effort read of whatever the worker flushed on its way
+        down — the SIGTERM handler's ``partial_metrics`` line in
+        particular. Never blocks past a beat; called after the process
+        is already dead or dying."""
+        if self._proc.stdout is None:
+            return
+        fd = self._proc.stdout.fileno()
+        try:
+            while True:
+                ready, _, _ = select.select([fd], [], [], 0.2)
+                if not ready:
+                    break
+                chunk = os.read(fd, 1 << 16)
+                if not chunk:
+                    break
+                self._buf += chunk
+        except OSError:
+            pass
+        while b"\n" in self._buf:
+            line, self._buf = self._buf.split(b"\n", 1)
+            text = line.decode("utf-8", "replace")
+            if not text.startswith(PROTOCOL_SENTINEL):
+                continue
+            try:
+                reply = json.loads(text[len(PROTOCOL_SENTINEL):])
+            except ValueError:
+                continue
+            if reply.get("op") == "partial_metrics":
+                self.last_partial_metrics = reply
+
+    def _reap(self, grace_s: float = 10.0):
+        """Wait the child out so no zombie survives; escalate to
+        SIGKILL when the grace window runs dry."""
+        try:
+            self._proc.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+            try:
+                self._proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
     def kill(self):
         self.alive = False
         if self._proc.poll() is None:
-            self._proc.kill()
-            self._proc.wait(timeout=10)
+            # SIGTERM first: the worker's PR-4-style handler gets one
+            # beat to ship its partial metrics snapshot up the pipe
+            self._proc.terminate()
+            self._reap(grace_s=5)
+        self._drain_partial()
+        self._close_pipes()
 
     def stop(self):
         if self.alive and self._proc.poll() is None:
@@ -411,4 +541,12 @@ class ProcessReplica:
                 self._proc.wait(timeout=30)
             except (ReplicaDead, subprocess.TimeoutExpired):
                 self._proc.kill()
+                self._reap()
+        elif self._proc.poll() is None:
+            # declared dead (protocol error) but the pid survives — a
+            # wedged worker must not outlive its fleet
+            self._proc.kill()
+            self._reap()
         self.alive = False
+        self._drain_partial()
+        self._close_pipes()

@@ -1,0 +1,196 @@
+"""Replica supervision policy: restart, backoff, crash-loop retirement.
+
+The ``ReplicaSupervisor`` is the fleet's self-healing brain — pure host
+policy, stdlib-only (the same import contract as the rest of
+``fleet/config.py``), driven entirely by the deterministic fleet step
+clock so a replayed trace reproduces every restart decision bit-exactly.
+
+The manager tracks replicas by *lineage*: one lineage is one logical
+fleet member across however many incarnations supervision spawns for
+it. When an incarnation dies (worker process exit, pipe protocol
+error, in-process ``ReplicaCrash``, missed health checks), the manager
+reports the death here and the supervisor answers with one of two
+verdicts:
+
+- ``"restart"`` — a fresh incarnation is due after an exponential
+  backoff (``backoff_base_steps * 2^(in-window crashes - 1)`` fleet
+  steps, capped at ``backoff_max_steps``; an isolated crash outside
+  the window restarts at the base delay again); the manager spawns it
+  from ``take_due()`` on a later fleet step. In-flight requests never wait for the restart —
+  they fail over to the survivors immediately with their generated
+  tokens retained (the PR-10 resume guarantee).
+- ``"retired"`` — the lineage crash-looped: more than ``max_restarts``
+  deaths inside a sliding ``crash_window_steps`` window. The fleet
+  keeps serving on the survivors and never respawns this lineage
+  (``fleet/replicas_retired``); restarting a deterministic crasher
+  forever would burn capacity without ever serving a token.
+
+Deliberate retirements (autoscaler scale-down, ``fleet.close()``) are
+``deregister()``\\ ed instead — an intentional drain must not look like
+a crash or trigger a respawn.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class SupervisionConfig:
+    """The ``serving.fleet.supervision`` sub-block (docs/config.md).
+
+    Also carries the handoff-injection hardening knobs: injection
+    retries ride the same fleet-step backoff discipline the restart
+    policy uses, so the whole self-healing layer is tuned in one
+    place.
+    """
+    enabled: bool = True             # restart dead/crashed replicas
+                                     # (both backends); false restores
+                                     # the PR-12 behavior — detected
+                                     # deaths fail over but nothing
+                                     # respawns, and an in-process
+                                     # ReplicaCrash is fatal
+    max_restarts: int = 3            # deaths tolerated per lineage
+                                     # inside crash_window_steps; one
+                                     # more permanently retires it
+    crash_window_steps: int = 256    # sliding window (fleet steps) the
+                                     # crash-loop detector counts over
+    backoff_base_steps: int = 2      # restart delay doubles per restart:
+                                     # base * 2^n fleet steps ...
+    backoff_max_steps: int = 64      # ... capped here
+    handoff_max_retries: int = 3     # FAILED injection attempts per
+                                     # handoff payload before the fleet
+                                     # drops it and re-prefills the
+                                     # request through failover
+                                     # (starvation waits are free — only
+                                     # errors count)
+    handoff_backoff_steps: int = 1   # fleet steps between injection
+                                     # retries, doubling per failure
+
+    def validate(self) -> "SupervisionConfig":
+        if self.max_restarts < 0:
+            raise ValueError(
+                "serving.fleet.supervision.max_restarts must be >= 0, "
+                f"got {self.max_restarts}")
+        if self.crash_window_steps < 1:
+            raise ValueError(
+                "serving.fleet.supervision.crash_window_steps must be "
+                f">= 1, got {self.crash_window_steps}")
+        if self.backoff_base_steps < 1:
+            raise ValueError(
+                "serving.fleet.supervision.backoff_base_steps must be "
+                f">= 1, got {self.backoff_base_steps}")
+        if self.backoff_max_steps < self.backoff_base_steps:
+            raise ValueError(
+                "serving.fleet.supervision.backoff_max_steps must be >= "
+                f"backoff_base_steps ({self.backoff_base_steps}), got "
+                f"{self.backoff_max_steps}")
+        if self.handoff_max_retries < 0:
+            raise ValueError(
+                "serving.fleet.supervision.handoff_max_retries must be "
+                f">= 0, got {self.handoff_max_retries}")
+        if self.handoff_backoff_steps < 1:
+            raise ValueError(
+                "serving.fleet.supervision.handoff_backoff_steps must "
+                f"be >= 1, got {self.handoff_backoff_steps}")
+        return self
+
+    def restart_delay_steps(self, restarts: int) -> int:
+        """Backoff before restart number ``restarts + 1`` (0-indexed):
+        exponential from ``backoff_base_steps``, capped."""
+        return min(self.backoff_max_steps,
+                   self.backoff_base_steps * (2 ** max(0, restarts)))
+
+    def handoff_retry_delay_steps(self, attempts: int) -> int:
+        """Backoff after the ``attempts``-th failed injection."""
+        return min(self.backoff_max_steps,
+                   self.handoff_backoff_steps * (2 ** max(0, attempts - 1)))
+
+
+class ReplicaSupervisor:
+    """Restart/retire policy over replica lineages (fleet-clock only)."""
+
+    def __init__(self, config: SupervisionConfig):
+        self.config = config
+        self._lineages: Dict[int, dict] = {}
+        self._next_lid = 0
+        self.restarts_scheduled = 0
+        self.retired_total = 0
+
+    # -- lineage lifecycle -------------------------------------------------
+    def register(self, role: str) -> int:
+        """Admit one logical fleet member; returns its lineage id."""
+        lid = self._next_lid
+        self._next_lid += 1
+        self._lineages[lid] = {"role": role, "crashes": [], "restarts": 0,
+                               "retired": False, "due": None}
+        return lid
+
+    def deregister(self, lid: Optional[int]):
+        """Forget a lineage the fleet retired ON PURPOSE (autoscaler
+        drain, close()) — not a crash, never a respawn."""
+        if lid is not None:
+            self._lineages.pop(lid, None)
+
+    # -- verdicts ----------------------------------------------------------
+    def on_death(self, lid: int, step: int) -> str:
+        """Record one incarnation death at fleet step ``step`` and
+        decide: ``"restart"`` (a respawn is due after backoff) or
+        ``"retired"`` (crash loop — the lineage is done)."""
+        rec = self._lineages[lid]
+        if rec["retired"]:
+            return "retired"
+        # the sliding crash-loop window: only deaths newer than
+        # crash_window_steps count against max_restarts
+        rec["crashes"] = [s for s in rec["crashes"]
+                          if step - s < self.config.crash_window_steps]
+        rec["crashes"].append(step)
+        if len(rec["crashes"]) > self.config.max_restarts:
+            rec["retired"] = True
+            rec["due"] = None
+            self.retired_total += 1
+            return "retired"
+        # backoff escalates with the IN-WINDOW crash count, so an
+        # isolated crash long after the last one restarts at the base
+        # delay again — only a tightening loop earns the long waits
+        # (rec["restarts"] stays as lifetime telemetry)
+        delay = self.config.restart_delay_steps(len(rec["crashes"]) - 1)
+        rec["restarts"] += 1
+        rec["due"] = step + delay
+        self.restarts_scheduled += 1
+        return "restart"
+
+    def take_due(self, step: int) -> List[Tuple[int, str]]:
+        """Pop every lineage whose backoff has elapsed at ``step`` —
+        ``[(lineage_id, role)]`` in lineage order. The caller spawns
+        them; a spawn that fails reports back via ``on_death``."""
+        out = []
+        for lid in sorted(self._lineages):
+            rec = self._lineages[lid]
+            if rec["due"] is not None and step >= rec["due"] \
+                    and not rec["retired"]:
+                rec["due"] = None
+                out.append((lid, rec["role"]))
+        return out
+
+    def pending(self, roles=None) -> bool:
+        """True when at least one restart is scheduled (optionally for
+        one of ``roles``) — what keeps an all-dead fleet waiting on its
+        backoff clock instead of declaring total loss."""
+        return any(rec["due"] is not None and not rec["retired"]
+                   and (roles is None or rec["role"] in roles)
+                   for rec in self._lineages.values())
+
+    def snapshot(self) -> dict:
+        """JSON-able policy state for /statusz and the chaos report."""
+        return {
+            "enabled": self.config.enabled,
+            "restarts_scheduled": self.restarts_scheduled,
+            "retired_total": self.retired_total,
+            "lineages": {
+                str(lid): {"role": rec["role"],
+                           "restarts": rec["restarts"],
+                           "recent_crashes": len(rec["crashes"]),
+                           "retired": rec["retired"],
+                           "restart_due_step": rec["due"]}
+                for lid, rec in sorted(self._lineages.items())},
+        }

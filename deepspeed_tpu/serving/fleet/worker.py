@@ -24,7 +24,10 @@ scrape client (observability/export.py) reads.
 
 import base64
 import json
+import os
+import signal
 import sys
+import time
 
 import numpy as np
 
@@ -33,7 +36,9 @@ from .replica import PROTOCOL_SENTINEL, engine_stats
 
 
 def _reply(msg: dict):
-    sys.stdout.write(PROTOCOL_SENTINEL + json.dumps(msg) + "\n")
+    # default=float: metrics snapshots carry numpy scalars
+    sys.stdout.write(PROTOCOL_SENTINEL + json.dumps(msg, default=float)
+                     + "\n")
     sys.stdout.flush()
 
 
@@ -79,8 +84,34 @@ class _Worker:
         self._admit_reported = set() # ids whose first admission went out
         self._events = []            # [[id, token, engine iteration]]
         self._staged = {}            # id -> (slot, req) awaiting export
+        # deterministic chaos hooks (the fleet scenario pack's vehicle):
+        # {"hang_at_advance": N, "hang_s": S} wedges op_advance at engine
+        # iteration N — the parent's reply timeout must contain it
+        chaos = dict(spec.get("chaos") or {})
+        self._hang_at = chaos.get("hang_at_advance")
+        self._hang_s = float(chaos.get("hang_s", 600.0))
+        # PR-4 preemption parity (runtime/resilience/preemption.py): a
+        # supervised teardown (SIGTERM from the parent's kill path or
+        # the orchestrator) ships this worker's partial metrics snapshot
+        # up the pipe before the default termination runs — a killed
+        # replica's work must not vanish without a trace
+        signal.signal(signal.SIGTERM, self._on_sigterm)
         _reply({"op": "ready", "replica_id": self.replica_id,
                 "telemetry_port": telemetry_port})
+
+    def _on_sigterm(self, signum, frame):
+        try:
+            _reply({"op": "partial_metrics",
+                    "replica_id": self.replica_id,
+                    "reason": f"signal {signum}",
+                    "iteration": self.engine.iteration,
+                    "metrics": self.engine.metrics.snapshot()})
+        finally:
+            # chain to the default action so termination semantics are
+            # exactly what the parent expects (the PreemptionHandler
+            # re-deliver pattern)
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
 
     def _on_token(self, req, token):
         self._events.append([req.request_id, int(token),
@@ -123,6 +154,10 @@ class _Worker:
         return sorted(out, key=str)
 
     def op_advance(self, msg):
+        if self._hang_at is not None \
+                and self.engine.iteration >= self._hang_at:
+            time.sleep(self._hang_s)   # chaos: a wedged worker — the
+                                       # parent's reply timeout fires
         self.engine.advance()
         for slot, req in self.engine.take_handoff_ready():
             self._staged[req.request_id] = (slot, req)

@@ -188,13 +188,14 @@ def test_serve_fleet_summary_line(tmp_path):
 
 
 @pytest.mark.slow
-def test_serve_fleet_replica_crash_partial_snapshot(tmp_path):
-    """An injected in-process replica crash is fatal by design (shared
-    process state): nonzero exit AND the partial fleet snapshot —
-    stdout JSON + sidecar — recording which replica died."""
+def test_serve_fleet_replica_crash_unsupervised_partial_snapshot(tmp_path):
+    """With --no-supervise an injected in-process replica crash is
+    fatal (the pre-supervision contract): nonzero exit AND the partial
+    fleet snapshot — stdout JSON + sidecar — recording which replica
+    died."""
     out = tmp_path / "fleet.json"
     r = _run([os.path.join(BIN, "ds_tpu_serve"), "--synthetic", "4",
-              "--replicas", "2", *FLEET_ARGS,
+              "--replicas", "2", *FLEET_ARGS, "--no-supervise",
               "--inject-replica-crash-at", "1",
               "--metrics-out", str(out)], timeout=300)
     assert r.returncode != 0
@@ -202,6 +203,41 @@ def test_serve_fleet_replica_crash_partial_snapshot(tmp_path):
     assert artifact["failed"] is True
     assert "crashed at iteration" in artifact["reason"]
     assert artifact["serving"]["replicas"]["1"]["alive"] is False
+
+
+@pytest.mark.slow
+def test_serve_fleet_replica_crash_supervised_recovers(tmp_path):
+    """Default (supervised) semantics: the SAME injected crash is
+    contained — failover finishes the workload, exit 0, and the
+    summary/snapshot record the death (and the restart when the
+    backoff elapses before the run drains)."""
+    out = tmp_path / "fleet.json"
+    r = _run([os.path.join(BIN, "ds_tpu_serve"), "--synthetic", "4",
+              "--replicas", "2", *FLEET_ARGS,
+              "--inject-replica-crash-at", "1",
+              "--metrics-out", str(out)], timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    fleet_lines = [l for l in r.stdout.splitlines()
+                   if l.startswith("fleet: ")]
+    assert fleet_lines and "4/4 finished" in fleet_lines[0]
+    assert "dead=1" in fleet_lines[0]
+    snap = json.loads(out.read_text())
+    assert snap["requests_finished"] == 4
+    assert snap["dead_replicas"] == 1
+
+
+@pytest.mark.slow
+def test_chaos_fleet_scenario_pack():
+    """The seeded fleet chaos pack (worker kill, crash loop, prefill
+    wipe, truncated handoff, hung worker) recovers end to end: exit 0
+    and every sub-scenario reports ok."""
+    r = _run([os.path.join(BIN, "ds_tpu_chaos"), "--scenario", "fleet"],
+             timeout=570)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-800:])
+    assert "[chaos] all scenarios recovered" in r.stdout
+    for sub in ("crash_loop", "prefill_wipe", "truncated_handoff",
+                "worker_kill", "hung_worker"):
+        assert f"fleet/{sub}: RECOVERED" in r.stdout
 
 
 @pytest.mark.slow

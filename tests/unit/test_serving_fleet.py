@@ -357,7 +357,8 @@ class TestDisaggregatedHandoff:
                    for i, pr in enumerate(prompts)]
         while fleet.busy:
             fleet.advance()
-            for payload, _h in list(fleet._handoff_backlog):
+            for ent in list(fleet._handoff_backlog):
+                payload = ent["payload"]
                 assert payload["kv_quant"] == "int8"
                 assert any("key_scale" in rec for rec in payload["kv"])
                 assert any(rec[k].dtype == np.int8
@@ -455,9 +456,13 @@ class TestDeterminismAndFailover:
         fleet.close()
 
     def test_all_replicas_dead_raises_instead_of_spinning(self):
+        """With supervision OFF nothing ever respawns, so total loss
+        must raise (the supervised fleet instead parks the work and
+        restarts — tests/unit/test_fleet_supervision.py)."""
         m, p = _model(vocab=157)
-        fleet = ServingFleet(m, p, _cfg(FleetConfig(replicas=2),
-                                        num_slots=2))
+        fleet = ServingFleet(m, p, _cfg(
+            FleetConfig(replicas=2, supervision={"enabled": False}),
+            num_slots=2))
         fleet.submit(np.arange(1, 9), max_new_tokens=64, request_id="x")
         fleet.kill_replica(0)
         fleet.kill_replica(1)
