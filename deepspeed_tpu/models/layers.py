@@ -379,15 +379,12 @@ class SelfAttention(nn.Module):
                 if idx.ndim == 1:
                     # Per-row cache index ([b] vector — the serving slot
                     # batch / ragged-prompt decode): every row appends its
-                    # token at its OWN length. Only the single-token
-                    # kernel hot path supports ragged rows — prefill and
-                    # masked chunks stay on the shared-scalar path.
-                    if s != 1:
-                        raise NotImplementedError(
-                            "per-row cache_index requires single-token "
-                            f"decode (got chunk length {s}); prefill each "
-                            "row with a scalar index, then set the per-row "
-                            "lengths")
+                    # s tokens at its OWN length. s == 1 is the kernel hot
+                    # path; s > 1 is the ragged multi-token step the
+                    # speculative verification program drives (each row's
+                    # candidate block lands at its own frontier, attention
+                    # masked per row below) — prefill and masked chunks
+                    # stay on the shared-scalar path.
                     if mask is not None or self.sparsity_config is not None \
                             or (self.dropout_rate > 0.0 and not deterministic):
                         raise NotImplementedError(
@@ -395,6 +392,13 @@ class SelfAttention(nn.Module):
                             "external masks, block-sparse patterns, or live "
                             "attention dropout (the dense cache path is "
                             "shared-scalar only)")
+                    if s != 1 and self.alibi:
+                        raise NotImplementedError(
+                            "per-row multi-token decode (speculative "
+                            "verification) does not support ALiBi — the "
+                            "shared additive bias cannot express per-row "
+                            "positions; serve ALiBi models without "
+                            "serving.speculation")
                     row_update = jax.vmap(
                         lambda c, u, i: jax.lax.dynamic_update_slice(
                             c, u, (0, 0, i)))
@@ -467,9 +471,17 @@ class SelfAttention(nn.Module):
                     # (query row i = global pos idx+i attends slots <= it)
                     k = k_all.transpose(0, 3, 1, 2)      # [b, s, h, d]
                     v = v_all.transpose(0, 3, 1, 2)
-                    rows = idx + jnp.arange(s)[:, None]
-                    cols = jnp.arange(max_len)[None, :]
-                    cache_mask = (cols <= rows)[None, None, :, :]
+                    if idx.ndim == 1:
+                        # ragged multi-token decode (speculative verify):
+                        # batch row b's query i sits at global position
+                        # idx[b]+i, so the validity mask is per-row
+                        rows = idx[:, None] + jnp.arange(s)[None, :]
+                        cache_mask = (jnp.arange(max_len)[None, None, None, :]
+                                      <= rows[:, None, :, None])
+                    else:
+                        rows = idx + jnp.arange(s)[:, None]
+                        cols = jnp.arange(max_len)[None, :]
+                        cache_mask = (cols <= rows)[None, None, :, :]
                     if mask is not None and mask.shape[-1] != max_len:
                         # caller's mask covers only the current chunk:
                         # scatter it into cache key space at the offset.

@@ -5,12 +5,13 @@ jax — ``runtime/config.py`` pulls ``ServingConfig`` into the top-level
 config schema, and that path must work in dependency-free tooling jobs.
 """
 
-from .config import QuantizeConfig, ServingConfig
+from .config import QuantizeConfig, ServingConfig, SpeculationConfig
 from .fleet.config import FleetConfig
 from .paging.config import PagingConfig
 from .qos import QosClass, QosConfig, QosController
 
-__all__ = ["ServingConfig", "PagingConfig", "QuantizeConfig", "QosClass",
+__all__ = ["ServingConfig", "PagingConfig", "QuantizeConfig",
+           "SpeculationConfig", "QosClass",
            "QosConfig", "QosController", "ServingEngine", "Request",
            "FifoScheduler", "ServingMetrics", "PagedKVManager",
            "FleetConfig", "ServingFleet", "FleetRequest"]

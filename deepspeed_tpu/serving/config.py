@@ -59,6 +59,56 @@ class QuantizeConfig:
 
 
 @dataclass
+class SpeculationConfig:
+    """Token-exact self-speculative decoding (the ``serving.speculation``
+    sub-block, docs/serving.md "Speculative decoding").
+
+    Draft-free prompt-lookup speculation on the deterministic step
+    clock: a host-side n-gram proposer (serving/speculation.py) matches
+    the tail of each slot's ``prompt + generated`` sequence against its
+    own history and proposes up to ``max_spec_tokens`` continuation
+    tokens per iteration; ONE batched verification program checks all
+    proposals in a single multi-token decode step and accepts the
+    longest prefix agreeing with greedy argmax — so accepted iterations
+    emit k+1 tokens for roughly the cost of one decode dispatch, and
+    the output stays bitwise identical to the non-speculative engine.
+
+    Greedy-only by construction: the acceptance rule IS greedy argmax,
+    so ``validate`` refuses the block on a sampling engine
+    (temperature > 0) rather than silently changing the distribution.
+    """
+    enabled: bool = True
+    max_spec_tokens: int = 4         # k: proposal budget per slot per
+                                     # iteration (the QoS ladder sheds
+                                     # this to 0 under pressure — before
+                                     # any request sheds)
+    ngram_max: int = 3               # longest tail n-gram the proposer
+                                     # tries to match (longest first)
+    ngram_min: int = 1               # shortest n-gram worth matching
+
+    def validate(self, temperature: float) -> "SpeculationConfig":
+        if self.max_spec_tokens < 1:
+            raise ValueError(
+                f"serving.speculation.max_spec_tokens must be >= 1, got "
+                f"{self.max_spec_tokens}")
+        if self.ngram_min < 1:
+            raise ValueError(
+                f"serving.speculation.ngram_min must be >= 1, got "
+                f"{self.ngram_min}")
+        if self.ngram_max < self.ngram_min:
+            raise ValueError(
+                f"serving.speculation.ngram_max ({self.ngram_max}) must "
+                f"be >= ngram_min ({self.ngram_min})")
+        if self.enabled and temperature != 0.0:
+            raise ValueError(
+                "serving.speculation requires greedy sampling "
+                f"(temperature=0.0, got {temperature}): the acceptance "
+                "rule is greedy argmax, and speculating under a sampling "
+                "engine would silently change the output distribution")
+        return self
+
+
+@dataclass
 class ServingConfig:
     """Continuous-batching serving knobs (reference analog: the
     init_inference kwargs + DeepSpeed-MII deployment config).
@@ -114,6 +164,13 @@ class ServingConfig:
                                      # fleet"): replica manager + prefix-
                                      # affinity router + disaggregated
                                      # prefill/decode; absent = one engine
+    speculation: Optional[SpeculationConfig] = None
+                                     # token-exact self-speculative decode
+                                     # (serving/speculation.py, docs/
+                                     # serving.md "Speculative decoding");
+                                     # absent or enabled=False keeps the
+                                     # one-token-per-step decode loop
+                                     # untouched
 
     def __post_init__(self):
         # nested-block plumbing: runtime/config.py's dict_to_dataclass is
@@ -126,6 +183,8 @@ class ServingConfig:
             self.quantize = QuantizeConfig(**self.quantize)
         if isinstance(self.fleet, dict):
             self.fleet = FleetConfig(**self.fleet)
+        if isinstance(self.speculation, dict):
+            self.speculation = SpeculationConfig(**self.speculation)
 
     def validate(self):
         if self.num_slots < 1:
@@ -165,6 +224,8 @@ class ServingConfig:
             self.quantize.validate(self.paged)
         if self.fleet is not None:
             self.fleet.validate(self)
+        if self.speculation is not None:
+            self.speculation.validate(self.temperature)
         return self
 
     @property
@@ -193,10 +254,25 @@ class ServingConfig:
         return self.fleet is not None and self.fleet.enabled
 
     @property
+    def spec_enabled(self) -> bool:
+        """True when self-speculative decoding is configured AND enabled."""
+        return self.speculation is not None and self.speculation.enabled
+
+    @property
     def cache_len(self) -> int:
         """Slot capacity rounded up to a 128 multiple so the Pallas decode
-        kernel's tiling always applies (generation.py convention)."""
-        return (self.max_len + 127) // 128 * 128
+        kernel's tiling always applies (generation.py convention).
+
+        With speculation enabled the capacity also covers
+        ``max_spec_tokens`` of write headroom past ``max_len``: the
+        verification step writes k+1 candidate tokens at each slot's
+        frontier BEFORE acceptance decides how many are real, and the
+        headroom guarantees those writes never clamp backwards into a
+        live slot's valid prefix (an active slot holds at most
+        ``max_len - 2`` tokens, so ``max_len + k`` positions always fit
+        the k+1-token window)."""
+        pad = self.speculation.max_spec_tokens if self.spec_enabled else 0
+        return (self.max_len + pad + 127) // 128 * 128
 
     def bucket_lengths(self) -> Tuple[int, ...]:
         """The fixed prefill-length set: multiples of ``prefill_bucket``

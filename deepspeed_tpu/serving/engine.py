@@ -80,6 +80,7 @@ from .request import PREEMPTED, Request
 from .scheduler import FifoScheduler
 from .metrics import ServingMetrics
 from .paging.manager import _chunk_prefill_jit, _paged_decode_jit
+from .speculation import NgramProposer, _spec_verify_jit
 
 
 def _admit_impl(module, params, cache, state, prompt, prompt_len, slot,
@@ -262,6 +263,12 @@ class ServingEngine:
         # the block is absent — the FIFO engine runs untouched.
         self._qos = (QosController(self.config.qos)
                      if self.config.qos_enabled else None)
+        # self-speculative decode plane (serving/speculation.py): the
+        # host n-gram proposer + ONE batched verification program. None
+        # when the block is absent/disabled — the one-token decode loop
+        # runs untouched, bit-identical to the pre-speculation engine.
+        self._spec = (NgramProposer(self.config.speculation)
+                      if self.config.spec_enabled else None)
         self._slot_cap = n                # admissible slots (autoscaling
                                           # drains above the cap via the
                                           # preemption path; compiled
@@ -1024,6 +1031,12 @@ class ServingEngine:
     def _dispatch_decode(self) -> bool:
         if all(r is None for r in self._slot_req):
             return False
+        if self._spec is not None:
+            proposals = self._collect_proposals()
+            if proposals is not None:
+                return self._dispatch_spec_verify(*proposals)
+            if all(r is None for r in self._slot_req):
+                return False    # the proposal drain finished every slot
         greedy, has_k, has_p, t, k, p = self._mode
         snapshot = list(self._slot_req)
         busy = sum(r is not None for r in snapshot)
@@ -1047,6 +1060,90 @@ class ServingEngine:
                     self._param_transform, greedy, has_k, has_p)
         self.metrics.on_decode_dispatch(busy, self.config.num_slots)
         self._pending.append(("decode", snapshot, toks, done))
+        self._iteration += 1
+        return True
+
+    # -- self-speculative decoding (serving/speculation.py) ----------------
+    def _collect_proposals(self):
+        """This iteration's host-side speculation proposals: ``(props
+        [slots, K], counts [slots])`` numpy arrays, or None when no slot
+        proposes — the iteration then rides the existing one-token
+        decode program untouched. Drains in-flight readbacks first (the
+        proposer matches against each slot's CURRENT prompt+generated
+        frontier, which pipelining lags by ``pipeline_depth`` tokens) —
+        the latency price of draft-free self-speculation, paid only on
+        iterations that actually propose."""
+        kmax = self.config.speculation.max_spec_tokens
+        if self._qos is not None:
+            # the first rung of the degradation ladder: speculation
+            # sheds from the FIRST overloaded iteration — strictly
+            # before any request does
+            kmax = self._qos.max_spec_tokens(kmax)
+        if kmax <= 0 or not self._mode[0]:     # shed, or non-greedy
+            return None
+        if not any(r is not None and not r.done and r.tokens
+                   for r in self._slot_req):
+            return None
+        while self._pending:
+            self._harvest_one()
+        n = self.config.num_slots
+        width = self.config.speculation.max_spec_tokens
+        props = np.zeros((n, width), np.int32)
+        counts = np.zeros((n,), np.int32)
+        with _span("serving/spec_propose", {"iteration": self._iteration}):
+            for slot, req in enumerate(self._slot_req):
+                # proposable: running with its first token already
+                # harvested (mid-chunked-prefill slots have none) and
+                # at least 2 tokens of budget left (with 1 remaining a
+                # plain decode already finishes the request)
+                if req is None or req.done or not req.tokens:
+                    continue
+                budget = min(kmax, req.remaining_budget() - 1)
+                if budget <= 0:
+                    continue
+                seq = np.concatenate([np.asarray(req.prompt, np.int32),
+                                      np.asarray(req.tokens, np.int32)])
+                got = self._spec.propose(seq, budget)
+                if got.shape[0]:
+                    props[slot, :got.shape[0]] = got
+                    counts[slot] = got.shape[0]
+        if not counts.any():
+            return None
+        return props, counts
+
+    def _dispatch_spec_verify(self, props, counts) -> bool:
+        """Dispatch the ONE batched verification program over the slot
+        batch: every proposing slot's ``[last_token, proposals]`` block
+        runs one multi-token decode step at its own frontier;
+        non-proposing slots ride along masked (``counts == 0`` accepts
+        zero proposals, emitting exactly the one token a plain decode
+        step would). Counts as one decode iteration on the step clock —
+        TTFT/steps percentiles stay iteration-denominated while token
+        counters take the full emitted count at harvest."""
+        greedy, has_k, has_p, t, k, p = self._mode
+        snapshot = list(self._slot_req)
+        busy = sum(r is not None for r in snapshot)
+        rng = jax.random.fold_in(self._rng, 2**31)
+        with _span("serving/spec_verify",
+                   {"active_requests": busy, "iteration": self._iteration,
+                    "proposed_tokens": int(counts.sum())}), \
+                _goodput("compute"):
+            if self._paged is not None:
+                mgr = self._paged
+                mgr.pool, self._state, toks, done = _spec_verify_jit(
+                    self.module, self.params, mgr.pool, mgr.page_table,
+                    self._state, jnp.asarray(props), jnp.asarray(counts),
+                    rng, jnp.int32(self._iteration), self._eos, t, k, p,
+                    self._param_transform, greedy, has_k, has_p,
+                    mgr.dequant_dtype)
+            else:
+                self._cache, self._state, toks, done = _spec_verify_jit(
+                    self.module, self.params, self._cache, None,
+                    self._state, jnp.asarray(props), jnp.asarray(counts),
+                    rng, jnp.int32(self._iteration), self._eos, t, k, p,
+                    self._param_transform, greedy, has_k, has_p, None)
+        self.metrics.on_decode_dispatch(busy, self.config.num_slots)
+        self._pending.append(("spec", snapshot, toks, done, counts))
         self._iteration += 1
         return True
 
@@ -1083,6 +1180,33 @@ class ServingEngine:
                         "remaining": self._state["remaining"].at[slot].set(0),
                     }
                     self._handoff_ready.append((slot, req))
+                return
+            if entry[0] == "spec":
+                # speculative verification readback: toks is
+                # [slots, K+1] with position i >= 0 iff emitted — the
+                # accepted proposal prefix plus the bonus token, in
+                # order. Token counters take the EMITTED count (k+1 per
+                # accepted step); the iteration clock already ticked
+                # exactly once at dispatch.
+                _, snapshot, toks, done, counts = entry
+                toks = np.asarray(toks)
+                done = np.asarray(done)
+                for slot, req in enumerate(snapshot):
+                    if req is None or req.done:
+                        continue
+                    emitted = 0
+                    for i in range(toks.shape[1]):
+                        if toks[slot, i] < 0:
+                            break
+                        req._emit(int(toks[slot, i]), self._iteration)
+                        emitted += 1
+                    if emitted:
+                        self.metrics.on_token(emitted)
+                        if counts[slot]:
+                            self.metrics.on_spec(int(counts[slot]),
+                                                 emitted - 1)
+                    if done[slot]:
+                        self._finish(slot, req)
                 return
             _, snapshot, toks, done = entry
             toks = np.asarray(toks)

@@ -102,6 +102,12 @@ class ServingMetrics:
         self.tokens_generated = 0
         self.prefills = 0
         self.decode_iterations = 0
+        # speculative decoding (serving/speculation.py): token-level
+        # proposer outcomes — proposed = entered verification,
+        # accepted = emitted to the request, rejected = rolled back
+        self.spec_proposed_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_rejected_tokens = 0
         self.wasted_slot_steps = 0     # inactive slots carried through decode
         # paged mode: the prefill-FLOPs ledger — computed counts prompt
         # tokens that actually ran through a prefill program (chunked),
@@ -199,8 +205,30 @@ class ServingMetrics:
         self.decode_iterations += 1
         self.wasted_slot_steps += num_slots - busy_slots
 
-    def on_token(self):
-        self.tokens_generated += 1
+    def on_token(self, n: int = 1):
+        """``n`` EMITTED tokens streamed to requests. With speculation
+        an accepted verification step emits several tokens in one
+        decode iteration, so token counters and throughput take the
+        emitted count — ``decode_iterations`` (and every ``*_steps``
+        percentile) stays iteration-denominated; their ratio is the
+        speculation speedup."""
+        self.tokens_generated += n
+
+    def on_spec(self, proposed: int, accepted: int):
+        """One slot's speculation outcome at harvest: ``proposed``
+        tokens went into the verification step, ``accepted`` of them
+        were emitted (the bonus token is NOT counted here — acceptance
+        rate measures the proposer, not the free argmax). Mirrored into
+        the shared registry so /metrics and /statusz carry the
+        ``spec/*`` series without a snapshot call."""
+        self.spec_proposed_tokens += proposed
+        self.spec_accepted_tokens += accepted
+        self.spec_rejected_tokens += proposed - accepted
+        if self.registry is not None:
+            self.registry.counter("spec/proposed_tokens").inc(proposed)
+            self.registry.counter("spec/accepted_tokens").inc(accepted)
+            self.registry.counter("spec/rejected_tokens").inc(
+                proposed - accepted)
 
     def on_timeout(self, request):
         self.requests_timed_out += 1
@@ -427,6 +455,16 @@ class ServingMetrics:
                                     if self.samples else 0.0),
             "concurrent_requests_peak": self.busy_slots_max,
         }
+        if self.spec_proposed_tokens:
+            out["spec_proposed_tokens"] = self.spec_proposed_tokens
+            out["spec_accepted_tokens"] = self.spec_accepted_tokens
+            out["spec_rejected_tokens"] = self.spec_rejected_tokens
+            out["spec_acceptance_rate"] = (self.spec_accepted_tokens
+                                           / self.spec_proposed_tokens)
+            # emitted tokens per decode dispatch — the speculation
+            # speedup figure (1.0 = the non-speculative engine)
+            out["tokens_per_decode_iteration"] = (
+                self.tokens_generated / max(1, self.decode_iterations))
         if self.handoffs_exported or self.handoffs_imported:
             out["handoffs_exported"] = self.handoffs_exported
             out["handoffs_imported"] = self.handoffs_imported

@@ -332,6 +332,20 @@ class QosController:
             return min(configured, self.config.degraded_max_chunks_per_iter)
         return configured
 
+    def max_spec_tokens(self, configured: int) -> int:
+        """The effective speculation budget at the current pressure: 0 —
+        speculation fully shed — from the FIRST overloaded iteration
+        (``observe`` saw an overload signal this step) or while the
+        ladder sits at any shedding level. Escalation to request
+        shedding needs ``ladder_patience_steps`` CONSECUTIVE overloaded
+        iterations, so speculation is always the first thing to go and
+        the last to come back — strictly before any request sheds.
+        Pure streak/level arithmetic on the step clock: the shed
+        sequence replays bit-exactly."""
+        if self._overload_streak >= 1 or self.level >= LEVEL_SHED:
+            return 0
+        return configured
+
     def snapshot(self) -> dict:
         """JSON-able controller state (the /statusz qos section)."""
         return {
@@ -339,5 +353,7 @@ class QosController:
             "level_name": self.level_name,
             "overload_streak": self._overload_streak,
             "healthy_streak": self._healthy_streak,
+            "speculation_shed": bool(self._overload_streak >= 1
+                                     or self.level >= LEVEL_SHED),
             "level_changes": list(self.level_changes),
         }

@@ -17,6 +17,7 @@ REPO = Path(__file__).resolve().parents[2]
 
 
 @pytest.mark.real_data
+@pytest.mark.slow
 def test_tiny_gpt_converges_on_real_corpus_with_engine_optax_parity():
     r = subprocess.run(
         [sys.executable, str(REPO / "tests/model/run_convergence.py"),

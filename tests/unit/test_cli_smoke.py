@@ -105,6 +105,7 @@ def test_serve_synthetic_demo(tmp_path):
     assert snap["tokens_generated"] >= 3
 
 
+@pytest.mark.slow
 def test_serve_metrics_port_endpoint(tmp_path):
     """--metrics-port: the serving CLI announces its live telemetry
     endpoint and still completes the workload (the endpoint itself is
@@ -122,6 +123,7 @@ def test_serve_metrics_port_endpoint(tmp_path):
     assert telemetry[0].endswith("/metrics")
 
 
+@pytest.mark.slow
 def test_serve_qos_smoke(tmp_path):
     """A qos-enabled serve run completes and announces the shed/preempt
     counters plus the per-class breakdown on stdout (the operator-facing
@@ -141,6 +143,7 @@ def test_serve_qos_smoke(tmp_path):
     assert any(k.startswith("class/") for k in snap)
 
 
+@pytest.mark.slow
 def test_serve_crash_leaves_partial_snapshot_and_exits_nonzero(tmp_path):
     """The fault-containment satellite: a serving loop that dies mid-run
     (chaos hook --inject-crash-at) exits NONZERO and still leaves the
@@ -356,6 +359,7 @@ def test_serve_fleet_trace_out_stitched(tmp_path):
     assert tagged, "spans must carry trace ids"
 
 
+@pytest.mark.slow
 def test_chaos_smoke_torn_scenario(tmp_path):
     """Fast chaos smoke (tier-1): the torn-save scenario must recover —
     the CLI exits 0 only when the fallback restored a verified tag —
@@ -369,6 +373,7 @@ def test_chaos_smoke_torn_scenario(tmp_path):
     assert report["fallback_path"].endswith("good")
 
 
+@pytest.mark.slow
 def test_bench_serving_writes_artifact(tmp_path):
     """`ds_tpu_bench serving` replays the seeded trace and writes the
     BENCH_serving JSON artifact."""
@@ -388,6 +393,7 @@ def test_bench_serving_writes_artifact(tmp_path):
     assert all(p["ttft_steps"] is not None for p in art["per_request"])
 
 
+@pytest.mark.slow
 def test_bench_serving_paged_prefix_adversarial(tmp_path):
     """`ds_tpu_bench serving --paged --scenario prefix-adversarial`: the
     paged engine serves the shared-prefix + long-prompt trace and the
@@ -426,6 +432,7 @@ def test_bench_serving_paged_prefix_adversarial(tmp_path):
     assert "serving/kv_pool" in mem["by_subsystem"]
 
 
+@pytest.mark.slow
 def test_trace_windowed_capture(tmp_path):
     """`ds_tpu_trace` runs a short training loop and writes a valid
     Chrome-trace JSON (windowed capture) + the metrics snapshot."""
@@ -458,6 +465,7 @@ def test_trace_windowed_capture(tmp_path):
     assert snap["programs"]["train/fwd_grads"]["compiles"] == 1
 
 
+@pytest.mark.slow
 def test_trace_memory_sections(tmp_path):
     """`ds_tpu_trace --memory` prints the ds_tpu_mem attribution +
     compiled-program tables with per-program XLA analysis."""
@@ -474,6 +482,7 @@ def test_trace_memory_sections(tmp_path):
     assert "train/train_step" in r.stdout
 
 
+@pytest.mark.slow
 def test_bench_trace_attaches_capture(tmp_path):
     """`ds_tpu_bench serving --trace` attaches the span capture to the
     bench run and dumps serving-phase spans as Chrome-trace JSON."""

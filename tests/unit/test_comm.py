@@ -138,6 +138,7 @@ class TestInt8CompressedAllreduce:
         assert np.abs(got - want).max() < 0.05, np.abs(got - want).max()
         np.testing.assert_allclose(got[0], got[3], atol=1e-6)  # agreed
 
+    @pytest.mark.slow
     def test_error_feedback_compensates(self):
         """Accumulating T compressed means of the SAME tensor with error
         carry converges on T * exact mean (bias dies), unlike carrying

@@ -435,6 +435,7 @@ report_result(samples_per_sec=cfg["train_batch_size"] / t, step_ms=t * 1e3)
         at_path.write_text(json.dumps(at))
         return script, at_path
 
+    @pytest.mark.slow
     def test_cli_tunes_stage_micro_gas_with_crash_isolation(self, tmp_path):
         import json, os
         from deepspeed_tpu.launcher.runner import main
@@ -502,6 +503,7 @@ class TestActivationQuantization:
         g = jax.grad(lambda x: fake_quantize_activation(x, bits=4).sum())(x)
         np.testing.assert_array_equal(np.asarray(g), np.ones_like(g))
 
+    @pytest.mark.slow
     def test_engine_toggles_at_schedule_offset(self):
         """Losses are UNCHANGED before schedule_offset and CHANGE once
         activation quantization kicks in (recompiled forward)."""

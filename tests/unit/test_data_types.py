@@ -47,6 +47,7 @@ def test_config_parse_and_validation():
              "data_types": {"grad_accum_dtype": "int8"}}).data_types.resolve()
 
 
+@pytest.mark.slow
 def test_bf16_accum_trajectory_close_to_fp32():
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(0, 128, size=(32, 16), dtype=np.int32)}

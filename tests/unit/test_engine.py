@@ -88,6 +88,7 @@ def _init_kwargs_engine(stage, extra=None, mesh_cfg=None):
 
 
 @pytest.mark.parametrize("stage", [0, 1, 2, 3])
+@pytest.mark.slow
 def test_zero_stage_matches_baseline(stage, golden):
     engine = _init_kwargs_engine(stage)
     losses = [float(engine.train_batch(make_batch(16, seed=s)))
@@ -148,6 +149,7 @@ def test_forward_backward_step_api(golden):
     np.testing.assert_allclose(losses, golden, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_fp16_loss_scaling_runs():
     mc = GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ, d_model=32, n_layers=2,
                    n_heads=4, dtype=jnp.float16, scan_layers=True)
@@ -161,6 +163,7 @@ def test_fp16_loss_scaling_runs():
     assert engine.get_loss_scale() == 2.0 ** 8
 
 
+@pytest.mark.slow
 def test_load_module_only_and_skip_optimizer(tmp_path):
     """r5 review (verified against orbax 0.11): restore templates that
     differ from the saved structure crashed — load_module_only=True and
@@ -185,6 +188,7 @@ def test_load_module_only_and_skip_optimizer(tmp_path):
     assert np.isfinite(float(e3.train_batch(make_batch(16, seed=1))))
 
 
+@pytest.mark.slow
 def test_fp16_parity_api_scales_and_unscales():
     """r5 core review: the forward()/backward()/step() convention must
     apply the SAME fp16 loss scaling as the fused path — grads of the
@@ -219,6 +223,7 @@ def test_fp16_parity_api_scales_and_unscales():
     assert scale_f == scale_p == 2.0 ** 8
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip(tmp_path):
     engine = _init_kwargs_engine(1)
     engine.train_batch(make_batch(16, seed=0))
@@ -235,6 +240,7 @@ def test_checkpoint_roundtrip(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_async_checkpoint_save(tmp_path):
     """async_save=True: training continues during the background write;
     the latest tag is only published once the save is durable (at
@@ -265,6 +271,7 @@ def test_async_checkpoint_save(tmp_path):
     engine.destroy()                                 # idempotent
 
 
+@pytest.mark.slow
 def test_chunked_loss_matches_full():
     """gpt_chunked_loss_fn == gpt_loss_fn on full logits (values AND
     grads) — the bench's memory-efficient path must be exact."""
@@ -327,6 +334,7 @@ class TestCrossTopologyRestore:
 
     @pytest.mark.parametrize("offload", [False, True],
                              ids=["optax", "streamed_offload"])
+    @pytest.mark.slow
     def test_save_dp8_restore_dp4xtp2(self, tmp_path, offload):
         batch = make_batch(8, seed=5)
         a = self._engine({"data": 8}, offload=offload)
@@ -348,6 +356,7 @@ class TestCrossTopologyRestore:
         lb = float(b.train_batch(batch))
         np.testing.assert_allclose(lb, la, rtol=1e-4)
 
+    @pytest.mark.slow
     def test_save_fsdp_restore_data(self, tmp_path):
         batch = make_batch(8, seed=6)
         a = self._engine({"fsdp": 4, "data": 2}, zero_stage=3)
@@ -360,6 +369,7 @@ class TestCrossTopologyRestore:
                                    float(a.eval_batch(batch)), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_save_16bit_model_consolidates_zero3(tmp_path):
     """reference: save_16bit_model (engine.py:3202) +
     _zero3_consolidated_16bit_state_dict (:3132) — full unsharded bf16

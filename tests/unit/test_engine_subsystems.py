@@ -63,6 +63,7 @@ def make_engine(extra=None, lf=loss_fn, model_cfg=MODEL_CFG):
 
 
 class TestCurriculum:
+    @pytest.mark.slow
     def test_seqlen_truncation_reaches_model(self):
         """Difficulty steps 8 -> 16 and the MODEL actually sees the
         truncated sequence (trace-time shape capture)."""
@@ -98,6 +99,7 @@ class TestCurriculum:
 
 
 class TestProgressiveLayerDrop:
+    @pytest.mark.slow
     def test_theta_changes_loss(self):
         """theta < 1 must change the forward pass: engines with and
         without PLD diverge once theta decays (gamma large -> theta ~= 0.5
@@ -150,6 +152,7 @@ class TestCompressionWiring:
                 checked += 1
         assert checked > 0
 
+    @pytest.mark.slow
     def test_moq_bit_annealed_snap(self):
         """quantize_training block drives MoQ from train_batch: weights
         snap to the current bit grid (start 8 bits -> <= 256 levels)."""
@@ -176,6 +179,7 @@ class TestCompressionWiring:
                     checked += 1
         assert checked > 0
 
+    @pytest.mark.slow
     def test_moq_noop_before_16bit_threshold(self):
         """start_bits 16 means no snap until the first drop period."""
         engine = make_engine(extra={"quantize_training": {
@@ -215,6 +219,7 @@ class TestStageMemory:
             engine.params, engine.optimizer_state, scaler, placed, rng, {})
         return lowered.compile().memory_analysis()
 
+    @pytest.mark.slow
     def test_stage2_grad_carry_sharded(self):
         """The grad-accum carry (the dominant scan temp) must be sharded
         in stage 2: per-device temp bytes well below stage 0's replicated
@@ -233,6 +238,7 @@ class TestStageMemory:
         # opt-state arguments shrink from stage 0 -> 1 (ZeRO-1 partition)
         assert m1.argument_size_in_bytes < m0.argument_size_in_bytes
 
+    @pytest.mark.slow
     def test_stage3_params_smaller_than_stage2(self):
         """Stage 3 shards the params themselves: per-device argument
         bytes (params + opt state) must shrink vs stage 2."""
@@ -304,6 +310,7 @@ class TestActivationCheckpointingConfig:
             engine.params, engine.optimizer_state, scaler, placed, rng, {})
         return lowered.compile().memory_analysis()
 
+    @pytest.mark.slow
     def test_partition_activations_changes_compiled_memory(self):
         """partition_activations shards saved residuals' seq dim over the
         TP axis: per-device temp bytes must shrink vs the same remat
@@ -367,6 +374,7 @@ class TestStreamedHostOffload:
         pytest.param(0.01, 0.0, marks=pytest.mark.slow),
         pytest.param(0.0, 1.0, marks=pytest.mark.slow),
     ], ids=["plain", "weight_decay", "clipped"])
+    @pytest.mark.slow
     def test_matches_default_path(self, wd, clip):
         ea, la = self._train(False, wd, clip)
         eb, lb = self._train(True, wd, clip)
@@ -376,6 +384,7 @@ class TestStreamedHostOffload:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-6, atol=2e-6)
 
+    @pytest.mark.slow
     def test_state_structure(self):
         engine, _ = self._train(True, steps=1)
         assert set(engine.optimizer_state.keys()) == {"mu", "nu", "count"}
@@ -414,6 +423,7 @@ class TestParamOffload:
         losses = [float(engine.train_batch(batch)) for _ in range(steps)]
         return engine, losses
 
+    @pytest.mark.slow
     def test_streamed_params_match_resident(self):
         ea, la = self._train(False)
         eb, lb = self._train(True)
@@ -430,6 +440,7 @@ class TestParamOffload:
             make_engine(extra={"zero_optimization": {
                 "stage": 2, "offload_param": {"device": "cpu"}}})
 
+    @pytest.mark.slow
     def test_loss_decreases(self):
         _, losses = self._train(True, steps=5)
         assert losses[-1] < losses[0]
@@ -572,6 +583,7 @@ class TestNoInvoluntaryRemat:
         spec = orule(P(None, "model"), (5, 6), ("layers", "qkv"))
         assert spec == P(None, "model"), spec
 
+    @pytest.mark.slow
     def test_zero3_step_compiles_without_involuntary_remat(self):
         """Compile the data2 x fsdp2 x tp2 zero-3 train step in a
         subprocess and grep its stderr: the SPMD partitioner logs
@@ -670,6 +682,7 @@ class TestLegacyPathZeroGrads:
             f"largest leaf {big.shape} holds {shard_elems} elems/device "
             f"(full size {big.size}, dp={dp})")
 
+    @pytest.mark.slow
     def test_stage2_legacy_step_matches_train_batch(self):
         """Sharded accumulation must not change the math: one gas cycle
         via forward/backward/step produces the same loss trajectory as
@@ -777,6 +790,7 @@ class TestParamNVMeTier:
         # the old degraded-mode warning is gone
         assert not any("no NVMe tier" in r.message for r in caplog.records)
 
+    @pytest.mark.slow
     def test_small_models_skip_per_step_paging(self, tmp_path):
         """Default max_in_cpu (1e9 bytes): a tiny model's params stay in
         host RAM between steps — no SSD round-trip on the hot loop."""
@@ -791,6 +805,7 @@ class TestParamNVMeTier:
         engine.train_batch(make_batch(16, seed=0))
         assert not engine._params_on_disk
 
+    @pytest.mark.slow
     def test_transparent_restore_for_eval_and_checkpoint(self, tmp_path):
         engine, _ = self._train("nvme", tmp_path / "swap", steps=2)
         assert engine._params_on_disk

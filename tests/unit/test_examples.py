@@ -35,12 +35,17 @@ def _run_example(script, n_devices=8, extra_env=None, timeout=600):
 
 
 @pytest.mark.parametrize("script,expect", [
-    ("examples/train_gpt2_zero3.py", "final loss"),
-    ("examples/train_long_context_sp.py", "final loss"),
-    ("examples/train_moe_ep.py", "final loss"),
+    pytest.param("examples/train_gpt2_zero3.py", "final loss",
+                 marks=pytest.mark.slow),
+    pytest.param("examples/train_long_context_sp.py", "final loss",
+                 marks=pytest.mark.slow),
+    pytest.param("examples/train_moe_ep.py", "final loss",
+                 marks=pytest.mark.slow),
     ("examples/train_pipeline.py", "final loss"),
-    ("examples/serve_hf_model.py", "smoke generated ids"),
-    ("examples/autotune_gpt2.py", "AUTOTUNE_RESULT"),
+    pytest.param("examples/serve_hf_model.py", "smoke generated ids",
+                 marks=pytest.mark.slow),
+    pytest.param("examples/autotune_gpt2.py", "AUTOTUNE_RESULT",
+                 marks=pytest.mark.slow),
 ])
 def test_example_runs(script, expect, tmp_path):
     extra = {}

@@ -278,6 +278,7 @@ def test_dropout_stable_under_remat():
                                       err_msg=f"d{name} differs under remat")
 
 
+@pytest.mark.slow
 def test_cross_attention_shapes_with_operands():
     """sq != sk (the causal_shift path): operands + dropout must use the
     right absolute coordinates on both the short-q and long-k sides."""

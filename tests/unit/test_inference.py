@@ -40,6 +40,7 @@ def _parity(hf_model, ids_np, tol=2e-3, is_bert=False):
 
 
 class TestInjectionParity:
+    @pytest.mark.slow
     def test_gpt2(self, ids_np):
         from transformers import GPT2Config, GPT2LMHeadModel
         torch.manual_seed(0)
@@ -94,6 +95,7 @@ ARCH_VARIANTS = {
 
 
 class TestGeneration:
+    @pytest.mark.slow
     @pytest.mark.parametrize("arch", sorted(ARCH_VARIANTS))
     def test_cache_decode_matches_full_forward(self, arch):
         cfg = GPTConfig(vocab_size=97, max_seq_len=64, d_model=32,
@@ -140,6 +142,7 @@ class TestGeneration:
         assert toks3.shape == (4,)
         assert int(jnp.max(toks3)) < 97
 
+    @pytest.mark.slow
     def test_sampling_shapes_and_determinism(self):
         cfg = GPTConfig(vocab_size=97, max_seq_len=64, d_model=32,
                         n_layers=1, n_heads=2, dtype=jnp.float32)
@@ -153,6 +156,7 @@ class TestGeneration:
         assert a.shape == (2, 10)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    @pytest.mark.slow
     def test_batched_decode_rows_are_independent(self):
         """Batched greedy decode (the serving-throughput mode benched by
         bench_decode's throughput_batch loop) must carry no cross-row
@@ -178,6 +182,7 @@ class TestGeneration:
         assert not np.array_equal(np.asarray(batched[0]),
                                   np.asarray(batched[1]))
 
+    @pytest.mark.slow
     def test_eos_fill(self):
         cfg = GPTConfig(vocab_size=17, max_seq_len=32, d_model=16,
                         n_layers=1, n_heads=2, dtype=jnp.float32)
@@ -206,6 +211,7 @@ class TestInferenceEngine:
 
 
 class TestCheckpointServing:
+    @pytest.mark.slow
     def test_load_module_params_roundtrip(self, tmp_path):
         """Train-engine checkpoint -> inference weights (reference:
         InferenceEngine checkpoint loading, inference/engine.py:240)."""
@@ -305,6 +311,7 @@ class TestRaggedGeneration:
         params = m.init(rng, jnp.asarray(ids))["params"]
         return m, params, ids
 
+    @pytest.mark.slow
     def test_equal_lengths_match_classic_path_exactly(self):
         m, params, ids = self._setup("gpt2")
         classic = np.asarray(generate(m, params, ids, max_new_tokens=5,
@@ -314,6 +321,7 @@ class TestRaggedGeneration:
             prompt_lengths=np.full(3, 12, np.int32)))
         np.testing.assert_array_equal(classic, ragged)
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("arch", sorted(ARCHS))
     def test_mixed_lengths_match_per_row_references(self, arch):
         m, params, ids = self._setup(arch)
@@ -331,6 +339,7 @@ class TestRaggedGeneration:
             np.testing.assert_array_equal(out[i, :n + 5], ref[0],
                                           err_msg=f"{arch} row {i}")
 
+    @pytest.mark.slow
     def test_left_padded_input_via_pad_token(self):
         """HF-convention left-padded batches: lengths inferred from
         pad_token_id and rows normalized — same result as right-padded
@@ -350,6 +359,7 @@ class TestRaggedGeneration:
                                 temperature=0.0, pad_token_id=PAD))
         np.testing.assert_array_equal(a, b)
 
+    @pytest.mark.slow
     def test_ragged_eos_fill_and_output_layout(self):
         m, params, ids = self._setup("gpt2")
         lens = np.asarray([12, 5, 8], np.int32)
@@ -378,6 +388,7 @@ class TestRaggedGeneration:
             np.testing.assert_array_equal(out2[i, n + 6:], 3)
             np.testing.assert_array_equal(out2[i, :n], ids[i, :n])
 
+    @pytest.mark.slow
     def test_pad_valued_tokens_inside_prompt_survive_inference(self):
         """A right-padded prompt that STARTS with (or contains) the pad
         token — BOS == pad in several HF tokenizers — must keep its real
@@ -405,6 +416,7 @@ class TestRaggedGeneration:
                                 prompt_lengths=lens, pad_token_id=PAD))
         np.testing.assert_array_equal(a, b)
 
+    @pytest.mark.slow
     def test_engine_generate_ragged_checks_true_lengths_not_width(self):
         """engine.generate(..., prompt_lengths=) must size the request by
         the longest TRUE prompt: a padded width that pushes width+max_new
@@ -432,6 +444,7 @@ class TestRaggedGeneration:
         with pytest.raises(ValueError, match="max_seq_len"):
             eng.generate(ids, max_new_tokens=14, prompt_lengths=lens)
 
+    @pytest.mark.slow
     def test_ragged_padded_width_wider_than_needed_cache(self):
         """The cache must hold the full PADDED width: short true lengths
         inside a >128-wide padded batch must not shrink the cache below
@@ -477,6 +490,7 @@ class TestInt8Serving:
         params = m.init(jax.random.PRNGKey(0), ids)["params"]
         return m, params, ids
 
+    @pytest.mark.slow
     def test_quantize_roundtrip_error_bounded(self):
         from deepspeed_tpu.module_inject.module_quantize import (
             quantize_param_tree, dequantize_param_tree)
@@ -488,6 +502,7 @@ class TestInt8Serving:
             # symmetric per-channel int8: error <= scale/2 = max|w|/254
             assert np.max(np.abs(a - b)) <= np.max(np.abs(a)) / 254 + 1e-6
 
+    @pytest.mark.slow
     def test_engine_generates_and_halves_bytes(self):
         import deepspeed_tpu
         from deepspeed_tpu.module_inject.module_quantize import \
@@ -520,6 +535,7 @@ class TestInt8Serving:
         assert agree > 0.7, agree
 
 
+    @pytest.mark.slow
     def test_int8_direct_under_tensor_parallel_mesh(self):
         """QDense's fused-dequant matmul must compile and serve under a
         model-axis (TP) mesh — pallas custom calls see the sharded
@@ -567,6 +583,7 @@ class TestMoEServing:
         params = m.init(jax.random.PRNGKey(0), ids)["params"]
         return mesh, m, ids, params
 
+    @pytest.mark.slow
     def test_moe_generate_matches_full_forward(self):
         _, m, ids, params = self._moe_setup(k=1, moe_interval=2)
         out = generate(m, params, ids, max_new_tokens=4, temperature=0.0)
@@ -577,6 +594,7 @@ class TestMoEServing:
             cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
 
+    @pytest.mark.slow
     def test_moe_engine_generate(self):
         import deepspeed_tpu
         mesh, m, ids, params = self._moe_setup(k=2, moe_interval=1)
@@ -585,6 +603,7 @@ class TestMoEServing:
         out = eng.generate(ids, max_new_tokens=4)
         assert out.shape == (4, 12)
 
+    @pytest.mark.slow
     def test_moe_int8_direct_serving(self):
         """Expert-parallel MoE + weight-only int8: the capability flag
         routes MoEGPT through DIRECT mode (expert kernels stay int8
@@ -1014,6 +1033,7 @@ class TestServingStackHardening:
             from deepspeed_tpu.comm.mesh import set_global_mesh
             set_global_mesh(None)
 
+    @pytest.mark.slow
     def test_sampling_sweep_reuses_one_executable(self):
         """Temperature/top-k/top-p are traced VALUES: a serving sweep
         must not recompile the decode loop per setting (only the feature
@@ -1043,6 +1063,7 @@ class TestServingStackHardening:
         assert _decode_jit._cache_size() == before + 1, \
             _decode_jit._cache_size() - before
 
+    @pytest.mark.slow
     def test_inference_engine_preserves_act_quant_rules(self):
         """Constructing/serving an InferenceEngine (distillation teacher)
         must not clear the process-global activation-quantization rules a

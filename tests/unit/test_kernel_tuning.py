@@ -162,6 +162,7 @@ class TestBwdStructures:
 
 
 class TestSweepHarness:
+    @pytest.mark.slow
     def test_sweep_writes_consumable_artifact(self, tmp_path):
         from benchmarks.kernel_tuning import sweep_flash_attention
         entries = sweep_flash_attention(

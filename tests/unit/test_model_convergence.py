@@ -29,6 +29,7 @@ def _avg(xs):
     return sum(xs) / len(xs)
 
 
+@pytest.mark.slow
 def test_tiny_gpt_converges_through_engine():
     cfg = GPTConfig(vocab_size=128, max_seq_len=32, d_model=64, n_layers=2,
                     n_heads=4, dtype=jnp.float32, scan_layers=False)
@@ -56,6 +57,7 @@ def test_tiny_gpt_converges_through_engine():
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_tiny_bert_pretraining_converges_through_engine():
     cfg = BertConfig(vocab_size=96, max_seq_len=24, d_model=48, n_layers=2,
                      n_heads=4, dtype=jnp.float32, scan_layers=False)

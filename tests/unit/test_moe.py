@@ -118,6 +118,7 @@ def make_moe_engine(expert_axis=4, zero_stage=0):
     return engine, batch
 
 
+@pytest.mark.slow
 def test_moe_gpt_trains_expert_parallel():
     engine, batch = make_moe_engine(expert_axis=4)
     losses = [float(engine.train_batch(batch)) for _ in range(10)]

@@ -140,6 +140,7 @@ class TestCheckpointReshape:
         set_global_mesh(None)
         return cfg, loss_fn, batch, loss
 
+    @pytest.mark.slow
     def test_resize_dp_on_load(self, tmp_path):
         """dp=4 checkpoint resumes at dp=2 with identical eval loss — the
         reference implements this with hand-written shard remapping

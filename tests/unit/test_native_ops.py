@@ -144,6 +144,7 @@ def test_tensor_swapper(tmp_path):
     ("cpu", {"type": "Adam", "params": {"lr": 1e-3, "weight_decay": 0.01,
                                         "adam_w_mode": False}}),
 ])
+@pytest.mark.slow
 def test_native_offload_engine_matches_default(tmp_path, device, optimizer):
     """ZeRO-Offload via cpu_adam reproduces the in-XLA Adam trajectory
     (reference: test_zero.py correctness-vs-baseline pattern)."""

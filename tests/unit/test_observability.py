@@ -292,6 +292,7 @@ class TestPerf:
 # ---------------------------------------------------------------------------
 
 class TestEngineIntegration:
+    @pytest.mark.slow
     def test_fused_run_trace_and_probe_discipline(self, tmp_path):
         """CPU run with tracing: valid Chrome-trace JSON, data/dispatch
         spans nested in the capture window, and the ONLY host syncs the
@@ -332,6 +333,7 @@ class TestEngineIntegration:
         assert len(eng.observability.tracer.events) > 0
         eng.destroy()
 
+    @pytest.mark.slow
     def test_split_convention_nested_fwd_bwd_step(self, tmp_path):
         """The acceptance nesting check: fwd/bwd/step spans each sit
         INSIDE their iteration span in the written trace.json."""

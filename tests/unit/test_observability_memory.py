@@ -404,6 +404,7 @@ class TestEngineIntegration:
         assert get_accountant().subsystem_bytes("train/gradient_buffers") > 0
         eng.destroy()
 
+    @pytest.mark.slow
     def test_train_step_analysis_memory_on_cpu(self):
         """The registered fused train step re-lowers from its stored
         avals and yields a real XLA memory analysis (the ds_tpu_trace

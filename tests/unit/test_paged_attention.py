@@ -63,6 +63,7 @@ class TestKernelVsDenseParity:
     # the current token), page boundaries +/- 1, and a full table
     @pytest.mark.parametrize("length", [0, 1, PAGE - 1, PAGE, PAGE + 1,
                                         5 * PAGE])
+    @pytest.mark.slow
     def test_ragged_lengths(self, length):
         kp, vp = _pool()
         q, kn, vn = _operands()
@@ -124,6 +125,7 @@ class TestKernelVsDenseParity:
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_int8_pages_kernel_vs_dense_and_error_bound(self):
         """int8 pages: kernel dequant-in-page-loop == dense dequant
         exactly, and both stay within the quantization error bound of
@@ -229,7 +231,7 @@ class TestEngineKernelPath:
     # variants and the unstacked sweep ride the CI unit matrix only
     # (pytest.ini slow convention — engine drives cost ~10s each)
     @pytest.mark.parametrize("arch", [
-        "gpt2",
+        pytest.param("gpt2", marks=pytest.mark.slow),
         pytest.param("gptj", marks=pytest.mark.slow),
         pytest.param("bloom", marks=pytest.mark.slow),
     ])
@@ -265,6 +267,7 @@ class TestEngineKernelPath:
         _, toks_on = _drive(m, params, prompts, outs, "on")
         assert toks_on == toks_off
 
+    @pytest.mark.slow
     def test_transient_gauge_zero_and_compile_once(self):
         """The acceptance figures: decode_gather_transient_bytes == 0 on
         the kernel path (derived AND the live gauge), kernel decode

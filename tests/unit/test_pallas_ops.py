@@ -288,6 +288,7 @@ class TestFusedLamb:
     """Pallas fused LAMB (VERDICT #8; reference:
     csrc/lamb/fused_lamb_cuda.cpp:108 in-kernel trust-ratio reductions)."""
 
+    @pytest.mark.slow
     def test_matches_optax_lamb(self):
         from deepspeed_tpu.ops.pallas import fused_lamb
         import optax
@@ -487,6 +488,7 @@ class TestWOInt8Matmul:
                                    rtol=0.05, atol=0.05)
 
 
+@pytest.mark.slow
 def test_flash_streamed_structure_matches_resident(monkeypatch):
     """Long-seq (streamed-grid) kernel structure must agree exactly with
     the resident structure it replaces above the VMEM threshold."""

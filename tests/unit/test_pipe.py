@@ -248,6 +248,7 @@ class TestHostDrivenPipeline:
         layers = m.build_stage_layers()
         assert len(layers) == 2 and sum(len(l) for l in layers) == 4
 
+    @pytest.mark.slow
     def test_heterogeneous_trains(self):
         module = self._hetero_module()
         config = {"train_batch_size": 8, "gradient_accumulation_steps": 2,
@@ -407,6 +408,7 @@ class TestHostPipelineDataParallel:
         finally:
             set_global_mesh(None)
 
+    @pytest.mark.slow
     def test_dp_matches_single_client(self):
         from deepspeed_tpu.comm import MeshSpec
         _, single = self._run(None, 1)

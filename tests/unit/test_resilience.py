@@ -180,6 +180,7 @@ class TestManifest:
 # engine integration: save/load with integrity + fallback
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_engine_save_writes_manifest_and_load_verifies(tmp_path):
     eng = make_engine()
     eng.train_batch(make_batch(16, seed=0))
@@ -192,6 +193,7 @@ def test_engine_save_writes_manifest_and_load_verifies(tmp_path):
     assert not (tmp_path / "latest.tmp").exists()
 
 
+@pytest.mark.slow
 def test_torn_checkpoint_falls_back_to_verified_tag(tmp_path):
     """The tentpole recovery: latest points at a checkpoint with a
     fault-injected torn shard; load detects the mismatch, restores the
@@ -226,6 +228,7 @@ def test_corruption_without_fallback_raises(tmp_path):
         eng.load_checkpoint(str(tmp_path))
 
 
+@pytest.mark.slow
 def test_keep_last_n_gc_on_save(tmp_path):
     eng = make_engine(resilience={"integrity": {"keep_last_n": 2}})
     for i in range(4):
@@ -236,6 +239,7 @@ def test_keep_last_n_gc_on_save(tmp_path):
     assert (tmp_path / "latest").read_text() == "s3"
 
 
+@pytest.mark.slow
 def test_async_save_publishes_manifest_at_finalize(tmp_path):
     eng = make_engine()
     eng.train_batch(make_batch(16, seed=0))
@@ -248,6 +252,7 @@ def test_async_save_publishes_manifest_at_finalize(tmp_path):
     eng.destroy()
 
 
+@pytest.mark.slow
 def test_atexit_finalizes_pending_async_save(tmp_path):
     """A clean interpreter exit must not drop a durable async save: the
     registered atexit hook joins and publishes it."""
@@ -267,6 +272,7 @@ def test_atexit_finalizes_pending_async_save(tmp_path):
 # divergence sentinel + rollback
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_nan_rollback_restores_checkpoint_bitwise(tmp_path):
     eng = make_engine(ckpt_dir=tmp_path, resilience={
         "divergence": {"patience": 2, "check_interval": 1,
@@ -292,6 +298,7 @@ def test_nan_rollback_restores_checkpoint_bitwise(tmp_path):
     assert np.isfinite(float(eng.train_batch(make_batch(16, seed=99))))
 
 
+@pytest.mark.slow
 def test_rollback_exhaustion_raises(tmp_path):
     eng = make_engine(ckpt_dir=tmp_path, resilience={
         "divergence": {"patience": 1, "check_interval": 1,
@@ -352,6 +359,7 @@ def test_burst_ending_before_check_boundary_still_detected(tmp_path):
     assert eng.resilience.events[0][1] == 2.0        # the peak, not 0
 
 
+@pytest.mark.slow
 def test_explicit_tag_corruption_raises_not_substitutes(tmp_path):
     """Review regression: load_checkpoint(tag=...) naming a corrupt tag
     must raise, never silently restore a different step; latest-driven
@@ -369,6 +377,7 @@ def test_explicit_tag_corruption_raises_not_substitutes(tmp_path):
     assert path is not None and path.endswith("good")
 
 
+@pytest.mark.slow
 def test_async_manifest_records_save_time_step(tmp_path):
     """Review regression: an async save finalized steps later must stamp
     the manifest with the step the checkpoint was TAKEN at (tag ordering
@@ -401,6 +410,7 @@ def test_fp16_overflow_skips_are_not_divergence():
     assert sent.read_consecutive() == 1
 
 
+@pytest.mark.slow
 def test_rollback_quarantines_manifest_valid_nan_checkpoint(tmp_path):
     """Review regression: a save landing inside an undetected divergence
     window is integrity-valid NaN state; rollback must detect the
@@ -470,6 +480,7 @@ def test_resilience_package_lints_clean():
 # preemption + watchdog
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_emergency_save_on_sigterm(tmp_path):
     """In-process SIGTERM: the handler joins pending saves, writes a
     verified emergency checkpoint, and chains to the prior handler."""
@@ -566,6 +577,7 @@ def test_delay_fault_trips_engine_watchdog():
 # end-to-end chaos acceptance scenario
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_chaos_end_to_end_nan_torn_preempt(tmp_path):
     """The acceptance criterion: one run survives (a) an injected NaN
     burst, (b) a torn write on the next save, (c) a simulated preemption

@@ -87,6 +87,7 @@ def test_attention_op_auto_dispatch(sp_mesh):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_gpt_train_step_with_seq_parallel():
     """End-to-end: tiny GPT trains under a seq=2 mesh, loss matches the
     seq=1 run (same global batch, deterministic)."""
@@ -218,6 +219,7 @@ class TestSPWithOperands:
         np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_gpt_sp_trains_with_dropout(self):
         """End-to-end: GPT with attn+residual dropout trains under a
         seq=2 mesh with NO fallback warning and finite decreasing loss."""
@@ -371,6 +373,7 @@ class TestRingChunkedQ:
             set_global_mesh(None)
 
 
+@pytest.mark.slow
 def test_zero3_fsdp_ulysses_dropout_composition():
     """Combined regime: ZeRO-3 param sharding x fsdp x Ulysses sequence
     parallelism x dropout on ONE mesh — the config where sharding rules

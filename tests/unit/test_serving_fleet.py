@@ -269,6 +269,7 @@ def test_statusz_carries_fleet_section():
 # ---------------------------------------------------------------------------
 
 class TestDisaggregatedHandoff:
+    @pytest.mark.slow
     def test_handoff_token_exact_with_zero_recompute_on_decoder(self):
         """Prompt prefilled on replica A, decoded on replica B: tokens
         bit-equal to single-engine generate(), zero prefill programs run
@@ -394,6 +395,7 @@ class TestDeterminismAndFailover:
         fleet.close()
         return out
 
+    @pytest.mark.slow
     def test_same_trace_same_dispatch_and_handoff_sets(self):
         """Replayed trace -> the same per-replica dispatch sequence and
         the same handoff (src, dst) sequence, bit-exact, and identical
@@ -405,6 +407,7 @@ class TestDeterminismAndFailover:
         assert [h.tokens for h in h1] == [h.tokens for h in h2]
         assert {h.status for h in h1} == {"finished"}
 
+    @pytest.mark.slow
     def test_replica_kill_mid_trace_completes_token_exact(self):
         """Kill the highest-id live replica mid-trace: every request
         still finishes, token-exact vs the uncontended single-engine
@@ -433,6 +436,7 @@ class TestDeterminismAndFailover:
                    if not r["alive"]) == 1
         fleet.close()
 
+    @pytest.mark.slow
     def test_health_sweep_counts_misses_before_failover(self):
         """A wedged-but-alive replica (probe says "miss") survives
         exactly ``max_missed_health - 1`` sweeps, then fails over; a

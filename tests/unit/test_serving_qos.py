@@ -224,6 +224,7 @@ class TestPriorityScheduler:
 # ---------------------------------------------------------------------------
 
 class TestPreemption:
+    @pytest.mark.slow
     def test_preempt_requeue_resume_token_exact(self):
         """2 slots saturated by low-priority requests; a late interactive
         request preempts one back to the queue. EVERY request — the
@@ -499,6 +500,7 @@ class TestFaultContainment:
         eng.close()
         assert eng._watchdog is None    # close() tears the thread down
 
+    @pytest.mark.slow
     def test_recover_requeues_queued_and_active(self):
         """engine.recover() — the engine-restart path: every active
         request is requeued with tokens retained, queued requests stay

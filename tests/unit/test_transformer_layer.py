@@ -104,7 +104,7 @@ def test_additive_hf_mask_and_2d_mask_agree():
 @pytest.mark.parametrize("knob", [
     pytest.param("gelu_checkpoint", marks=pytest.mark.slow),
     pytest.param("attn_dropout_checkpoint", marks=pytest.mark.slow),
-    "normalize_invertible"])
+    pytest.param("normalize_invertible", marks=pytest.mark.slow)])
 def test_checkpoint_knobs_preserve_values_and_grads(knob):
     base = DeepSpeedTransformerConfig(
         hidden_size=64, heads=4, num_hidden_layers=1, training=False)
