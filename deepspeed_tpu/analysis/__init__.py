@@ -12,7 +12,17 @@ TPU only surface as opaque OOMs or flatlined step times at scale —
   constant capture, plus a broad-except hygiene rule;
 - **sharding-consistency** (sharding_rules.py): every collective axis name
   and every ``PartitionSpec`` dim must name a declared mesh axis
-  (cross-checked against comm/mesh.py's ``MESH_AXES`` vocabulary).
+  (cross-checked against comm/mesh.py's ``MESH_AXES`` vocabulary);
+- **determinism / replay safety** (determinism.py, DT rules): salted
+  ``hash()`` folds, wall-clock taint in scheduler/router decision paths,
+  unseeded global RNG, set-iteration dispatch order, ``np.asarray``
+  views of donated buffers;
+- **compile-cache hygiene** (compile_cache.py, CC rules): jit programs
+  stored without the PR-7 ``track_program`` registry wrapper, jit
+  construction in per-step paths, interpolated static_argnames values;
+- **cross-artifact drift** (drift.py, DR rules; ``ds_tpu_lint --drift``):
+  config dataclasses vs docs/config.md, emitted metric families vs the
+  docs/observability.md glossary.
 
 ``validate.py`` is the runtime half: structural validation of param /
 optimizer-state spec trees against the live mesh, run at engine init when
@@ -27,6 +37,7 @@ baseline file (see analysis/baseline.py and docs/analysis.md).
 from .core import (Finding, analyze_source, analyze_file, analyze_paths,
                    all_rules, declared_mesh_axes)
 from .baseline import load_baseline, save_baseline, split_by_baseline
+from .drift import analyze_drift
 from .validate import (validate_spec, validate_spec_tree,
                        validate_param_opt_consistency,
                        validate_engine_sharding)
@@ -47,6 +58,6 @@ def lint_ok(*rules):
 
 __all__ = ["Finding", "analyze_source", "analyze_file", "analyze_paths",
            "all_rules", "declared_mesh_axes", "load_baseline",
-           "save_baseline", "split_by_baseline", "lint_ok",
+           "save_baseline", "split_by_baseline", "lint_ok", "analyze_drift",
            "validate_spec", "validate_spec_tree",
            "validate_param_opt_consistency", "validate_engine_sharding"]

@@ -6,11 +6,15 @@ Exit codes: 0 = clean (all findings suppressed or baselined),
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 
-from .core import all_rules, analyze_paths, declared_mesh_axes
+from .core import (all_rules, analyze_paths, declared_mesh_axes,
+                   resolve_analysis_files)
 from .baseline import (DEFAULT_BASELINE, load_baseline, save_baseline,
                        split_by_baseline)
+from .drift import RULES as DRIFT_RULES, analyze_drift
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -31,6 +35,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="extra mesh axis names beyond comm/mesh.py's "
                         "MESH_AXES (comma-separated), for user scripts with "
                         "custom meshes")
+    p.add_argument("--drift", action="store_true",
+                   help="also run the cross-artifact drift checker "
+                        "(config dataclasses vs docs/config.md, metric "
+                        "families vs docs/observability.md)")
+    p.add_argument("--changed-only", metavar="REF", nargs="?", const="HEAD",
+                   default=None,
+                   help="scope the run to files changed vs a git ref "
+                        "(default HEAD when the flag is bare); the "
+                        "baseline is filtered to the same file subset so "
+                        "untouched files' entries never misreport as "
+                        "stale")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--list-rules", action="store_true",
                    help="print rule ids and descriptions, then exit")
@@ -49,7 +64,7 @@ def main(argv=None) -> int:
             print(f"{rule_id}  {desc}", file=out)
         return 0
 
-    if not args.paths:
+    if not args.paths and not args.drift:
         print("error: no paths given (try: ds_tpu_lint deepspeed_tpu)",
               file=sys.stderr)
         return 2
@@ -69,16 +84,37 @@ def main(argv=None) -> int:
                            if a.strip())
     mesh_axes = declared_mesh_axes(extra=extra_axes)
 
-    findings = analyze_paths(args.paths, mesh_axes=mesh_axes, rules=rules)
+    file_filter = None
+    analyzed_rel_paths = None
+    if args.changed_only is not None:
+        try:
+            file_filter = _changed_files(args.changed_only)
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"error: --changed-only needs a git checkout "
+                  f"(git diff vs {args.changed_only!r} failed: {e})",
+                  file=sys.stderr)
+            return 2
+        analyzed_rel_paths = {
+            rel.replace(os.sep, "/")
+            for _, rel in resolve_analysis_files(args.paths, file_filter)}
+
+    findings = analyze_paths(args.paths, mesh_axes=mesh_axes, rules=rules,
+                             file_filter=file_filter)
+    if args.drift:
+        drift_findings = analyze_drift()
+        if rules is not None:
+            drift_findings = [f for f in drift_findings if f.rule in rules]
+        findings.extend(drift_findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     if args.update_baseline:
-        if args.rules:
+        if args.rules or args.changed_only is not None:
             # a filtered run sees only a subset of findings; writing it
-            # out would silently drop every other rule's triaged entries
+            # out would silently drop every other rule's/file's triaged
+            # entries
             print("error: --update-baseline cannot be combined with "
-                  "--rules (the baseline must cover all rules)",
-                  file=sys.stderr)
+                  "--rules or --changed-only (the baseline must cover "
+                  "all rules and files)", file=sys.stderr)
             return 2
         path = args.baseline or DEFAULT_BASELINE
         save_baseline(path, findings)
@@ -99,6 +135,19 @@ def main(argv=None) -> int:
         # from the baseline too, or they'd all misreport as stale/fixed
         baseline = {fp: rec for fp, rec in baseline.items()
                     if rec.get("rule") in rules}
+    if not args.drift:
+        # drift entries only materialize under --drift; without it they
+        # would all misreport as stale (same logic as the --rules filter)
+        baseline = {fp: rec for fp, rec in baseline.items()
+                    if rec.get("rule") not in DRIFT_RULES}
+    if analyzed_rel_paths is not None:
+        # --changed-only analyzes a file subset: keep only those files'
+        # entries (drift entries ride along — the drift pass is always
+        # repo-wide) so untouched files never misreport as stale
+        baseline = {fp: rec for fp, rec in baseline.items()
+                    if rec.get("rule") in DRIFT_RULES
+                    or rec.get("path", "").replace(os.sep, "/")
+                    in analyzed_rel_paths}
     new, baselined, stale = split_by_baseline(findings, baseline)
 
     if args.format == "json":
@@ -123,6 +172,23 @@ def main(argv=None) -> int:
               f"{'y' if len(stale) == 1 else 'ies'}", file=out)
 
     return 1 if new else 0
+
+
+def _changed_files(ref: str):
+    """Absolute paths of files changed vs ``ref`` (tracked diff +
+    untracked), for --changed-only. Raises CalledProcessError/OSError
+    outside a git checkout."""
+    top = subprocess.run(
+        ["git", "rev-parse", "--show-toplevel"],
+        capture_output=True, text=True, check=True).stdout.strip()
+    changed = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        capture_output=True, text=True, check=True).stdout.splitlines()
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        capture_output=True, text=True, check=True).stdout.splitlines()
+    return {os.path.abspath(os.path.join(top, p))
+            for p in changed + untracked if p.strip()}
 
 
 def _as_dict(f):

@@ -1,0 +1,283 @@
+"""Compile-cache hygiene rules (the CC family).
+
+Every jitted program in this repo is supposed to be (a) registered in
+the PR 7 compiled-program registry (``observability/programs.py``) so
+compile accounting sees it, and (b) constructed ONCE and dispatched
+many times — the compile-once discipline the ``_cache_size()`` parity
+tests assert. These rules catch the static violations:
+
+- CC001 untracked-jit      a ``jax.jit``/``pjit`` program stored for
+                           later dispatch without ``track_program(...)``
+                           around it — invisible to ``ds_tpu_trace``,
+                           ``ds_tpu_report`` and the compile-count
+                           parity probes.
+- CC002 jit-in-step-path   ``jax.jit(...)`` constructed inside a loop
+                           body or a per-step/per-request method: a
+                           fresh jit object per call owns a fresh cache,
+                           so every dispatch retraces. Memoized stores
+                           (``self._compiled[key] = ...``) are the
+                           sanctioned pattern and are exempt.
+- CC003 dynamic-static-arg interpolated (f-string/.format/%) value
+                           passed for a ``static_argnames`` parameter:
+                           every distinct string is a distinct
+                           specialization — a per-value retrace bomb.
+
+Exemptions for CC001 (each is a real convention in-tree):
+
+- immediately-invoked ``jax.jit(f)(args)`` — one-shot init computations
+  never dispatched again;
+- ``jax.jit(f).lower(...)`` chains — AOT inspection, not dispatch;
+- ``return jax.jit(...)`` — factory helpers whose callers wrap the
+  result in ``track_program`` at the storage site.
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .core import LintContext, dotted_name
+
+RULES: Dict[str, str] = {
+    "CC001": "untracked-jit: jax.jit/pjit program stored without "
+             "track_program() — invisible to compile accounting "
+             "(observability/programs.py registry)",
+    "CC002": "jit-in-step-path: jax.jit constructed in a loop body or "
+             "per-step/per-request method — a fresh jit object per call "
+             "defeats the compile cache; build once, dispatch many",
+    "CC003": "dynamic-static-arg: f-string/.format interpolation passed "
+             "for a static_argnames parameter — every distinct value is "
+             "a fresh retrace",
+}
+
+_JIT_LEAVES = {"jit", "pjit"}
+
+_STEP_PATH_FN_RE = re.compile(
+    r"(?:^|_)(step|advance|tick|iterate|admit|submit|harvest|decode_iter|"
+    r"prefill|forward|backward)(?:$|_)")
+
+# builders run once at init and RETURN the program for the caller to
+# store — `_make_train_step` is not the per-step path despite the name
+_BUILDER_FN_RE = re.compile(r"(?:^|_)(make|build|create|init|compile|"
+                            r"setup|configure)(?:$|_)")
+
+
+def _parent_map(tree) -> Dict[int, ast.AST]:
+    parents: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    return parents
+
+
+def _is_jit_construction(node) -> bool:
+    """A call that *creates* a compiled-program handle: jax.jit(f, ...)
+    with a function argument or keyword config (not a bare dispatch)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fname = dotted_name(node.func)
+    if fname is None:
+        return False
+    parts = fname.split(".")
+    if parts[-1] not in _JIT_LEAVES:
+        return False
+    # `jax.jit(...)`, `jax.experimental.pjit(...)`, or a bare from-import
+    # `jit(...)`; `self.jit(...)` is something else.
+    return len(parts) == 1 or parts[0] in ("jax", "pjit", "functools")
+
+
+def _ancestors(node, parents):
+    cur = parents.get(id(node))
+    while cur is not None:
+        yield cur
+        cur = parents.get(id(cur))
+
+
+def _wrapping_call_leaf(node, parents) -> Optional[str]:
+    """Leaf name of a call that takes ``node`` directly as an argument
+    (``track_program(name, <node>)``), else None."""
+    parent = parents.get(id(node))
+    if isinstance(parent, ast.Call) and node in parent.args:
+        fname = dotted_name(parent.func)
+        if fname is not None:
+            return fname.split(".")[-1]
+        if isinstance(parent.func, ast.Attribute):
+            return parent.func.attr
+    return None
+
+
+def _is_immediately_invoked(node, parents) -> bool:
+    parent = parents.get(id(node))
+    return isinstance(parent, ast.Call) and parent.func is node
+
+
+def _is_lower_chain(node, parents) -> bool:
+    parent = parents.get(id(node))
+    return isinstance(parent, ast.Attribute)
+
+
+def _storage_root(node, parents):
+    """The statement that stores this expression (walking through a
+    track_program wrapper and call chains), or None."""
+    cur = node
+    for anc in _ancestors(node, parents):
+        if isinstance(anc, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                            ast.Return, ast.Expr, ast.NamedExpr)):
+            return anc
+        cur = anc
+    return None
+
+
+def _enclosing_function(node, parents):
+    for anc in _ancestors(node, parents):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def _inside_loop(node, parents, stop_at) -> bool:
+    for anc in _ancestors(node, parents):
+        if anc is stop_at:
+            return False
+        if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+            return True
+    return False
+
+
+def _stored_into_self(node, parents) -> bool:
+    """True when the (possibly track_program-wrapped) jit lands in an
+    instance cache: ``self._compiled[key] = ...`` / ``self._prog = ...``
+    — the memoize-on-first-use pattern."""
+    root = _storage_root(node, parents)
+    if not isinstance(root, (ast.Assign, ast.AnnAssign)):
+        return False
+    targets = root.targets if isinstance(root, ast.Assign) else [root.target]
+    for t in targets:
+        base = t
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Attribute):
+            return True
+    return False
+
+
+def _check_jit_sites(ctx: LintContext, tree, parents):
+    """CC001 + CC002 over every jit construction site (call form)."""
+    for node in ast.walk(tree):
+        if not _is_jit_construction(node):
+            continue
+        wrapper = _wrapping_call_leaf(node, parents)
+        tracked = wrapper == "track_program"
+        immediate = _is_immediately_invoked(node, parents)
+        lower = _is_lower_chain(node, parents)
+        root = _storage_root(node, parents)
+        returned = isinstance(root, ast.Return)
+
+        if not (tracked or immediate or lower or returned):
+            ctx.report("CC001", node,
+                       "jit program stored without track_program() — wrap "
+                       "the site (track_program(name, jax.jit(...), "
+                       "subsystem=...)) so compile accounting and "
+                       "ds_tpu_trace see it")
+
+        if immediate or lower or returned:
+            continue
+        fn = _enclosing_function(node, parents)
+        in_loop = _inside_loop(node, parents, stop_at=fn)
+        fn_name = fn.name.lower() if fn is not None else ""
+        in_step_fn = (fn is not None
+                      and _STEP_PATH_FN_RE.search(fn_name) is not None
+                      and _BUILDER_FN_RE.search(fn_name) is None)
+        if (in_loop or in_step_fn) and not _stored_into_self(node, parents):
+            where = "a loop body" if in_loop else f"per-step method {fn.name}()"
+            ctx.report("CC002", node,
+                       f"jax.jit constructed in {where} — a fresh jit "
+                       "object per call owns a fresh cache and retraces "
+                       "every dispatch; hoist it, or memoize into an "
+                       "instance cache (self._compiled[key] = ...)")
+
+
+def _check_jit_decorators(ctx: LintContext, tree):
+    """CC001 for the decorator form: @jax.jit / @partial(jax.jit, ...)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            target = dec
+            if isinstance(dec, ast.Call):
+                head = dotted_name(dec.func)
+                if head is not None and head.split(".")[-1] == "partial" \
+                        and dec.args:
+                    target = dec.args[0]
+                else:
+                    target = dec.func
+            fname = dotted_name(target)
+            if fname is None:
+                continue
+            parts = fname.split(".")
+            if parts[-1] in _JIT_LEAVES and (
+                    len(parts) == 1 or parts[0] == "jax"):
+                ctx.report("CC001", dec,
+                           f"@{fname} program is never registered — "
+                           "decorated functions bypass track_program(); "
+                           "jit at the storage site instead: name = "
+                           "track_program(name, jax.jit(fn))")
+
+
+# --- CC003 -----------------------------------------------------------------
+
+def _static_argname_vocab(tree) -> Set[str]:
+    """Every literal name appearing in a static_argnames value anywhere
+    in the file — the params whose values specialize the trace."""
+    vocab: Set[str] = set()
+
+    def collect(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            vocab.add(node.value)
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.elts:
+                collect(e)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "static_argnames":
+                    collect(kw.value)
+    return vocab
+
+
+def _is_interpolated_string(node) -> bool:
+    if isinstance(node, ast.JoinedStr):
+        return any(isinstance(v, ast.FormattedValue) for v in node.values)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == "format":
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+        left = node.left
+        return isinstance(left, ast.Constant) and isinstance(left.value, str)
+    return False
+
+
+def _check_dynamic_static_args(ctx: LintContext, tree):
+    vocab = _static_argname_vocab(tree)
+    if not vocab:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        for kw in node.keywords:
+            if kw.arg in vocab and _is_interpolated_string(kw.value):
+                ctx.report("CC003", kw.value,
+                           f"interpolated string passed for static arg "
+                           f"'{kw.arg}' — every distinct value compiles a "
+                           "fresh specialization (retrace bomb); pass an "
+                           "enum/interned constant instead")
+
+
+# --- entry point -----------------------------------------------------------
+
+def analyze(ctx: LintContext):
+    tree = ctx.tree
+    parents = _parent_map(tree)
+    _check_jit_sites(ctx, tree, parents)
+    _check_jit_decorators(ctx, tree)
+    _check_dynamic_static_args(ctx, tree)

@@ -182,10 +182,14 @@ class LintContext:
 # ---------------------------------------------------------------------------
 
 def all_rules() -> Dict[str, str]:
-    """rule id -> one-line description, across both families."""
-    from . import trace_safety, sharding_rules
+    """rule id -> one-line description, across every family."""
+    from . import trace_safety, sharding_rules, determinism, compile_cache
+    from . import drift
     rules = dict(trace_safety.RULES)
     rules.update(sharding_rules.RULES)
+    rules.update(determinism.RULES)
+    rules.update(compile_cache.RULES)
+    rules.update(drift.RULES)
     return rules
 
 
@@ -220,7 +224,7 @@ def analyze_source(source: str, path: str = "<string>",
                    rules: Optional[Set[str]] = None) -> List[Finding]:
     """Run every rule over one source string. Returns findings sorted by
     position (suppressed ones already dropped)."""
-    from . import trace_safety, sharding_rules
+    from . import trace_safety, sharding_rules, determinism, compile_cache
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
@@ -232,6 +236,8 @@ def analyze_source(source: str, path: str = "<string>",
                       mesh_axes or declared_mesh_axes(), enabled_rules=rules)
     trace_safety.analyze(ctx)
     sharding_rules.analyze(ctx)
+    determinism.analyze(ctx)
+    compile_cache.analyze(ctx)
     ctx.findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return finalize_fingerprints(ctx.findings)
 
@@ -262,20 +268,36 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
     return out
 
 
-def analyze_paths(paths: Sequence[str],
-                  mesh_axes: Optional[Sequence[str]] = None,
-                  rules: Optional[Set[str]] = None) -> List[Finding]:
-    """Findings for files under each root, reported with paths relative to
-    the root's parent ("deepspeed_tpu/runtime/engine.py" whether the root
-    was given absolute or relative) so baseline fingerprints don't depend
-    on where the linter was invoked from."""
-    findings: List[Finding] = []
+def resolve_analysis_files(paths: Sequence[str],
+                           file_filter: Optional[Set[str]] = None
+                           ) -> List[Tuple[str, str]]:
+    """(absolute, reported-relative) path pairs for every file a run over
+    ``paths`` would analyze. Reported paths are relative to each root's
+    parent ("deepspeed_tpu/runtime/engine.py" whether the root was given
+    absolute or relative) so baseline fingerprints don't depend on where
+    the linter was invoked from. ``file_filter`` (absolute paths, e.g.
+    the --changed-only set) restricts the result."""
+    out: List[Tuple[str, str]] = []
     for root in paths:
         base = os.path.dirname(os.path.abspath(root))
         for path in iter_python_files([root]):
-            rel = os.path.relpath(os.path.abspath(path), base)
-            with open(path, encoding="utf-8") as f:
-                source = f.read()
-            findings.extend(analyze_source(source, path=rel,
-                                           mesh_axes=mesh_axes, rules=rules))
+            abspath = os.path.abspath(path)
+            if file_filter is not None and abspath not in file_filter:
+                continue
+            out.append((abspath, os.path.relpath(abspath, base)))
+    return out
+
+
+def analyze_paths(paths: Sequence[str],
+                  mesh_axes: Optional[Sequence[str]] = None,
+                  rules: Optional[Set[str]] = None,
+                  file_filter: Optional[Set[str]] = None) -> List[Finding]:
+    """Findings for files under each root (see resolve_analysis_files for
+    path reporting and the ``file_filter`` contract)."""
+    findings: List[Finding] = []
+    for abspath, rel in resolve_analysis_files(paths, file_filter):
+        with open(abspath, encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(analyze_source(source, path=rel,
+                                       mesh_axes=mesh_axes, rules=rules))
     return findings

@@ -1,0 +1,403 @@
+"""Determinism / replay-safety rules (the DT family).
+
+The repo's parity discipline — bit-exact trace replay on the
+deterministic step clock (docs/serving.md), compile-once programs,
+cross-replica agreement in the fleet — breaks on a handful of recurring
+Python habits that tests only catch after the fact. These rules flag
+them where they are provable from local AST evidence:
+
+- DT001 salted-hash        ``hash()`` on a str/bytes value feeding ids
+                           or ordering: PYTHONHASHSEED salts it per
+                           process, so two replicas (or a replay run)
+                           disagree. Use ``zlib.crc32`` (the PR 3
+                           request-id convention).
+- DT002 wall-clock-decision  ``time.time``/``perf_counter*``/
+                           ``monotonic`` taint flowing into the return
+                           value or persistent state of a scheduler/
+                           router/QoS/fleet decision function. Replay
+                           runs at a different wall speed; decisions
+                           must key off the step clock. Telemetry sinks
+                           (record/observe/emit/span...) and timestamp
+                           attributes are recognized and exempt.
+- DT003 unseeded-global-rng  module-level ``random.*`` / ``np.random.*``
+                           sampling calls: process-global RNG state is
+                           invisible to the replay log. Use a seeded
+                           ``random.Random(seed)`` / ``np.random
+                           .default_rng(seed)`` instance.
+- DT004 unordered-iteration  iterating a ``set`` inside a decision
+                           function without ``sorted()``: victim
+                           selection / dispatch order then depends on
+                           hash salt. (Python dicts iterate in
+                           insertion order — deterministic — so only
+                           sets are flagged.)
+- DT005 asarray-view-of-donated  ``np.asarray(x)`` where ``x`` is also
+                           passed to a donating/jitted step call in the
+                           same function: asarray is a ZERO-COPY view,
+                           and donation invalidates the buffer under it
+                           (the PR 4 param-snapshot bug). Use
+                           ``np.array`` (a copy).
+"""
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .core import LintContext, dotted_name
+
+RULES: Dict[str, str] = {
+    "DT001": "salted-hash: hash() on a str/bytes value — PYTHONHASHSEED "
+             "salts it per process; use zlib.crc32 for stable id/order "
+             "folds",
+    "DT002": "wall-clock-decision: time.time/perf_counter/monotonic value "
+             "flows into the return value or state of a scheduler/router/"
+             "QoS/fleet decision function — replay-unstable; use the step "
+             "clock",
+    "DT003": "unseeded-global-rng: random.*/np.random.* module-level "
+             "sampling call — use a seeded random.Random / "
+             "np.random.default_rng instance",
+    "DT004": "unordered-iteration: iterating a set in a decision function "
+             "without sorted() — dispatch/victim order depends on hash "
+             "salt",
+    "DT005": "asarray-view-of-donated: np.asarray of a value that is also "
+             "passed to a donating/jitted step call — zero-copy view of a "
+             "donated buffer; use np.array (copy)",
+}
+
+# --- DT001 -----------------------------------------------------------------
+
+# Names that conventionally hold strings in id/ordering paths; hash() on
+# one is flagged even when the value's type is not locally provable.
+_STRINGY_NAME_RE = re.compile(
+    r"(?:^|_)(id|ids|name|names|key|keys|tag|label|prefix|path|uid|"
+    r"request_id|replica|host)(?:$|_)|(?:_id|_key|_name|_tag)$")
+
+_STR_PRODUCERS = {"str", "repr", "format", "join", "encode", "hexdigest",
+                  "upper", "lower", "strip", "lstrip", "rstrip"}
+
+
+def _is_stringy(node) -> bool:
+    """Provably (or conventionally) a str/bytes expression."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (str, bytes))
+    if isinstance(node, ast.JoinedStr):
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname is not None and fname.split(".")[-1] in _STR_PRODUCERS:
+            return True
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _STR_PRODUCERS:
+            return True
+        return False
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Mod)):
+        return _is_stringy(node.left) or _is_stringy(node.right)
+    if isinstance(node, ast.Name):
+        return bool(_STRINGY_NAME_RE.search(node.id.lower()))
+    if isinstance(node, ast.Attribute):
+        return bool(_STRINGY_NAME_RE.search(node.attr.lower()))
+    return False
+
+
+def _check_salted_hash(ctx: LintContext, tree):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted_name(node.func) != "hash" or len(node.args) != 1:
+            continue
+        if _is_stringy(node.args[0]):
+            ctx.report("DT001", node,
+                       "hash() on a str/bytes value is salted per process "
+                       "(PYTHONHASHSEED) — replicas and replay runs "
+                       "disagree; fold with zlib.crc32(s.encode()) instead")
+
+
+# --- decision-function scope (DT002 / DT004) -------------------------------
+
+_DECISION_FN_RE = re.compile(
+    r"(?:^|_)(decide|route|dispatch|select|admit|schedule|pick|victim|"
+    r"evict|preempt|shed|rebalance|assign|place|recommend|plan)(?:$|_)"
+    r"|^should_|_policy$|^policy_")
+
+_DECISION_CLASS_RE = re.compile(
+    r"(Scheduler|Router|Qos|QoS|Policy|Autoscaler|Balancer|Arbiter)")
+
+# Telemetry sinks: a wall-clock value handed to one of these is a
+# measurement, not a decision input.
+_SINK_LEAVES = {"record", "observe", "emit", "log", "debug", "info",
+                "warning", "error", "span", "timed", "set", "inc", "add",
+                "append", "note", "sample", "stamp", "write", "push",
+                "publish", "update", "gauge", "counter", "histogram",
+                "print", "format", "render"}
+
+# Attribute names that hold timestamps by convention: stamping state is
+# telemetry, steering on it elsewhere is what DT002 catches.
+_TIMESTAMP_ATTR_RE = re.compile(
+    r"(time|stamp|clock|heartbeat|latency|elapsed|wall|tick)|"
+    r"(_s|_ns|_ms|_ts|_at)$")
+
+_WALLCLOCK_LEAVES = {"time", "perf_counter", "perf_counter_ns", "monotonic",
+                     "monotonic_ns", "process_time", "time_ns"}
+
+
+def _is_wallclock_call(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fname = dotted_name(node.func)
+    if fname is None:
+        return False
+    parts = fname.split(".")
+    leaf = parts[-1]
+    if leaf not in _WALLCLOCK_LEAVES:
+        return False
+    # `time.time()` / bare `perf_counter()` / `datetime.now()`-free: a
+    # bare `time()` or a `time.*` head both count; `self.time()` doesn't.
+    return len(parts) == 1 or parts[0] in ("time", "datetime")
+
+
+def _mentions_wallclock(node, tainted: Set[str]) -> bool:
+    if node is None:
+        return False
+    if _is_wallclock_call(node):
+        return True
+    if isinstance(node, ast.Call):
+        # arguments handed to a telemetry sink are exempt; still look at
+        # the callee expression itself (e.g. tainted().pick())
+        leaf = node.func.attr if isinstance(node.func, ast.Attribute) \
+            else (dotted_name(node.func) or "").split(".")[-1]
+        if leaf in _SINK_LEAVES:
+            return False
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    return any(_mentions_wallclock(c, tainted)
+               for c in ast.iter_child_nodes(node))
+
+
+def _decision_functions(tree):
+    """(fn_node, why) for every decision-scope function: name pattern, or
+    any method of a class whose name pattern-matches."""
+    out = []
+
+    def visit(body, cls: Optional[str]):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _DECISION_FN_RE.search(node.name.lower()):
+                    out.append((node, f"decision function {node.name}()"))
+                elif cls is not None and not node.name.startswith("__"):
+                    out.append((node, f"method of decision class {cls}"))
+            elif isinstance(node, ast.ClassDef):
+                is_dec = bool(_DECISION_CLASS_RE.search(node.name))
+                visit(node.body, node.name if is_dec else None)
+
+    visit(tree.body, None)
+    return out
+
+
+def _walk_outside_inner(fn_node):
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _check_wallclock_decisions(ctx: LintContext, tree):
+    for fn_node, why in _decision_functions(tree):
+        # taint: names assigned from wall-clock reads, to a fixpoint
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            before = len(tainted)
+            for node in _walk_outside_inner(fn_node):
+                if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                    value = getattr(node, "value", None)
+                    if value is None or not _mentions_wallclock(value, tainted):
+                        continue
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Name):
+                                tainted.add(leaf.id)
+            changed = len(tainted) > before
+
+        for node in _walk_outside_inner(fn_node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if _mentions_wallclock(node.value, tainted):
+                    ctx.report("DT002", node,
+                               f"wall-clock value returned from {why} — "
+                               "replay runs at a different wall speed; "
+                               "decide on the step clock and keep clock "
+                               "reads in telemetry")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                value = getattr(node, "value", None)
+                if value is None or not _mentions_wallclock(value, tainted):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and not _TIMESTAMP_ATTR_RE.search(t.attr.lower()):
+                        ctx.report("DT002", node,
+                                   f"wall-clock value stored into state "
+                                   f"(.{t.attr}) of {why} — later decisions "
+                                   "inherit wall-speed nondeterminism; use "
+                                   "the step clock or a *_s/_ts timestamp "
+                                   "field for telemetry")
+
+
+# --- DT003 -----------------------------------------------------------------
+
+_RANDOM_SAMPLERS = {"random", "randint", "randrange", "choice", "choices",
+                    "shuffle", "sample", "uniform", "gauss", "normal",
+                    "getrandbits", "randn", "rand", "permutation",
+                    "standard_normal", "integers"}
+
+
+def _np_aliases(tree) -> Set[str]:
+    out = {"numpy"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "numpy":
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def _check_global_rng(ctx: LintContext, tree):
+    has_random_import = any(
+        isinstance(n, ast.Import) and any(a.name == "random" and not a.asname
+                                          for a in n.names)
+        for n in ast.walk(tree))
+    np_aliases = _np_aliases(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        if fname is None:
+            continue
+        parts = fname.split(".")
+        if len(parts) == 2 and parts[0] == "random" \
+                and parts[1] in _RANDOM_SAMPLERS and has_random_import:
+            ctx.report("DT003", node,
+                       f"{fname}() samples the process-global RNG — state "
+                       "is invisible to replay; use a seeded "
+                       "random.Random(seed) instance")
+        elif len(parts) == 3 and parts[0] in np_aliases \
+                and parts[1] == "random" and parts[2] in _RANDOM_SAMPLERS:
+            ctx.report("DT003", node,
+                       f"{fname}() samples numpy's global RNG — use a "
+                       "seeded np.random.default_rng(seed) Generator")
+
+
+# --- DT004 -----------------------------------------------------------------
+
+def _is_set_expr(node, set_names: Set[str]) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname is not None and fname.split(".")[-1] in ("set", "frozenset"):
+            return True
+    if isinstance(node, ast.Name):
+        return node.id in set_names
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        # set algebra: a | b, tracked - done ...
+        return _is_set_expr(node.left, set_names) \
+            or _is_set_expr(node.right, set_names)
+    return False
+
+
+def _check_unordered_iteration(ctx: LintContext, tree):
+    for fn_node, why in _decision_functions(tree):
+        set_names: Set[str] = set()
+        changed = True
+        while changed:
+            before = len(set_names)
+            for node in _walk_outside_inner(fn_node):
+                if isinstance(node, ast.Assign) \
+                        and _is_set_expr(node.value, set_names):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            set_names.add(t.id)
+            changed = len(set_names) > before
+
+        def iter_sites(fn_node):
+            for node in _walk_outside_inner(fn_node):
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    yield node, node.iter
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.DictComp, ast.GeneratorExp)):
+                    for gen in node.generators:
+                        yield node, gen.iter
+
+        for site, it in iter_sites(fn_node):
+            if _is_set_expr(it, set_names):
+                ctx.report("DT004", site,
+                           f"iteration over a set in {why} — order depends "
+                           "on the per-process hash salt, so dispatch/"
+                           "victim selection diverges across replicas; "
+                           "wrap in sorted()")
+
+
+# --- DT005 -----------------------------------------------------------------
+
+_DONATING_LEAF_RE = re.compile(
+    r"jit|donate|train_batch|train_step|grad_step|apply_grads|_step$|^step$")
+
+
+def _expr_base_names(node) -> Set[str]:
+    """Root identifiers mentioned by an expression: `params`,
+    `self.params` (as "self.params"), `state["p"]` (as "state")."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute):
+            d = dotted_name(n)
+            if d is not None:
+                out.add(d)
+        elif isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+def _check_asarray_of_donated(ctx: LintContext, tree):
+    np_aliases = _np_aliases(tree)
+    for fn_node in (n for n in ast.walk(tree)
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
+        asarray_calls = []      # (call_node, base names of its argument)
+        donated: Set[str] = set()
+        for node in _walk_outside_inner(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname is None:
+                continue
+            parts = fname.split(".")
+            if parts[0] in np_aliases and parts[-1] == "asarray" and node.args:
+                asarray_calls.append((node, _expr_base_names(node.args[0])))
+            elif _DONATING_LEAF_RE.search(parts[-1]):
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    donated |= _expr_base_names(arg)
+        for call, bases in asarray_calls:
+            hit = bases & donated
+            if hit:
+                ctx.report("DT005", call,
+                           f"np.asarray({sorted(hit)[0]}) is a zero-copy "
+                           "VIEW, and the same value feeds a donating/"
+                           "jitted step call in this function — donation "
+                           "invalidates the buffer under the view; use "
+                           "np.array (copy)")
+
+
+# --- entry point -----------------------------------------------------------
+
+def analyze(ctx: LintContext):
+    tree = ctx.tree
+    _check_salted_hash(ctx, tree)
+    _check_wallclock_decisions(ctx, tree)
+    _check_global_rng(ctx, tree)
+    _check_unordered_iteration(ctx, tree)
+    _check_asarray_of_donated(ctx, tree)

@@ -1,0 +1,455 @@
+"""Cross-artifact drift checker (the DR family): code vs docs.
+
+The config dataclasses (``runtime/config.py`` + the nested block modules)
+and the metrics the registry emits are both documented by hand —
+``docs/config.md`` and the ``docs/observability.md`` glossary — and 14
+PRs of subsystem growth is exactly how hand-kept docs rot. This pass
+parses BOTH sides statically (ast for the dataclasses and metric-name
+literals, a jsonc scanner for the doc blocks) and reports the diff:
+
+- DR001 undocumented-knob   a config dataclass field reachable from
+                            ``DeepSpeedConfig`` that no ``jsonc`` block
+                            in docs/config.md mentions
+- DR002 phantom-doc-knob    a documented key that no longer exists on
+                            the dataclass the docs nest it under
+- DR003 undocumented-metric a metric family (``fleet/...``) emitted
+                            through the registry but absent from
+                            docs/observability.md
+
+Free-form ``Dict[str, Any]`` blocks (optimizer.params, elasticity...)
+are boundary leaves: the block itself must be documented, its contents
+are not checked in either direction.
+
+Everything rides the normal Finding/fingerprint machinery, so existing
+drift can be triaged once into the baseline and only NEW drift fails
+CI. Stdlib-only like the rest of the package.
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, dotted_name, finalize_fingerprints
+
+RULES: Dict[str, str] = {
+    "DR001": "undocumented-knob: config dataclass field missing from "
+             "docs/config.md",
+    "DR002": "phantom-doc-knob: documented config key that no longer "
+             "exists on its dataclass",
+    "DR003": "undocumented-metric: metric family emitted in code but "
+             "absent from docs/observability.md",
+}
+
+# Modules that define config dataclasses reachable from DeepSpeedConfig.
+# Paths are relative to the repo root; missing entries are skipped so the
+# checker degrades gracefully on partial trees (unit-test fixtures).
+_CONFIG_MODULES = (
+    "deepspeed_tpu/runtime/config.py",
+    "deepspeed_tpu/serving/config.py",
+    "deepspeed_tpu/serving/paging/config.py",
+    "deepspeed_tpu/serving/qos.py",
+    "deepspeed_tpu/serving/fleet/config.py",
+    "deepspeed_tpu/serving/fleet/supervision.py",
+    "deepspeed_tpu/observability/config.py",
+    "deepspeed_tpu/runtime/resilience/config.py",
+    "deepspeed_tpu/runtime/tiering/config.py",
+)
+
+_ROOT_CLASS = "DeepSpeedConfig"
+_CONFIG_DOC = os.path.join("docs", "config.md")
+_METRICS_DOC = os.path.join("docs", "observability.md")
+
+_FREEFORM_RE = re.compile(r"\b(Dict|dict|Any|Mapping)\b")
+
+
+def repo_root() -> str:
+    """The checkout root, resolved from this module's location (never the
+    CWD — fingerprinted paths must not depend on the invocation dir)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# config side: dataclass field trees
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Field:
+    name: str
+    lineno: int
+    annotation: str
+    nested_class: Optional[str] = None   # resolved *Config class name
+    freeform: bool = False               # Dict/Any boundary leaf
+
+
+@dataclass
+class _ConfigClass:
+    name: str
+    path: str                            # repo-relative module path
+    lineno: int
+    fields: "Dict[str, _Field]" = field(default_factory=dict)
+
+
+def _annotation_config_class(node) -> Optional[str]:
+    """The *Config identifier inside an annotation like
+    ``Optional[PagingConfig]``, else None."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id.endswith("Config"):
+            return n.id
+    return None
+
+
+def _post_init_bindings(cls_node) -> Dict[str, str]:
+    """field -> class for __post_init__/from_dict conversion patterns:
+    ``self.f = SomeConfig(**self.f)`` and
+    ``dict_to_dataclass(SomeConfig, self.f, ...)``."""
+    out: Dict[str, str] = {}
+    for fn in cls_node.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and isinstance(node.value, ast.Call)):
+                        cname = dotted_name(node.value.func)
+                        if cname and cname.split(".")[-1].endswith("Config"):
+                            out[t.attr] = cname.split(".")[-1]
+            elif isinstance(node, ast.Call):
+                cname = dotted_name(node.func)
+                if cname is None or cname.split(".")[-1] != "dict_to_dataclass":
+                    continue
+                cls_arg = node.args[0] if node.args else None
+                val_arg = node.args[1] if len(node.args) > 1 else None
+                if (isinstance(cls_arg, ast.Name)
+                        and cls_arg.id.endswith("Config")
+                        and isinstance(val_arg, ast.Attribute)
+                        and isinstance(val_arg.value, ast.Name)
+                        and val_arg.value.id == "self"):
+                    out[val_arg.attr] = cls_arg.id
+    return out
+
+
+def parse_config_classes(root: str) -> Dict[str, _ConfigClass]:
+    """Every @dataclass in the config module list, fields resolved."""
+    classes: Dict[str, _ConfigClass] = {}
+    for rel in _CONFIG_MODULES:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8") as f:
+            try:
+                tree = ast.parse(f.read())
+            except SyntaxError:
+                continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not any((dotted_name(d) or "").split(".")[-1] == "dataclass"
+                       for d in node.decorator_list):
+                continue
+            cc = _ConfigClass(node.name, rel.replace(os.sep, "/"), node.lineno)
+            bindings = _post_init_bindings(node)
+            for stmt in node.body:
+                if not (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)):
+                    continue
+                fname = stmt.target.id
+                if fname.startswith("_"):
+                    continue
+                ann = ast.unparse(stmt.annotation)
+                nested = (_annotation_config_class(stmt.annotation)
+                          or bindings.get(fname))
+                cc.fields[fname] = _Field(
+                    name=fname, lineno=stmt.lineno, annotation=ann,
+                    nested_class=nested,
+                    freeform=(nested is None
+                              and _FREEFORM_RE.search(ann) is not None))
+            classes.setdefault(node.name, cc)
+    return classes
+
+
+def config_knob_paths(classes: Dict[str, _ConfigClass],
+                      root_class: str = _ROOT_CLASS
+                      ) -> Dict[str, Tuple[str, int, bool]]:
+    """dotted knob path -> (module path, lineno, freeform) for every field
+    reachable from the root config class."""
+    out: Dict[str, Tuple[str, int, bool]] = {}
+    if root_class not in classes:
+        return out
+
+    def walk(cls_name: str, prefix: str, seen: Set[str]):
+        cc = classes.get(cls_name)
+        if cc is None or cls_name in seen:
+            return
+        seen = seen | {cls_name}
+        for f in cc.fields.values():
+            path = f"{prefix}{f.name}"
+            out[path] = (cc.path, f.lineno, f.freeform)
+            if f.nested_class is not None:
+                walk(f.nested_class, path + ".", seen)
+
+    walk(root_class, "", set())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# docs side: jsonc key paths
+# ---------------------------------------------------------------------------
+
+def _jsonc_blocks(md_text: str):
+    """(start_line, block_text) for every ```jsonc fenced block."""
+    lines = md_text.splitlines()
+    blocks = []
+    i = 0
+    while i < len(lines):
+        if lines[i].strip().startswith("```jsonc"):
+            start = i + 1
+            j = start
+            while j < len(lines) and not lines[j].strip().startswith("```"):
+                j += 1
+            blocks.append((start + 1, "\n".join(lines[start:j])))
+            i = j + 1
+        else:
+            i += 1
+    return blocks
+
+
+def _strip_jsonc_comments(text: str) -> str:
+    """Remove // comments (outside strings), preserving line structure."""
+    out = []
+    in_str = False
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if in_str:
+            out.append(c)
+            if c == "\\" and i + 1 < n:
+                out.append(text[i + 1])
+                i += 2
+                continue
+            if c == '"':
+                in_str = False
+            i += 1
+            continue
+        if c == '"':
+            in_str = True
+            out.append(c)
+            i += 1
+            continue
+        if c == "/" and i + 1 < n and text[i + 1] == "/":
+            while i < n and text[i] != "\n":
+                i += 1
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def jsonc_key_paths(block_text: str, first_line: int = 1) -> Dict[str, int]:
+    """dotted key path -> line for every key in one jsonc block. Array
+    contents do not extend the path (list-valued knobs are leaves)."""
+    text = _strip_jsonc_comments(block_text)
+    paths: Dict[str, int] = {}
+    stack: List[Optional[str]] = []      # object nesting: key per level
+    pending: Optional[str] = None        # key waiting for its value
+    in_array = 0
+    line = first_line
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c == "\n":
+            line += 1
+            i += 1
+            continue
+        if c == '"':
+            j = i + 1
+            buf = []
+            while j < n and text[j] != '"':
+                if text[j] == "\\":
+                    j += 1
+                buf.append(text[j])
+                j += 1
+            # key or value? a key is followed by ':'
+            k = j + 1
+            while k < n and text[k] in " \t":
+                k += 1
+            if k < n and text[k] == ":" and not in_array:
+                pending = "".join(buf)
+                key_path = ".".join([s for s in stack if s] + [pending])
+                paths.setdefault(key_path, line)
+            else:
+                pending = None           # string value consumed
+            i = j + 1
+            continue
+        if c == "{":
+            if in_array:
+                stack.append(None)
+            else:
+                stack.append(pending)
+                pending = None
+            i += 1
+            continue
+        if c == "}":
+            if stack:
+                stack.pop()
+            i += 1
+            continue
+        if c == "[":
+            in_array += 1
+            pending = None
+            i += 1
+            continue
+        if c == "]":
+            in_array = max(0, in_array - 1)
+            i += 1
+            continue
+        if c not in " \t,:":
+            pending = None               # scalar value consumed
+        i += 1
+    return paths
+
+
+def documented_knob_paths(root: str) -> Dict[str, int]:
+    """Every key path documented in docs/config.md's jsonc blocks."""
+    doc = os.path.join(root, _CONFIG_DOC)
+    if not os.path.isfile(doc):
+        return {}
+    with open(doc, encoding="utf-8") as f:
+        text = f.read()
+    paths: Dict[str, int] = {}
+    for first_line, block in _jsonc_blocks(text):
+        for p, line in jsonc_key_paths(block, first_line).items():
+            paths.setdefault(p, line)
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# metrics side
+# ---------------------------------------------------------------------------
+
+_METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _metric_name_literal(node) -> Optional[str]:
+    """The (prefix of the) metric-name literal of a registry call: plain
+    string, or the constant head of an f-string (``f"fleet/{x}"``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value
+    return None
+
+
+def emitted_metric_families(root: str,
+                            package: str = "deepspeed_tpu"
+                            ) -> Dict[str, Tuple[str, int, str]]:
+    """family -> (module path, line, full first name) for every metric
+    name emitted through registry counter()/gauge()/histogram() calls."""
+    from .core import iter_python_files
+    out: Dict[str, Tuple[str, int, str]] = {}
+    pkg_dir = os.path.join(root, package)
+    if not os.path.isdir(pkg_dir):
+        return out
+    for path in iter_python_files([pkg_dir]):
+        with open(path, encoding="utf-8") as f:
+            try:
+                tree = ast.parse(f.read())
+            except SyntaxError:
+                continue
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METRIC_METHODS
+                    and node.args):
+                continue
+            name = _metric_name_literal(node.args[0])
+            if name is None or "/" not in name:
+                continue
+            family = name.split("/")[0]
+            out.setdefault(family, (rel, node.lineno, name))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the drift pass
+# ---------------------------------------------------------------------------
+
+def analyze_drift(root: Optional[str] = None) -> List[Finding]:
+    """Run all three drift checks over one checkout. ``root`` defaults to
+    the repo this module lives in; unit tests point it at synthetic
+    trees. Paths in the findings are repo-relative."""
+    root = root or repo_root()
+    findings: List[Finding] = []
+
+    classes = parse_config_classes(root)
+    knobs = config_knob_paths(classes)
+    docs = documented_knob_paths(root)
+
+    # DR001: knob in code, absent from docs. A free-form block's children
+    # are out of scope, and so are children of any undocumented parent
+    # already reported (one finding per missing subtree root).
+    freeform_prefixes = tuple(
+        p + "." for p, (_, _, ff) in knobs.items() if ff)
+    missing = sorted(p for p in knobs
+                     if p not in docs
+                     and not p.startswith(freeform_prefixes))
+    reported: List[str] = []
+    for p in missing:
+        if any(p.startswith(r + ".") for r in reported):
+            continue
+        reported.append(p)
+        mod_path, lineno, _ = knobs[p]
+        findings.append(Finding(
+            rule="DR001", path=mod_path, line=lineno, col=0,
+            message=f"config knob '{p}' is not documented in "
+                    f"docs/config.md",
+            source_line=f"knob {p}"))
+
+    # DR002: documented key that the dataclass tree does not know.
+    doc_rel = _CONFIG_DOC.replace(os.sep, "/")
+    known_prefixes = tuple(p + "." for p, (_, _, ff) in knobs.items() if ff)
+    phantom_roots: List[str] = []
+    for p in sorted(docs):
+        if p in knobs or p.startswith(known_prefixes):
+            continue
+        # only check keys whose PARENT resolves to a known dataclass —
+        # fragments documenting non-config JSON (none today) stay out
+        parent = p.rsplit(".", 1)[0] if "." in p else ""
+        parent_known = parent == "" or parent in knobs
+        if not parent_known:
+            continue
+        if any(p.startswith(r + ".") for r in phantom_roots):
+            continue
+        phantom_roots.append(p)
+        findings.append(Finding(
+            rule="DR002", path=doc_rel, line=docs[p], col=0,
+            message=f"documented config key '{p}' does not exist on the "
+                    f"dataclass tree (moved or deleted?)",
+            source_line=f"doc-key {p}"))
+
+    # DR003: emitted metric family absent from the observability glossary.
+    metrics_doc = os.path.join(root, _METRICS_DOC)
+    doc_text = ""
+    if os.path.isfile(metrics_doc):
+        with open(metrics_doc, encoding="utf-8") as f:
+            doc_text = f.read()
+    for family, (mod_path, lineno, name) in sorted(
+            emitted_metric_families(root).items()):
+        if f"{family}/" in doc_text:
+            continue
+        findings.append(Finding(
+            rule="DR003", path=mod_path, line=lineno, col=0,
+            message=f"metric family '{family}/' (e.g. '{name}') is "
+                    f"emitted but undocumented in docs/observability.md",
+            source_line=f"metric-family {family}"))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return finalize_fingerprints(findings)

@@ -101,13 +101,18 @@ def _leaf_spec(x):
     return None
 
 
-def validate_spec_tree(specs, mesh, shapes=None, where: str = "specs") -> List[str]:
+def validate_spec_tree(specs, mesh, shapes=None, where: str = "specs",
+                       extra_axes: Optional[Sequence[str]] = None) -> List[str]:
     """Validate every PartitionSpec/NamedSharding leaf of a tree. When
     ``shapes`` (a matching tree of shaped leaves) is given, divisibility
-    is checked too."""
+    is checked too. ``extra_axes`` are accepted as declared size-1 axes
+    beyond the mesh's (the ``validate_sharding_extra_axes`` knob): specs
+    written for a larger target mesh then validate on a small host mesh."""
     import jax
 
     mesh_shape = dict(mesh.shape)
+    for a in extra_axes or ():
+        mesh_shape.setdefault(a, 1)
     problems: List[str] = []
     leaves = jax.tree_util.tree_flatten_with_path(
         specs, is_leaf=lambda x: _leaf_spec(x) is not None)[0]
@@ -231,20 +236,24 @@ def validate_engine_sharding(engine) -> None:
     from ..utils.logging import logger
 
     mesh = engine.mesh
+    extra_axes = tuple(getattr(getattr(engine, "config", None),
+                               "validate_sharding_extra_axes", None) or ())
     problems: List[str] = []
     problems += validate_spec_tree(engine.param_specs, mesh,
                                    shapes=getattr(engine, "_param_shapes", None),
-                                   where="params")
+                                   where="params", extra_axes=extra_axes)
     opt = getattr(engine, "opt_shardings", None)
     if opt:
-        problems += validate_spec_tree(opt, mesh, where="opt_state")
+        problems += validate_spec_tree(opt, mesh, where="opt_state",
+                                       extra_axes=extra_axes)
         problems += validate_param_opt_consistency(
             engine.param_specs, opt, mesh,
             param_shapes=getattr(engine, "_param_shapes", None),
             zero_stage=getattr(engine, "zero_stage", 0))
     grads = getattr(engine, "grad_shardings", None)
     if grads is not None:
-        problems += validate_spec_tree(grads, mesh, where="grads")
+        problems += validate_spec_tree(grads, mesh, where="grads",
+                                       extra_axes=extra_axes)
 
     warnings = [p for p in problems if p.startswith("WARNING")]
     errors = [p for p in problems if not p.startswith("WARNING")]

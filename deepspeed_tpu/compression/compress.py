@@ -183,7 +183,11 @@ class Compressor:
                 out = [self._project_leaf(jax.tree_util.keystr(p), w, step)
                        for p, w in flat]
                 return jax.tree.unflatten(treedef, out)
-            self._jitted[phase] = jax.jit(project)
+            from ..observability.programs import track_program
+            tag = "".join("1" if t else "0" for t in phase)
+            self._jitted[phase] = track_program(
+                f"compression/project_{tag}", jax.jit(project),
+                subsystem="compression")
         return self._jitted[phase](params)
 
 

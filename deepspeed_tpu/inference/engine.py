@@ -160,10 +160,15 @@ class InferenceEngine:
         key = ("forward", tuple(sorted(static.items())))
         if key not in self._compiled:
             module, transform = self.module, self._param_transform
-            self._compiled[key] = jax.jit(
-                lambda p, a, kw: module.apply(
-                    {"params": transform(p) if transform else p},
-                    *a, **kw, **static))
+            from ..observability.programs import track_program
+            statics = ",".join(f"{k}={v}" for k, v in sorted(static.items()))
+            self._compiled[key] = track_program(
+                f"inference/forward[{statics}]",
+                jax.jit(
+                    lambda p, a, kw: module.apply(
+                        {"params": transform(p) if transform else p},
+                        *a, **kw, **static)),
+                subsystem="inference")
         from ..models.layers import activation_quantization_suspended
         with activation_quantization_suspended():
             return self._compiled[key](self.params, args, arrays)

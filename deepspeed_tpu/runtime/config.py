@@ -363,6 +363,11 @@ class DeepSpeedConfig:
     # live mesh (declared axes, one-dim-per-axis, divisibility, opt state
     # extending the param spec). See docs/analysis.md.
     validate_sharding: bool = False
+    # extra logical axis names the validator accepts beyond the live
+    # mesh's, treated as size 1 — lets specs written for a larger target
+    # mesh validate on a small host mesh, mirroring the lint packs'
+    # KNOWN_AXES vocabulary so the static and runtime checks agree
+    validate_sharding_extra_axes: List[str] = field(default_factory=list)
 
     activation_checkpointing: ActivationCheckpointingConfig = field(
         default_factory=ActivationCheckpointingConfig)
@@ -509,6 +514,12 @@ class DeepSpeedConfig:
                 "loss scaling (unscale needs fp32 headroom)")
         if self.gradient_clipping < 0:
             raise DeepSpeedConfigError("gradient_clipping must be >= 0")
+        if (not isinstance(self.validate_sharding_extra_axes, (list, tuple))
+                or not all(isinstance(a, str) and a
+                           for a in self.validate_sharding_extra_axes)):
+            raise DeepSpeedConfigError(
+                "validate_sharding_extra_axes must be a list of non-empty "
+                f"axis-name strings, got {self.validate_sharding_extra_axes!r}")
         if self.zero_optimization.stage > 0 and not (self.fp16.enabled or self.bf16.enabled):
             logger.info("ZeRO enabled with fp32 training (no fp16/bf16 block)")
         if self.tiering is not None and self.tiering.enabled:

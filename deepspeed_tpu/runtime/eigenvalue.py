@@ -17,6 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..observability.programs import track_program
 from ..utils.logging import logger
 
 
@@ -85,10 +86,16 @@ class Eigenvalue:
                 for i, (x, m) in enumerate(zip(flat_p, flat_m))])
             v, _ = _normalize(v)
 
-            @jax.jit
             def power_step(v):
                 hv = masked(hvp(params, v))
                 return _normalize(hv)
+            # one program PER BLOCK by construction (each closes over its
+            # own mask/hvp); re-registering the name per block keeps the
+            # registry pointing at the live program
+            power_step = track_program(
+                "eigenvalue/power_step",
+                jax.jit(power_step),  # ds-tpu: lint-ok[CC002]
+                subsystem="eigenvalue")
 
             eig_prev = jnp.float32(0.0)
             eig = jnp.float32(0.0)

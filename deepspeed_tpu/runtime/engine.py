@@ -535,10 +535,13 @@ class DeepSpeedEngine:
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), values_abs)
             self._build_param_shardings()
             # jit-init directly into the sharded layout (no host round-trip)
-            init_fn = jax.jit(
-                lambda r: extract_logical_names(
-                    self.module.init(r, **_init_kwargs(sample_batch)))[0],
-                out_shardings=self.param_shardings)
+            init_fn = track_program(
+                "train/param_init",
+                jax.jit(
+                    lambda r: extract_logical_names(
+                        self.module.init(r, **_init_kwargs(sample_batch)))[0],
+                    out_shardings=self.param_shardings),
+                subsystem="train")
             self.params = init_fn(init_rng)
         else:
             values, names = extract_logical_names(params)

@@ -145,12 +145,15 @@ class PipelineEngine(DeepSpeedEngine):
                 out_shardings=self.param_shardings)(params)
             return
 
-        init_fn = jax.jit(
-            lambda: jax.tree.map(
-                lambda t: t,
-                {k: extract_logical_names(v)[0] for k, v in
-                 zip(("embed", "blocks", "head"), build_abstract())}),
-            out_shardings=self.param_shardings)
+        init_fn = track_program(
+            "pipe/param_init",
+            jax.jit(
+                lambda: jax.tree.map(
+                    lambda t: t,
+                    {k: extract_logical_names(v)[0] for k, v in
+                     zip(("embed", "blocks", "head"), build_abstract())}),
+                out_shardings=self.param_shardings),
+            subsystem="pipe")
         self.params = init_fn()
 
     def _build_param_shardings(self):

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 from ... import comm as dist
 from ...observability.goodput import timed as _goodput
+from ...observability.programs import track_program
 from ...observability.trace import span as _span
 from ...utils.logging import log_dist
 from ..config import DeepSpeedConfig
@@ -234,7 +235,9 @@ class HostDrivenPipelineEngine:
     def _fwd_prog(self, s):
         key = ("fwd", s)
         if key not in self._compiled:
-            self._compiled[key] = jax.jit(self._stage_forward(s))
+            self._compiled[key] = track_program(
+                f"pipe_host/fwd_stage{s}", jax.jit(self._stage_forward(s)),
+                subsystem="pipe_host")
         return self._compiled[key]
 
     def _last_fwd_prog(self):
@@ -245,7 +248,8 @@ class HostDrivenPipelineEngine:
 
             def run(stage_params, x, batch):
                 return loss_fn(fwd(stage_params, x), batch)
-            self._compiled[key] = jax.jit(run)
+            self._compiled[key] = track_program(
+                "pipe_host/fwd_last", jax.jit(run), subsystem="pipe_host")
         return self._compiled[key]
 
     def _bwd_prog(self, s):
@@ -258,7 +262,9 @@ class HostDrivenPipelineEngine:
             def run(stage_params, x, cot):
                 _, vjp = jax.vjp(fwd, stage_params, x)
                 return vjp(cot)
-            self._compiled[key] = jax.jit(run)
+            self._compiled[key] = track_program(
+                f"pipe_host/bwd_stage{s}", jax.jit(run),
+                subsystem="pipe_host")
         return self._compiled[key]
 
     def _last_bwd_prog(self):
@@ -272,7 +278,8 @@ class HostDrivenPipelineEngine:
                     return loss_fn(fwd(p, xx), batch)
                 _, vjp = jax.vjp(f, stage_params, x)
                 return vjp(jnp.float32(1.0 / self.micro_batches))
-            self._compiled[key] = jax.jit(run)
+            self._compiled[key] = track_program(
+                "pipe_host/bwd_last", jax.jit(run), subsystem="pipe_host")
         return self._compiled[key]
 
     # -- the executor --------------------------------------------------
@@ -410,7 +417,9 @@ class HostDrivenPipelineEngine:
                 updates, new_state = optimizer.update(grads, opt_state,
                                                       params)
                 return optax.apply_updates(params, updates), new_state
-            self._compiled["opt_step"] = jax.jit(step, donate_argnums=(0, 1))
+            self._compiled["opt_step"] = track_program(
+                "pipe_host/opt_step", jax.jit(step, donate_argnums=(0, 1)),
+                subsystem="pipe_host")
         self.params, self.optimizer_state = self._compiled["opt_step"](
             self.params, self.optimizer_state, grads)
 

@@ -88,7 +88,13 @@ class MoQQuantizer:
                         if (b < 16 and hasattr(w, "ndim") and w.ndim >= 2
                             and jnp.issubdtype(w.dtype, jnp.floating)) else w
                         for w, b in zip(leaves, leaf_bits)]
-            self._jitted[key] = jax.jit(project)
+            import zlib
+            from ..observability.programs import track_program
+            # crc32, not hash(): registry names must agree across
+            # processes (PYTHONHASHSEED salts hash() per process)
+            tag = f"{zlib.crc32(repr(key).encode()):08x}"
+            self._jitted[key] = track_program(
+                f"moq/project_{tag}", jax.jit(project), subsystem="moq")
         if self.config.quantize_verbose:
             logger.info(f"MoQ: step {step} -> bits {sorted(set(leaf_bits))}")
         return jax.tree.unflatten(treedef,

@@ -202,7 +202,11 @@ def test_rule_fires_on_seeded_violation_and_not_on_clean(rule, bad, good):
 
 def test_every_registered_rule_has_a_fixture():
     covered = {r for r, _, _ in FIXTURES}
-    assert covered == set(all_rules()), \
+    # DT/CC fixtures live in test_analysis_determinism.py (which carries
+    # its own completeness assertion); DR rules are exercised there on
+    # synthetic repo trees rather than source fixtures.
+    legacy = {r for r in all_rules() if r[:2] not in ("DT", "CC", "DR")}
+    assert covered == legacy, \
         "every rule needs a seeded-violation fixture"
 
 

@@ -1,0 +1,648 @@
+"""Determinism (DT) + compile-cache (CC) lint packs and the cross-artifact
+drift checker (DR): every new rule must fire on a seeded violation and stay
+quiet on the clean equivalent, suppression must triage, the drift pass must
+diff synthetic code/doc trees correctly, --changed-only must scope both the
+run and the baseline, and the real repo must be clean (incl. --drift)
+against the committed baseline (ISSUE 17 acceptance criteria). Host-only —
+nothing here touches jax at runtime."""
+
+import json
+import os
+import shutil
+import subprocess
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.analysis import (analyze_drift, analyze_source, all_rules,
+                                    save_baseline)
+from deepspeed_tpu.analysis.cli import main as lint_main
+from deepspeed_tpu.analysis.drift import (config_knob_paths,
+                                          documented_knob_paths,
+                                          emitted_metric_families,
+                                          jsonc_key_paths,
+                                          parse_config_classes)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+GIT = shutil.which("git")
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def src(body):
+    return textwrap.dedent(body)
+
+
+# ---------------------------------------------------------------------------
+# rule fixtures: (rule, seeded violation, clean equivalent)
+# ---------------------------------------------------------------------------
+
+FIXTURES = [
+    ("DT001",  # the PR 3 request-id bug: salted hash() in an id fold
+     """
+     def bucket_of(request_id, buckets):
+         return hash(request_id) % buckets
+     """,
+     """
+     import zlib
+
+     def bucket_of(request_id, buckets):
+         return zlib.crc32(request_id.encode()) % buckets
+     """),
+    ("DT002",  # wall-clock taint returned from a routing decision
+     """
+     import time
+
+     class ReplicaRouter:
+         def route(self, replicas):
+             started = time.monotonic()
+             return int(started) % len(replicas)
+     """,
+     """
+     import time
+
+     class ReplicaRouter:
+         def route(self, replicas, step):
+             self.last_route_ts = time.monotonic()   # telemetry stamp: fine
+             return step % len(replicas)
+     """),
+    ("DT002",  # wall-clock stored into decision state (non-timestamp attr)
+     """
+     import time
+
+     def schedule_next(self, queue):
+         self.priority = time.time()
+         return queue[0]
+     """,
+     """
+     import time
+
+     def schedule_next(self, queue):
+         self.started_at = time.time()               # *_at timestamp: fine
+         return queue[0]
+     """),
+    ("DT003",
+     """
+     import random
+
+     def jitter_steps():
+         return random.randint(0, 7)
+     """,
+     """
+     import random
+
+     _RNG = random.Random(0)
+
+     def jitter_steps():
+         return _RNG.randint(0, 7)
+     """),
+    ("DT003",  # numpy's global RNG, incl. the aliased import
+     """
+     import numpy as np
+
+     def noise(shape):
+         return np.random.normal(size=shape)
+     """,
+     """
+     import numpy as np
+
+     def noise(shape, seed=0):
+         return np.random.default_rng(seed).normal(size=shape)
+     """),
+    ("DT004",
+     """
+     def pick_victim(self, active, protected):
+         candidates = set(active) - set(protected)
+         for slot in candidates:
+             return slot
+     """,
+     """
+     def pick_victim(self, active, protected):
+         candidates = set(active) - set(protected)
+         for slot in sorted(candidates):
+             return slot
+     """),
+    ("DT005",  # the PR 4 bug: asarray view of a donated buffer
+     """
+     import numpy as np
+
+     def snapshot_and_step(params, batch, train_step):
+         before = np.asarray(params)
+         params = train_step(params, batch)
+         return before, params
+     """,
+     """
+     import numpy as np
+
+     def snapshot_and_step(params, batch, train_step):
+         before = np.array(params)                   # a copy survives donation
+         params = train_step(params, batch)
+         return before, params
+     """),
+    ("CC001",  # jit stored without the PR 7 registry wrapper
+     """
+     import jax
+
+     def build_program(fn):
+         prog = jax.jit(fn, donate_argnums=(0,))
+         return prog
+     """,
+     """
+     import jax
+     from deepspeed_tpu.observability.programs import track_program
+
+     def build_program(fn):
+         prog = track_program("demo/prog", jax.jit(fn, donate_argnums=(0,)),
+                              subsystem="demo")
+         return prog
+     """),
+    ("CC001",  # decorator form bypasses track_program entirely
+     """
+     import jax
+
+     @jax.jit
+     def forward(params, tokens):
+         return params, tokens
+     """,
+     """
+     import jax
+
+     def forward(params, tokens):
+         return params, tokens
+     """),
+    ("CC002",  # fresh jit object per decode step = retrace every dispatch
+     """
+     import jax
+
+     class Engine:
+         def decode_step(self, fn, tokens):
+             prog = jax.jit(fn)
+             return prog(tokens)
+     """,
+     """
+     import jax
+     from deepspeed_tpu.observability.programs import track_program
+
+     class Engine:
+         def decode_step(self, fn, tokens):
+             if "decode" not in self._compiled:
+                 self._compiled["decode"] = track_program(
+                     "engine/decode", jax.jit(fn), subsystem="engine")
+             return self._compiled["decode"](tokens)
+     """),
+    ("CC002",  # jit inside a loop body
+     """
+     import jax
+     from deepspeed_tpu.observability.programs import track_program
+
+     def run(fns, x):
+         out = []
+         for fn in fns:
+             prog = track_program("run/prog", jax.jit(fn))
+             out.append(prog(x))
+         return out
+     """,
+     """
+     import jax
+     from deepspeed_tpu.observability.programs import track_program
+
+     def run(fns, x):
+         progs = [track_program(f"run/prog{i}", jax.jit(fn))
+                  for i, fn in enumerate(fns)]
+         return [prog(x) for prog, _ in zip(progs, fns)]
+     """),
+    ("CC003",  # interpolated static arg: per-value retrace bomb
+     """
+     import jax
+     from deepspeed_tpu.observability.programs import track_program
+
+     def build_and_call(fn, x, mode):
+         prog = track_program("m/p", jax.jit(fn, static_argnames=("mode",)))
+         return prog(x, mode=f"mode-{mode}")
+     """,
+     """
+     import jax
+     from deepspeed_tpu.observability.programs import track_program
+
+     def build_and_call(fn, x, mode):
+         prog = track_program("m/p", jax.jit(fn, static_argnames=("mode",)))
+         return prog(x, mode=mode)
+     """),
+]
+
+
+@pytest.mark.parametrize("rule,bad,good", FIXTURES,
+                         ids=[f"{r}-{i}" for i, (r, _, _) in enumerate(FIXTURES)])
+def test_rule_fires_on_seeded_violation_and_not_on_clean(rule, bad, good):
+    bad_findings = analyze_source(src(bad), path="seeded.py")
+    assert rule in rules_of(bad_findings), \
+        f"{rule} did not fire on seeded violation: {bad_findings}"
+    good_findings = analyze_source(src(good), path="clean.py")
+    assert rule not in rules_of(good_findings), \
+        f"{rule} false-positive on clean equivalent: {good_findings}"
+
+
+def test_every_new_source_rule_has_a_fixture():
+    covered = {r for r, _, _ in FIXTURES}
+    registered = {r for r in all_rules() if r[:2] in ("DT", "CC")}
+    assert covered == registered, \
+        "every DT/CC rule needs a seeded-violation fixture here"
+
+
+def test_drift_rules_are_registered():
+    assert {"DR001", "DR002", "DR003"} <= set(all_rules())
+
+
+# ---------------------------------------------------------------------------
+# targeted false-positive guards (the in-tree idioms that must stay clean)
+# ---------------------------------------------------------------------------
+
+def test_dt002_sink_calls_are_exempt():
+    """perf_counter handed to a telemetry sink is a measurement."""
+    code = src("""
+    import time
+
+    class QosScheduler:
+        def admit(self, req, metrics):
+            metrics.observe(time.perf_counter())
+            return req.priority >= 0
+    """)
+    assert "DT002" not in rules_of(analyze_source(code))
+
+
+def test_dt004_dict_iteration_is_deterministic():
+    """Python dicts iterate in insertion order — only sets are flagged."""
+    code = src("""
+    def dispatch(self, pending):
+        order = {}
+        for req in pending:
+            order[req] = True
+        for req in order:
+            yield req
+    """)
+    assert "DT004" not in rules_of(analyze_source(code))
+
+
+def test_cc001_immediate_invocation_and_return_are_exempt():
+    code = src("""
+    import jax
+
+    def init_params(rng, shape):
+        return jax.jit(lambda r: r * 2)(rng)
+
+    def make_step(fn):
+        return jax.jit(fn, donate_argnums=(0,))
+    """)
+    assert "CC001" not in rules_of(analyze_source(code))
+
+
+def test_cc002_builder_functions_are_exempt():
+    """_make_train_step-style one-shot builders are not the step path."""
+    code = src("""
+    import jax
+    from deepspeed_tpu.observability.programs import track_program
+
+    class Engine:
+        def _make_train_step(self, fn):
+            step = jax.jit(fn, donate_argnums=(0,))
+            return track_program("train/step", step)
+    """)
+    assert "CC002" not in rules_of(analyze_source(code))
+
+
+def test_cc003_needs_a_static_argnames_vocabulary():
+    """Interpolated kwargs that never appear in static_argnames are fine."""
+    code = src("""
+    def render(template, name):
+        return template.render(title=f"run-{name}")
+    """)
+    assert "CC003" not in rules_of(analyze_source(code))
+
+
+# ---------------------------------------------------------------------------
+# suppression coverage for the new rules
+# ---------------------------------------------------------------------------
+
+def test_inline_pragma_suppresses_dt_rule():
+    code = src("""
+    def bucket_of(request_id):
+        return hash(request_id) % 8  # ds-tpu: lint-ok[DT001]
+    """)
+    assert "DT001" not in rules_of(analyze_source(code))
+
+
+def test_inline_pragma_suppresses_cc_rule():
+    code = src("""
+    import jax
+
+    @jax.jit  # ds-tpu: lint-ok[CC001]
+    def forward(params, tokens):
+        return params, tokens
+    """)
+    assert "CC001" not in rules_of(analyze_source(code))
+
+
+def test_lint_ok_decorator_suppresses_new_rules():
+    code = src("""
+    from deepspeed_tpu.analysis import lint_ok
+
+    @lint_ok("DT001", "DT003")
+    def legacy(request_id):
+        import random
+        return hash(request_id) + random.random()
+    """)
+    found = rules_of(analyze_source(code))
+    assert "DT001" not in found and "DT003" not in found
+
+
+def test_pragma_for_other_rule_does_not_suppress_dt():
+    code = src("""
+    def bucket_of(request_id):
+        return hash(request_id) % 8  # ds-tpu: lint-ok[TS001]
+    """)
+    assert "DT001" in rules_of(analyze_source(code))
+
+
+# ---------------------------------------------------------------------------
+# drift checker units over synthetic repo trees
+# ---------------------------------------------------------------------------
+
+SYNTH_CONFIG = src("""
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class SubConfig:
+    alpha: int = 1
+    beta: bool = False
+
+
+@dataclass
+class DeepSpeedConfig:
+    knob: int = 0
+    sub: Optional[SubConfig] = None
+    extras: Dict[str, Any] = field(default_factory=dict)
+    _private: int = 0
+""")
+
+SYNTH_METRICS = src("""
+def emit(reg):
+    reg.counter("widgets/built_total").inc()
+    reg.gauge("train/loss").set(0.0)
+""")
+
+
+def _write_synth_repo(root, config_doc, obs_doc):
+    pkg = root / "deepspeed_tpu" / "runtime"
+    pkg.mkdir(parents=True)
+    (pkg / "config.py").write_text(SYNTH_CONFIG)
+    (root / "deepspeed_tpu" / "metrics_mod.py").write_text(SYNTH_METRICS)
+    docs = root / "docs"
+    docs.mkdir()
+    (docs / "config.md").write_text(config_doc)
+    (docs / "observability.md").write_text(obs_doc)
+
+
+COMPLETE_CONFIG_DOC = src("""
+# Config
+
+```jsonc
+{
+  "knob": 0,            // a knob
+  "sub": {"alpha": 1, "beta": false},
+  "extras": {"anything": true}   // free-form: contents unchecked
+}
+```
+""")
+
+COMPLETE_OBS_DOC = "glossary: `widgets/built_total`, `train/loss`\n"
+
+
+def test_drift_clean_on_complete_synthetic_docs(tmp_path):
+    _write_synth_repo(tmp_path, COMPLETE_CONFIG_DOC, COMPLETE_OBS_DOC)
+    assert analyze_drift(root=str(tmp_path)) == []
+
+
+def test_drift_reports_all_three_rules(tmp_path):
+    drifted_doc = src("""
+    # Config
+
+    ```jsonc
+    {
+      "knob": 0,
+      "sub": {"alpha": 1},          // beta missing -> DR001
+      "extras": {"anything": true},
+      "ghost": {"x": 1}             // deleted knob -> DR002 (collapsed)
+    }
+    ```
+    """)
+    _write_synth_repo(tmp_path, drifted_doc, "only `train/` here\n")
+    findings = analyze_drift(root=str(tmp_path))
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert sorted(by_rule) == ["DR001", "DR002", "DR003"], findings
+    [dr1] = by_rule["DR001"]
+    assert "sub.beta" in dr1.message
+    assert dr1.path == "deepspeed_tpu/runtime/config.py"
+    [dr2] = by_rule["DR002"]        # ghost.x collapsed into its root
+    assert "'ghost'" in dr2.message and dr2.path == "docs/config.md"
+    [dr3] = by_rule["DR003"]
+    assert "widgets/" in dr3.message
+
+
+def test_drift_undocumented_subtree_collapses_to_root(tmp_path):
+    """An undocumented nested block is ONE finding at its root."""
+    doc = src("""
+    # Config
+
+    ```jsonc
+    {"knob": 0, "extras": {}}
+    ```
+    """)
+    _write_synth_repo(tmp_path, doc, COMPLETE_OBS_DOC)
+    findings = [f for f in analyze_drift(root=str(tmp_path))
+                if f.rule == "DR001"]
+    assert len(findings) == 1 and "'sub'" in findings[0].message
+
+
+def test_drift_findings_have_stable_fingerprints(tmp_path):
+    _write_synth_repo(tmp_path, "# empty\n", "")
+    a = analyze_drift(root=str(tmp_path))
+    b = analyze_drift(root=str(tmp_path))
+    assert [f.fingerprint for f in a] == [f.fingerprint for f in b]
+    assert len({f.fingerprint for f in a}) == len(a)
+
+
+def test_jsonc_key_paths_parser():
+    block = src("""
+    {
+      "a": 1,              // comment with "quoted: text"
+      "b": {
+        "c": "value // not a comment",
+        "d": [ {"ignored": 1}, 2 ]
+      },
+      "e": null
+    }
+    """)
+    paths = jsonc_key_paths(block)
+    assert set(paths) == {"a", "b", "b.c", "b.d", "e"}, paths
+
+
+def test_config_knob_paths_on_real_repo():
+    """The real dataclass walk resolves nested + post_init-bound classes."""
+    classes = parse_config_classes(REPO_ROOT)
+    knobs = config_knob_paths(classes)
+    assert "zero_optimization.offload_param.pin_memory" in knobs
+    assert "resilience.watchdog.exit_code" in knobs
+    assert knobs["optimizer.params"][2], "optimizer.params must be free-form"
+    docs = documented_knob_paths(REPO_ROOT)
+    assert "zero_optimization.stage" in docs
+    fams = emitted_metric_families(REPO_ROOT)
+    assert "programs" in fams and "fleet" in fams
+
+
+# ---------------------------------------------------------------------------
+# CLI: --drift, --changed-only, exit codes, repo gates
+# ---------------------------------------------------------------------------
+
+SEEDED_DT = src("""
+def bucket_of(request_id):
+    return hash(request_id) % 8
+""")
+
+CLEAN_PY = "VALUE = 1\n"
+
+
+def test_cli_drift_flag_needs_no_paths(capsys):
+    """`ds_tpu_lint --drift` alone is a valid invocation (repo is clean)."""
+    assert lint_main(["--drift", "-q"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_seeded_violation_exits_1_for_every_new_rule(tmp_path, capsys):
+    for i, (rule, bad, _) in enumerate(FIXTURES):
+        f = tmp_path / f"bad{i}.py"
+        f.write_text(src(bad))
+        assert lint_main([str(f)]) == 1, f"{rule} fixture did not fail CLI"
+    capsys.readouterr()
+
+
+def test_cli_rules_filter_covers_new_packs(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED_DT)
+    assert lint_main([str(bad), "--rules", "DT001"]) == 1
+    assert lint_main([str(bad), "--rules", "CC001"]) == 0
+    capsys.readouterr()
+
+
+def test_cli_drift_baseline_entries_dropped_without_drift_flag(
+        tmp_path, capsys):
+    """DR baseline entries only materialize under --drift; a non-drift run
+    must not misreport them as stale."""
+    _write_synth_repo(tmp_path, "# empty\n", "")
+    drift = analyze_drift(root=str(tmp_path))
+    assert drift, "synthetic tree should drift"
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED_DT)
+    base = str(tmp_path / "b.json")
+    save_baseline(base, analyze_source(SEEDED_DT, path="bad.py") + drift)
+    assert lint_main([str(bad), "--baseline", base]) == 0
+    out = capsys.readouterr().out
+    assert "0 stale" in out, out
+    # under --drift the (now-fixed, repo-side) DR entries DO count as stale
+    assert lint_main([str(bad), "--baseline", base, "--drift"]) == 0
+    out = capsys.readouterr().out
+    assert "0 stale" not in out, out
+
+
+@pytest.mark.skipif(GIT is None, reason="git not installed")
+def test_cli_changed_only_scopes_run_and_baseline(tmp_path, monkeypatch,
+                                                  capsys):
+    def git(*argv):
+        subprocess.run([GIT, "-c", "user.name=t", "-c", "user.email=t@t",
+                        *argv], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(SEEDED_DT)
+    (pkg / "clean.py").write_text(CLEAN_PY)
+    git("init", "-q")
+    git("add", ".")
+    git("commit", "-q", "-m", "seed")
+    monkeypatch.chdir(tmp_path)
+
+    # baseline the existing violation, full run
+    base = str(tmp_path / "b.json")
+    assert lint_main(["pkg", "--baseline", base, "--update-baseline"]) == 0
+
+    # nothing changed vs HEAD -> nothing analyzed, nothing stale
+    assert lint_main(["pkg", "--baseline", base, "--changed-only"]) == 0
+    out = capsys.readouterr().out
+    assert "0 new" in out and "0 stale" in out, out
+
+    # touch only clean.py: bad.py's baseline entries must NOT go stale
+    (pkg / "clean.py").write_text(CLEAN_PY + "OTHER = 2\n")
+    assert lint_main(["pkg", "--baseline", base, "--changed-only"]) == 0
+    out = capsys.readouterr().out
+    assert "0 stale" in out, out
+
+    # a new violation in a changed file fails the scoped run
+    (pkg / "clean.py").write_text(CLEAN_PY + SEEDED_DT)
+    assert lint_main(["pkg", "--baseline", base, "--changed-only"]) == 1
+    capsys.readouterr()
+
+    # an explicit ref works too (HEAD spelled out)
+    assert lint_main(["pkg", "--baseline", base,
+                      "--changed-only", "HEAD"]) == 1
+    capsys.readouterr()
+
+
+@pytest.mark.skipif(GIT is None, reason="git not installed")
+def test_cli_changed_only_update_baseline_is_usage_error(tmp_path,
+                                                         monkeypatch,
+                                                         capsys):
+    monkeypatch.chdir(REPO_ROOT)
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED_DT)
+    assert lint_main([str(bad), "--changed-only", "--baseline",
+                      str(tmp_path / "b.json"), "--update-baseline"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_changed_only_outside_git_is_usage_error(tmp_path, monkeypatch,
+                                                     capsys):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("GIT_DIR", str(tmp_path / "nonexistent.git"))
+    bad = tmp_path / "bad.py"
+    bad.write_text(SEEDED_DT)
+    assert lint_main([str(bad), "--changed-only"]) == 2
+    capsys.readouterr()
+
+
+def test_repo_is_clean_with_drift_against_committed_baseline(capsys):
+    """The CI gate, upgraded: package rules + drift exit 0 with 0 stale."""
+    pkg = os.path.join(REPO_ROOT, "deepspeed_tpu")
+    baseline = os.path.join(REPO_ROOT, ".ds_tpu_lint_baseline.json")
+    rc = lint_main([pkg, "--baseline", baseline, "--drift", "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"new lint/drift findings:\n{out}"
+    assert "0 stale" in out, f"stale baseline entries — regenerate:\n{out}"
+
+
+def test_repo_has_zero_undocumented_config_knobs():
+    """ISSUE 17 acceptance: --drift reports no undocumented knobs."""
+    assert [f for f in analyze_drift(root=REPO_ROOT)
+            if f.rule == "DR001"] == []
+
+
+def test_cli_json_format_includes_drift(tmp_path, capsys):
+    assert lint_main(["--drift", "--format", "json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["new"] == [] and out["stale_baseline_entries"] == []
