@@ -52,6 +52,7 @@ _CONFIG_MODULES = (
     "deepspeed_tpu/serving/qos.py",
     "deepspeed_tpu/serving/fleet/config.py",
     "deepspeed_tpu/serving/fleet/supervision.py",
+    "deepspeed_tpu/serving/fleet/federation/config.py",
     "deepspeed_tpu/observability/config.py",
     "deepspeed_tpu/runtime/resilience/config.py",
     "deepspeed_tpu/runtime/tiering/config.py",

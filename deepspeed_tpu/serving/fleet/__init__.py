@@ -13,7 +13,9 @@ __all__ = ["FleetConfig", "SupervisionConfig", "ReplicaSupervisor",
            "ServingFleet", "FleetRequest", "Router",
            "ReplicaStats", "LocalReplica", "ProcessReplica",
            "ReplicaCrash", "ReplicaDead", "WorkerProtocolError",
-           "serialize_handoff", "deserialize_handoff", "HandoffError"]
+           "serialize_handoff", "deserialize_handoff", "HandoffError",
+           "FederationConfig", "RemoteReplica", "FleetFrontend",
+           "RollingUpdate", "RollingUpdateError"]
 
 _LAZY = {
     "ServingFleet": ".manager",
@@ -28,6 +30,11 @@ _LAZY = {
     "serialize_handoff": ".handoff",
     "deserialize_handoff": ".handoff",
     "HandoffError": ".handoff",
+    "FederationConfig": ".federation",
+    "RemoteReplica": ".federation",
+    "FleetFrontend": ".federation",
+    "RollingUpdate": ".federation",
+    "RollingUpdateError": ".federation",
 }
 
 

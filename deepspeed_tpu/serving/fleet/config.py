@@ -114,6 +114,14 @@ class FleetConfig:
                                      # (ENABLED — supervision.enabled:
                                      # false restores fatal/no-respawn
                                      # PR-12 semantics)
+    federation: Optional["FederationConfig"] = field(default=None)
+                                     # cross-host federation (socket
+                                     # transport for remote non-child
+                                     # replicas, HTTP front-end, rolling
+                                     # update policy); absent/None =
+                                     # single-host fleet, no peers — the
+                                     # manager still reads rolling
+                                     # defaults from None safely
 
     def __post_init__(self):
         # nested-dict lift, same contract as ServingConfig.__post_init__
@@ -124,6 +132,9 @@ class FleetConfig:
             self.supervision = SupervisionConfig()
         elif isinstance(self.supervision, dict):
             self.supervision = SupervisionConfig(**self.supervision)
+        if isinstance(self.federation, dict):
+            from .federation.config import FederationConfig
+            self.federation = FederationConfig(**self.federation)
 
     def validate(self, serving_config=None) -> "FleetConfig":
         if self.replicas < 1:
@@ -194,6 +205,14 @@ class FleetConfig:
                 "serving.fleet.worker_reply_timeout_s must be > 0, got "
                 f"{self.worker_reply_timeout_s}")
         self.supervision.validate()
+        if self.federation is not None:
+            self.federation.validate()
+            if len(self.federation.peers) > self.replicas:
+                raise ValueError(
+                    "serving.fleet.federation.peers lists "
+                    f"{len(self.federation.peers)} peers but the fleet "
+                    f"only has {self.replicas} replicas — peers fill the "
+                    "leading replica ids")
         if self.disaggregate and self.min_replicas < 2:
             # a disaggregated fleet can never drain below one prefill +
             # one decode replica

@@ -1,0 +1,52 @@
+"""Cross-host fleet federation: socket transport for the worker
+protocol, remote (non-child) replicas, an HTTP request front-end, and
+zero-downtime rolling weight updates.
+
+Import discipline mirrors ``serving.fleet``: the frame codec, transport,
+and config are stdlib-only (importable with no jax present); everything
+that touches an engine is loaded lazily.
+"""
+
+from deepspeed_tpu.serving.fleet.federation.config import FederationConfig
+from deepspeed_tpu.serving.fleet.federation.frames import (
+    FrameError,
+    FrameDecoder,
+    encode_frame,
+    DEFAULT_MAX_FRAME_BYTES,
+)
+from deepspeed_tpu.serving.fleet.federation.transport import (
+    FrameConnection,
+    PeerGone,
+    connect,
+    parse_address,
+)
+
+_LAZY = {
+    "RemoteReplica": "deepspeed_tpu.serving.fleet.federation.remote",
+    "FleetFrontend": "deepspeed_tpu.serving.fleet.federation.frontend",
+    "RollingUpdate": "deepspeed_tpu.serving.fleet.federation.rolling",
+    "RollingUpdateError": "deepspeed_tpu.serving.fleet.federation.rolling",
+    "FederationWorkerServer": "deepspeed_tpu.serving.fleet.federation.worker",
+}
+
+__all__ = [
+    "FederationConfig",
+    "FrameError",
+    "FrameDecoder",
+    "encode_frame",
+    "DEFAULT_MAX_FRAME_BYTES",
+    "FrameConnection",
+    "PeerGone",
+    "connect",
+    "parse_address",
+] + sorted(_LAZY)
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+        module = importlib.import_module(_LAZY[name])
+        value = getattr(module, name)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,56 @@
+"""Federation config (`serving.fleet.federation`).
+
+Stdlib-only, same import contract as ``serving/fleet/config.py``: this
+module must import with no jax present so remote workers and codec
+tests can load it standalone.
+"""
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from deepspeed_tpu.serving.fleet.federation.frames import (
+    DEFAULT_MAX_FRAME_BYTES,
+)
+
+
+@dataclass
+class FederationConfig:
+    """Cross-host fleet knobs. ``peers`` lists remote worker addresses
+    ("host:port"); they fill the *leading* replica ids, so with
+    ``replicas == len(peers)`` the fleet is socket-only and
+    ``role_for`` assigns disaggregated roles to remote peers exactly
+    as it would to local ones."""
+
+    peers: List[str] = field(default_factory=list)
+    connect_timeout_s: float = 5.0
+    reply_timeout_s: float = 60.0
+    max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES
+    http_host: str = "127.0.0.1"
+    http_port: Optional[int] = None
+    rolling_verify: bool = True
+    rolling_drain_slot_cap: int = 1
+
+    def validate(self):
+        for peer in self.peers:
+            host, sep, port = str(peer).rpartition(":")
+            if not sep or not host or not port.isdigit():
+                raise ValueError(
+                    "serving.fleet.federation.peers entries must be "
+                    f"HOST:PORT strings, got {peer!r}")
+        if self.connect_timeout_s <= 0:
+            raise ValueError(
+                "serving.fleet.federation.connect_timeout_s must be > 0")
+        if self.reply_timeout_s <= 0:
+            raise ValueError(
+                "serving.fleet.federation.reply_timeout_s must be > 0")
+        if self.max_frame_bytes < 4096:
+            raise ValueError(
+                "serving.fleet.federation.max_frame_bytes must be >= 4096")
+        if self.http_port is not None and not (0 <= self.http_port < 65536):
+            raise ValueError(
+                "serving.fleet.federation.http_port must be in [0, 65536) "
+                "or null")
+        if self.rolling_drain_slot_cap < 1:
+            raise ValueError(
+                "serving.fleet.federation.rolling_drain_slot_cap must be "
+                ">= 1")

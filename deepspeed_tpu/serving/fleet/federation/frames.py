@@ -1,0 +1,102 @@
+"""Length-prefixed frame codec for the federated worker protocol.
+
+The single-host fleet speaks sentinel-prefixed line JSON over pipes
+(`serving/fleet/worker.py`); a TCP byte stream has no line discipline a
+reader can trust, so the federation wire promotes each message to a
+framed record:
+
+    +-------+------+----------------+---------...---+
+    | magic | kind | length (u32 BE)| payload       |
+    | 4 B   | 1 B  | 4 B            | `length` B    |
+    +-------+------+----------------+---------...---+
+
+``kind`` distinguishes JSON control frames from raw binary blobs (the
+npz KV-handoff payload travels as a blob frame — no base64 detour).
+Every malformed condition maps to a *named* :class:`FrameError` whose
+``kind`` mirrors PR 15's ``WorkerProtocolError`` taxonomy, so the
+remote-replica layer can contain torn reads the same way the pipe
+backend does. Stdlib-only: no jax, importable from codec unit tests.
+"""
+
+import struct
+
+MAGIC = b"DSF1"
+KIND_JSON = 0
+KIND_BLOB = 1
+_KINDS = (KIND_JSON, KIND_BLOB)
+_HEADER = struct.Struct(">4sBI")
+HEADER_BYTES = _HEADER.size
+# One handoff blob for the demo configs is ~100 KiB; 64 MiB leaves room
+# for real model pages while still rejecting a garbage length prefix
+# before the reader tries to buffer gigabytes.
+DEFAULT_MAX_FRAME_BYTES = 64 << 20
+
+
+class FrameError(ValueError):
+    """A frame that cannot be decoded, with a machine-readable ``kind``:
+    ``"malformed"`` (bad magic / kind byte / JSON), ``"truncated"``
+    (EOF mid-frame), ``"oversize"`` (declared length over the cap), or
+    ``"timeout"`` (no bytes within the read deadline — raised by the
+    transport layer, named here so every wire fault shares one type)."""
+
+    def __init__(self, kind, detail):
+        self.kind = kind
+        self.detail = detail
+        super().__init__(f"frame error ({kind}): {detail}")
+
+
+def encode_frame(payload, kind=KIND_JSON):
+    """``bytes`` for one frame; ``payload`` must already be encoded."""
+    if kind not in _KINDS:
+        raise ValueError(f"unknown frame kind {kind!r}")
+    return _HEADER.pack(MAGIC, kind, len(payload)) + payload
+
+
+class FrameDecoder:
+    """Incremental decoder: ``feed`` raw socket bytes, ``next_frame``
+    yields complete ``(kind, payload)`` records (or None while a frame
+    is still partial). The caller signals stream end via ``eof()`` so a
+    torn frame surfaces as a named error instead of a silent drop."""
+
+    def __init__(self, max_frame_bytes=DEFAULT_MAX_FRAME_BYTES):
+        self.max_frame_bytes = int(max_frame_bytes)
+        self._buf = bytearray()
+
+    @property
+    def pending(self):
+        """Bytes buffered but not yet consumed as a complete frame."""
+        return len(self._buf)
+
+    def feed(self, data):
+        self._buf += data
+
+    def next_frame(self):
+        if len(self._buf) < HEADER_BYTES:
+            return None
+        magic, kind, length = _HEADER.unpack_from(self._buf)
+        if magic != MAGIC:
+            raise FrameError(
+                "malformed",
+                f"bad magic {bytes(self._buf[:4])!r} (expected {MAGIC!r})")
+        if kind not in _KINDS:
+            raise FrameError("malformed", f"unknown frame kind {kind}")
+        if length > self.max_frame_bytes:
+            raise FrameError(
+                "oversize",
+                f"declared length {length} exceeds cap "
+                f"{self.max_frame_bytes}")
+        end = HEADER_BYTES + length
+        if len(self._buf) < end:
+            return None
+        payload = bytes(self._buf[HEADER_BYTES:end])
+        del self._buf[:end]
+        return kind, payload
+
+    def eof(self):
+        """Stream closed: raise ``truncated`` if bytes are stranded
+        mid-frame, else return None (clean close between frames)."""
+        if self._buf:
+            raise FrameError(
+                "truncated",
+                f"peer closed with {len(self._buf)} bytes mid-frame")
+        return None

@@ -1,0 +1,221 @@
+"""HTTP request front-end for the fleet router.
+
+The ``ThreadingHTTPServer`` pattern from ``observability/export.py``
+applied to the request plane: clients POST submissions and poll (or
+long-poll stream) generations over HTTP, while the fleet's dispatch
+thread stays single-threaded and deterministic. HTTP handler threads
+NEVER touch the fleet — they enqueue into a lock-protected mailbox;
+the dispatch thread drains it in FIFO order at the top of each
+``fleet.advance()`` (``ServingFleet.attach_frontend`` wires this), so
+a given arrival order replays bit-exactly regardless of socket timing.
+
+Endpoints:
+
+- ``POST /v1/submit``  body ``{"prompt": [ints], "max_new_tokens": N,
+  "priority": P}`` → ``{"request_id": ...}`` (202; the request is
+  queued, not yet dispatched)
+- ``GET /v1/result?id=ID`` → ``{"request_id", "status", "tokens",
+  "done"}``
+- ``GET /v1/stream?id=ID`` → ``application/x-ndjson``: one
+  ``{"token": t}`` line per generated token as it lands, then a final
+  ``{"done": true, "status": ...}`` line.
+"""
+
+import json
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+_STREAM_POLL_S = 0.25      # long-poll wakeup cadence (transport-side
+                           # only; never consulted by dispatch)
+_STREAM_MAX_WAIT_S = 600.0
+
+
+class _FrontendRequest:
+    def __init__(self, request_id, prompt, max_new_tokens, priority):
+        self.request_id = request_id
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.priority = priority
+        self.tokens = []
+        self.status = "queued"
+        self.done = False
+        self._cond = threading.Condition()
+        self.handle = None          # FleetRequest once dispatched
+
+    def on_token(self, _req, token):
+        """Dispatch-thread callback: publish one token to streamers."""
+        with self._cond:
+            self.tokens.append(int(token))
+            self._cond.notify_all()
+
+    def finish(self, status):
+        with self._cond:
+            self.status = status
+            self.done = True
+            self._cond.notify_all()
+
+    def view(self):
+        with self._cond:
+            return {"request_id": self.request_id, "status": self.status,
+                    "tokens": list(self.tokens), "done": self.done}
+
+
+class FleetFrontend:
+    """Lock-protected mailbox between HTTP handler threads and the
+    fleet dispatch thread. ``start()`` binds the server; ``drain()``
+    must only ever run on the dispatch thread."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._host = host
+        self._port = port
+        self._lock = threading.Lock()
+        self._pending = deque()      # submitted via HTTP, not dispatched
+        self._requests = {}          # id -> _FrontendRequest
+        self._next_id = 0
+        self._active = []            # dispatched, awaiting completion
+        self._server = None
+        self._thread = None
+        self.submitted = 0
+        self.finished = 0
+
+    @property
+    def port(self):
+        return self._server.server_address[1] if self._server else None
+
+    def submit(self, prompt, max_new_tokens, priority=0):
+        """HTTP-thread side: enqueue and hand back the request id."""
+        with self._lock:
+            self._next_id += 1
+            rid = f"http-{self._next_id}"
+            rec = _FrontendRequest(rid, [int(t) for t in prompt],
+                                   int(max_new_tokens), int(priority))
+            self._requests[rid] = rec
+            self._pending.append(rec)
+            self.submitted += 1
+        return rid
+
+    def get(self, request_id):
+        with self._lock:
+            return self._requests.get(request_id)
+
+    def drain(self, fleet):
+        """Dispatch-thread side: FIFO-submit everything queued since
+        the last fleet step, then publish completions."""
+        while True:
+            with self._lock:
+                if not self._pending:
+                    break
+                rec = self._pending.popleft()
+            rec.status = "submitted"
+            rec.handle = fleet.submit(
+                np.asarray(rec.prompt, np.int32), rec.max_new_tokens,
+                request_id=rec.request_id, priority=rec.priority,
+                on_token=rec.on_token)
+            self._active.append(rec)
+        still = []
+        for rec in self._active:
+            if rec.handle is not None and rec.handle.done:
+                self.finished += 1
+                rec.finish(rec.handle.status)
+            else:
+                still.append(rec)
+        self._active = still
+
+    @property
+    def busy(self):
+        with self._lock:
+            pending = bool(self._pending)
+        return pending or bool(self._active)
+
+    # -- http plumbing -----------------------------------------------------
+    def start(self):
+        frontend = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, obj):
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if urlparse(self.path).path != "/v1/submit":
+                    self._reply(404, {"error": "unknown endpoint"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    msg = json.loads(self.rfile.read(length))
+                    prompt = [int(t) for t in msg["prompt"]]
+                    max_new = int(msg.get("max_new_tokens", 16))
+                    priority = int(msg.get("priority", 0))
+                except (ValueError, KeyError, TypeError) as e:
+                    self._reply(400, {"error": f"bad submission: {e}"})
+                    return
+                rid = frontend.submit(prompt, max_new, priority)
+                self._reply(202, {"request_id": rid})
+
+            def do_GET(self):
+                url = urlparse(self.path)
+                rid = (parse_qs(url.query).get("id") or [None])[0]
+                rec = frontend.get(rid) if rid else None
+                if url.path == "/v1/result":
+                    if rec is None:
+                        self._reply(404, {"error": f"unknown id {rid!r}"})
+                        return
+                    self._reply(200, rec.view())
+                    return
+                if url.path == "/v1/stream":
+                    if rec is None:
+                        self._reply(404, {"error": f"unknown id {rid!r}"})
+                        return
+                    self._stream(rec)
+                    return
+                self._reply(404, {"error": "unknown endpoint"})
+
+            def _stream(self, rec):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.end_headers()
+                sent = 0
+                waited = 0.0
+                while waited < _STREAM_MAX_WAIT_S:
+                    with rec._cond:
+                        if sent == len(rec.tokens) and not rec.done:
+                            rec._cond.wait(_STREAM_POLL_S)
+                            waited += _STREAM_POLL_S
+                        fresh = rec.tokens[sent:]
+                        done, status = rec.done, rec.status
+                    for token in fresh:
+                        self.wfile.write(
+                            json.dumps({"token": token}).encode() + b"\n")
+                    sent += len(fresh)
+                    self.wfile.flush()
+                    if done:
+                        self.wfile.write(json.dumps(
+                            {"done": True, "status": status}).encode()
+                            + b"\n")
+                        self.wfile.flush()
+                        return
+
+        self._server = ThreadingHTTPServer((self._host, self._port),
+                                           Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="fleet-frontend", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

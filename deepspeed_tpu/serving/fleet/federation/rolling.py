@@ -1,0 +1,209 @@
+"""Zero-downtime rolling weight updates across the fleet.
+
+One :class:`RollingUpdate` walks the live replicas in id order, one at
+a time, entirely on the deterministic fleet step clock:
+
+1. **drain** — the replica leaves the dispatchable set
+   (``fleet._draining``) and its admission cap squeezes to
+   ``rolling_drain_slot_cap`` via the PR 10 slot-cap/preemption path;
+   in-flight requests FINISH on the old weights (zero drops);
+2. **swap** — once the replica owns nothing (no fleet handles, empty
+   queue, idle slots), its engine is rebuilt from the new weights:
+   in-process via ``LocalReplica.swap_weights``, process/remote via the
+   ``swap`` worker op (the worker refuses while busy — a second
+   guard); the slot cap is restored and the replica rejoins dispatch;
+3. repeat until every replica in the start-of-update snapshot is
+   swapped (replicas that die mid-roll are skipped — supervision
+   respawns them from the already-updated fleet spec).
+
+Checkpoint targets are **manifest-verified before anything drains**
+(PR 4's ``resolve_verified_tag``): a corrupt checkpoint refuses the
+whole update with a named :class:`RollingUpdateError`; the fleet keeps
+serving the old weights untouched.
+
+Per-version parity: every ``FleetRequest`` is stamped with the
+``weights_version`` of the replica that serves it, so a mid-trace
+update yields two cleanly separable populations, each parity-checkable
+against its own single-engine reference (absent chaos, the drain
+barrier guarantees no request ever mixes versions).
+"""
+
+from typing import Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.observability.metrics import get_registry
+from deepspeed_tpu.serving.fleet.replica import ReplicaDead
+
+
+class RollingUpdateError(RuntimeError):
+    """A rolling update that cannot start (already in progress, fleet
+    too small for zero-downtime, unverifiable checkpoint) or cannot
+    make progress."""
+
+
+def _verify_checkpoint(checkpoint: str) -> None:
+    """Refuse unverifiable weights BEFORE draining anything."""
+    from deepspeed_tpu.runtime.resilience.manifest import (
+        resolve_verified_tag)
+    tag, errors = resolve_verified_tag(checkpoint)
+    if tag is None:
+        raise RollingUpdateError(
+            f"rolling update refused: no verified-good checkpoint under "
+            f"{checkpoint!r} ({errors})")
+
+
+class RollingUpdate:
+    def __init__(self, fleet, *, checkpoint: Optional[str] = None,
+                 module=None, params=None, spec_update: Optional[dict] =
+                 None, verify: bool = True, drain_slot_cap: int = 1):
+        alive = fleet._alive()
+        if len(alive) < 2:
+            raise RollingUpdateError(
+                "rolling update needs >= 2 live replicas — with one, "
+                "draining it is downtime by definition")
+        if checkpoint is None and params is None and not spec_update:
+            raise RollingUpdateError(
+                "rolling update needs new weights: checkpoint=, params=, "
+                "or spec_update=")
+        if checkpoint is not None and verify:
+            _verify_checkpoint(checkpoint)
+        self.checkpoint = checkpoint
+        self.module = module if module is not None else fleet._module
+        self.params = params
+        self.spec_update = dict(spec_update or {})
+        if checkpoint is not None:
+            self.spec_update.setdefault("checkpoint", checkpoint)
+        needs_params = any(
+            rep.backend == "inprocess"
+            for rep in fleet._replicas.values() if rep.alive)
+        if needs_params and self.params is None:
+            if checkpoint is None:
+                raise RollingUpdateError(
+                    "in-process replicas need params= or checkpoint=")
+            from deepspeed_tpu.runtime.checkpointing import (
+                load_module_params)
+            self.params = load_module_params(checkpoint)
+        self.drain_slot_cap = int(drain_slot_cap)
+        self.order = list(alive)        # snapshotted at start
+        self.position = 0
+        self.phase = "drain"
+        self.swapped = []
+        self.skipped = []
+        self.version = fleet.weights_version + 1
+        self.started_iteration = fleet.iteration
+        self.finished_iteration: Optional[int] = None
+        self.done = False
+        self._restore_caps = {}
+        # future spawns (supervision respawns, autoscale-up) must come
+        # up on the NEW weights from the moment the update starts — a
+        # mid-roll death respawning on stale weights would leak the old
+        # version back into a "completed" update
+        fleet._module = self.module
+        if self.params is not None:
+            fleet._params = self.params
+        if fleet._spec is not None and self.spec_update:
+            fleet._spec = {**fleet._spec, **self.spec_update}
+        fleet.recorder.record("rolling_start", iteration=fleet.iteration,
+                              version=self.version,
+                              replicas=list(self.order),
+                              checkpoint=checkpoint)
+        log_dist(f"fleet: rolling update to weights v{self.version} "
+                 f"started over replicas {self.order}"
+                 f"{' (checkpoint ' + checkpoint + ')' if checkpoint else ''}",
+                 ranks=[0])
+
+    def snapshot(self) -> dict:
+        return {"version": self.version, "done": self.done,
+                "position": self.position, "order": list(self.order),
+                "swapped": list(self.swapped),
+                "skipped": list(self.skipped),
+                "started_iteration": self.started_iteration,
+                "finished_iteration": self.finished_iteration}
+
+    # -- one fleet step of progress ----------------------------------------
+    def tick(self, fleet) -> bool:
+        """Advance the update at most one swap per fleet step (so at
+        most ONE replica is ever out of dispatch). Returns done."""
+        if self.done:
+            return True
+        while self.position < len(self.order):
+            rid = self.order[self.position]
+            rep = fleet._replicas.get(rid)
+            if rep is None or not rep.alive:
+                # died mid-roll: supervision respawns its lineage from
+                # the fleet's already-updated spec/params — skipping is
+                # not a version leak
+                fleet._draining.discard(rid)
+                self.skipped.append(rid)
+                self.position += 1
+                self.phase = "drain"
+                continue
+            if self.phase == "drain":
+                if rid not in fleet._draining:
+                    fleet._draining.add(rid)
+                    self._restore_caps[rid] = (rep.stats().num_slots
+                                               or fleet.config.num_slots)
+                    try:
+                        rep.set_slot_cap(self.drain_slot_cap)
+                    except (ReplicaDead, RuntimeError):
+                        continue   # reconsidered as dead next pass
+                if self._still_busy(fleet, rid, rep):
+                    return False   # draining: try again next step
+                self.phase = "swap"
+            if self.phase == "swap":
+                try:
+                    self._swap(fleet, rid, rep)
+                    self.swapped.append(rid)
+                except (ReplicaDead, RuntimeError) as e:
+                    # the swap itself failed: the replica's engine state
+                    # is suspect — let the death sweep contain it;
+                    # supervision respawns on the new weights
+                    rep.alive = False
+                    self.skipped.append(rid)
+                    log_dist(f"fleet: rolling swap of replica {rid} "
+                             f"failed ({e}) — containing", ranks=[0])
+                fleet._draining.discard(rid)
+                self.position += 1
+                self.phase = "drain"
+                return False       # one swap per step
+        self.done = True
+        self.finished_iteration = fleet.iteration
+        fleet.weights_version = self.version
+        fleet.rolling_updates += 1
+        fleet.recorder.record("rolling_done", iteration=fleet.iteration,
+                              version=self.version,
+                              swapped=list(self.swapped),
+                              skipped=list(self.skipped))
+        log_dist(f"fleet: rolling update to v{self.version} complete "
+                 f"({len(self.swapped)} swapped, "
+                 f"{len(self.skipped)} skipped)", ranks=[0])
+        return True
+
+    @staticmethod
+    def _still_busy(fleet, rid, rep) -> bool:
+        if any(h.replica_id == rid and not h.done
+               for h in fleet._handles.values()):
+            return True
+        s = rep.stats()
+        return bool(s.queue_depth or s.active_slots)
+
+    def _swap(self, fleet, rid, rep):
+        if rep.backend == "inprocess":
+            rep.swap_weights(self.module, self.params)
+        else:
+            rep.swap_weights_spec(self.spec_update)
+            if fleet._aggregator is not None and rep.telemetry_port:
+                # the worker's telemetry endpoint moved with the swap:
+                # re-register the fresh scrape client
+                fleet._aggregator.add_scrape(rid, client=rep.scrape_client)
+        rep.weights_version = self.version
+        restore = self._restore_caps.pop(rid, None)
+        if restore:
+            rep.set_slot_cap(restore)
+        fleet.rolling_swaps += 1
+        get_registry().counter("fleet/rolling_swaps").inc()
+        fleet.recorder.record("rolling_swap", replica_id=rid,
+                              iteration=fleet.iteration,
+                              version=self.version)
+        log_dist(f"fleet: replica {rid} swapped to weights "
+                 f"v{self.version} and rejoined dispatch", ranks=[0])

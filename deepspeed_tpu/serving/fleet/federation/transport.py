@@ -1,0 +1,125 @@
+"""Socket transport for framed worker-protocol messages.
+
+A :class:`FrameConnection` wraps one connected TCP socket and speaks
+``frames.py`` records: JSON control messages, each optionally followed
+by one raw blob frame (flagged in-band with ``"_blob": true`` so the
+reader knows to consume the companion frame). All wire faults surface
+as named :class:`~.frames.FrameError`s (timeout / truncated /
+malformed / oversize) or :class:`PeerGone` on a clean disconnect —
+the remote-replica layer maps these onto ``WorkerProtocolError`` and
+``ReplicaDead`` exactly like the pipe backend does.
+
+Stdlib-only; no jax.
+"""
+
+import json
+import socket
+
+from deepspeed_tpu.serving.fleet.federation.frames import (
+    DEFAULT_MAX_FRAME_BYTES,
+    FrameDecoder,
+    FrameError,
+    KIND_BLOB,
+    KIND_JSON,
+    encode_frame,
+)
+
+_RECV_CHUNK = 1 << 16
+
+
+class PeerGone(ConnectionError):
+    """The peer closed the stream cleanly (EOF between frames)."""
+
+
+def parse_address(address):
+    """``"host:port"`` → ``(host, port)``; port may be 0 (ephemeral)."""
+    host, sep, port = str(address).rpartition(":")
+    if not sep or not host:
+        raise ValueError(
+            f"address {address!r} must be HOST:PORT (e.g. 127.0.0.1:7077)")
+    try:
+        return host, int(port)
+    except ValueError:
+        raise ValueError(f"address {address!r} has a non-integer port")
+
+
+def connect(host, port, timeout_s=5.0,
+            max_frame_bytes=DEFAULT_MAX_FRAME_BYTES):
+    """Dial a federation peer; OSError propagates to the caller (a
+    failed dial is a spawn failure, not a protocol error)."""
+    sock = socket.create_connection((host, int(port)), timeout=timeout_s)
+    return FrameConnection(sock, max_frame_bytes=max_frame_bytes)
+
+
+class FrameConnection:
+    def __init__(self, sock, max_frame_bytes=DEFAULT_MAX_FRAME_BYTES):
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # not a TCP socket (e.g. socketpair in tests)
+        self._sock = sock
+        self._decoder = FrameDecoder(max_frame_bytes)
+        self.closed = False
+
+    def fileno(self):
+        return self._sock.fileno()
+
+    def send_msg(self, msg, blob=None):
+        """One JSON frame, plus one blob frame when ``blob`` is given.
+        OSError (broken pipe, reset) propagates to the caller."""
+        head = dict(msg)
+        if blob is not None:
+            head["_blob"] = True
+        data = encode_frame(json.dumps(head, default=float).encode("utf-8"))
+        if blob is not None:
+            data += encode_frame(blob, KIND_BLOB)
+        self._sock.sendall(data)
+
+    def _recv_frame(self, timeout_s):
+        while True:
+            frame = self._decoder.next_frame()
+            if frame is not None:
+                return frame
+            self._sock.settimeout(timeout_s)
+            try:
+                chunk = self._sock.recv(_RECV_CHUNK)
+            except socket.timeout:
+                raise FrameError(
+                    "timeout", f"no reply within {timeout_s}s")
+            if not chunk:
+                self._decoder.eof()  # raises "truncated" when mid-frame
+                raise PeerGone("peer closed the connection")
+            self._decoder.feed(chunk)
+
+    def recv_msg(self, timeout_s=None):
+        """→ ``(msg, blob)``; ``blob`` is None unless the message was
+        sent with a companion blob frame."""
+        kind, payload = self._recv_frame(timeout_s)
+        if kind != KIND_JSON:
+            raise FrameError("malformed", "blob frame without JSON header")
+        try:
+            msg = json.loads(payload.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as exc:
+            raise FrameError("malformed", f"undecodable JSON frame: {exc}")
+        if not isinstance(msg, dict):
+            raise FrameError("malformed", "JSON frame is not an object")
+        blob = None
+        if msg.pop("_blob", False):
+            kind, blob = self._recv_frame(timeout_s)
+            if kind != KIND_BLOB:
+                raise FrameError(
+                    "malformed", "expected blob frame after _blob header")
+        return msg, blob
+
+    def close(self):
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,168 @@
+"""Federation worker: one ServingEngine served over a TCP frame socket.
+
+``python -m deepspeed_tpu.serving.fleet.federation.worker --listen
+HOST:PORT`` (also reachable as ``ds_tpu_serve --listen``) binds the
+address, prints the bound endpoint (PORT may be 0 for ephemeral — the
+caller parses the printed line), and serves one router connection at a
+time. The op surface is exactly ``serving/fleet/worker.py``'s —
+``_SocketWorker`` subclasses ``_Worker`` and swaps the transport:
+replies travel as JSON frames, KV handoffs as raw v3 blob frames.
+
+Reconnect semantics: the ENGINE outlives the connection. A dropped
+router connection (crash, partition) parks the worker back in accept;
+the next dial finds the same engine with its KV state intact — the
+router side treats re-dialing as the supervision restart. A fresh
+``init`` on a new connection rebuilds the engine (a rejoining router
+must start from a known state); ``stop`` tears the engine down and
+exits the process.
+"""
+
+import argparse
+import socket
+import sys
+
+from deepspeed_tpu.serving.fleet.federation.frames import (
+    DEFAULT_MAX_FRAME_BYTES,
+    FrameError,
+)
+from deepspeed_tpu.serving.fleet.federation.transport import (
+    FrameConnection,
+    PeerGone,
+    parse_address,
+)
+from deepspeed_tpu.serving.fleet.handoff import deserialize_handoff
+from deepspeed_tpu.serving.fleet.worker import _Worker
+
+READY_BANNER = "@fleet-federation listening "
+
+
+class _SocketWorker(_Worker):
+    """The pipe worker's op surface answered over a FrameConnection."""
+
+    def __init__(self, spec: dict, conn: FrameConnection):
+        self._conn = conn            # before super().__init__: the ready
+        super().__init__(spec)       # reply already goes over the socket
+
+    def _reply(self, msg: dict):
+        self._conn.send_msg(msg)
+
+    def rebind(self, conn: FrameConnection):
+        """A new router connection adopts the live engine."""
+        self._conn = conn
+
+    def op_export(self, msg):
+        self._conn.send_msg({"op": "payload", "id": msg["id"]},
+                            blob=self._export_blob(msg))
+
+    def op_inject(self, msg, blob=None):
+        if blob is None:
+            return super().op_inject(msg)
+        self._inject_payload(deserialize_handoff(blob))
+
+
+class FederationWorkerServer:
+    def __init__(self, host: str, port: int, *,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+        self.max_frame_bytes = max_frame_bytes
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(4)
+        self.host = host
+        self.port = self._listener.getsockname()[1]
+        self._worker = None
+        self._stopping = False
+
+    def serve_forever(self):
+        try:
+            while not self._stopping:
+                try:
+                    sock, peer = self._listener.accept()
+                except OSError:
+                    break
+                conn = FrameConnection(
+                    sock, max_frame_bytes=self.max_frame_bytes)
+                print(f"[federation-worker] router connected from "
+                      f"{peer[0]}:{peer[1]}", flush=True)
+                try:
+                    self._serve_connection(conn)
+                finally:
+                    conn.close()
+        finally:
+            self._listener.close()
+            if self._worker is not None:
+                self._worker.engine.close()
+
+    def _serve_connection(self, conn: FrameConnection):
+        worker = self._worker
+        if worker is not None:
+            worker.rebind(conn)
+        while True:
+            try:
+                msg, blob = conn.recv_msg(timeout_s=None)
+            except (PeerGone, FrameError, OSError) as e:
+                # router gone (clean close, torn frame, reset): the
+                # engine survives; park in accept for the re-dial
+                print(f"[federation-worker] router connection lost "
+                      f"({e}); awaiting reconnect", flush=True)
+                return
+            op = msg.get("op")
+            if op == "init":
+                if worker is not None:
+                    # a rejoining router starts from a known state
+                    worker.engine.close()
+                worker = _SocketWorker(msg, conn)
+                self._worker = worker
+                continue
+            if op == "stop":
+                conn.send_msg({"op": "bye"})
+                self._stopping = True
+                return
+            if worker is None:
+                conn.send_msg({"op": "error",
+                               "detail": "no init received yet"})
+                continue
+            handler = getattr(worker, f"op_{op}", None)
+            if handler is None:
+                conn.send_msg({"op": "error",
+                               "detail": f"unknown op {op!r}"})
+                continue
+            try:
+                if op == "inject":
+                    handler(msg, blob=blob)
+                else:
+                    handler(msg)
+            except Exception as e:   # ds-tpu: lint-ok[PY001] — the
+                # protocol boundary: op failures become typed error
+                # replies, never a dead socket with no diagnosis
+                conn.send_msg({"op": "error", "detail": f"{op}: {e}"})
+
+
+def serve_listen(address: str,
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES) -> int:
+    from deepspeed_tpu.utils.host_env import honor_jax_platforms_env
+    honor_jax_platforms_env()
+    host, port = parse_address(address)
+    server = FederationWorkerServer(host, port,
+                                    max_frame_bytes=max_frame_bytes)
+    # the banner is the contract: callers with port 0 parse the bound
+    # endpoint from this line
+    print(f"{READY_BANNER}{server.host}:{server.port}", flush=True)
+    server.serve_forever()
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="federated fleet worker (socket transport)")
+    parser.add_argument("--listen", required=True, metavar="HOST:PORT",
+                        help="bind address; port 0 picks an ephemeral "
+                             "port, printed on the ready banner")
+    parser.add_argument("--max-frame-bytes", type=int,
+                        default=DEFAULT_MAX_FRAME_BYTES)
+    args = parser.parse_args(argv)
+    return serve_listen(args.listen, max_frame_bytes=args.max_frame_bytes)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

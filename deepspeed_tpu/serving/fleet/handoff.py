@@ -24,6 +24,12 @@ refuse unknown ``version`` values loudly rather than guessing, but
 accept every version in ``COMPAT_HANDOFF_VERSIONS`` — v1 payloads
 (pre-tracing) load fine, their requests simply carry no ``trace_id``
 (the injecting engine stamps a fresh one).
+
+v3 (federation): the SAME npz layout may now travel as a raw binary
+frame on the federation socket (serving/fleet/federation/frames.py) —
+no base64 detour, torn frames contained by the frame codec before this
+module ever sees the blob. A v3 blob read off a pipe still decodes
+identically; the version marks wire capability, not layout change.
 """
 
 import io
@@ -32,8 +38,8 @@ from typing import Dict
 
 import numpy as np
 
-HANDOFF_VERSION = 2               # v2: request carries trace_id
-COMPAT_HANDOFF_VERSIONS = (1, 2)  # what this build's readers accept
+HANDOFF_VERSION = 3                  # v3: socket blob framing (federation)
+COMPAT_HANDOFF_VERSIONS = (1, 2, 3)  # what this build's readers accept
 # payload keys that are numpy arrays at the top level
 _ARRAY_META = ("prompt",)
 

@@ -82,6 +82,9 @@ class FleetRequest:
         self.handoffs = 0
         self.failovers = 0
         self.preemptions = 0
+        self.weights_version = 0    # version of the replica that served
+                                    # this request (rolling updates bump
+                                    # it; the parity tests split on it)
         self._inner: Optional[Request] = None   # local-backend engine req
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
@@ -166,6 +169,23 @@ class ServingFleet:
                 "backend='process' needs spec= (model/checkpoint + "
                 "serving config dict) — workers rebuild the engine from "
                 "it")
+        # -- federation (remote peers + HTTP front-end + rolling) ----------
+        self.fedcfg = self.fcfg.federation
+        self._peers = list(self.fedcfg.peers) if self.fedcfg else []
+        if self._peers and spec is None:
+            raise ValueError(
+                "serving.fleet.federation.peers needs spec= — remote "
+                "workers rebuild their engine from it over the wire")
+        self._lineage_peer: Dict[int, str] = {}   # lineage -> address:
+                                                  # a remote restart is a
+                                                  # RE-DIAL of its peer
+        self._draining = set()      # rids excluded from dispatch while a
+                                    # rolling update drains them
+        self._frontend = None       # FleetFrontend (drained each step)
+        self.rolling = None         # in-flight RollingUpdate
+        self.weights_version = 0    # bumped when a rolling update lands
+        self.rolling_updates = 0    # completed updates
+        self.rolling_swaps = 0      # individual replicas swapped
         page_len = (self.config.paging.page_len if self.config.paged
                     else self.config.prefill_bucket)
         self.router = Router(self.fcfg, page_len)
@@ -233,8 +253,11 @@ class ServingFleet:
                     min_slots=1, max_replicas=self.fcfg.max_replicas),
                 registry=self._scale_registry,
                 replica_slots=self.config.num_slots)
-        for _ in range(self.fcfg.replicas):
-            self._spawn_replica()
+        for i in range(self.fcfg.replicas):
+            # peers fill the LEADING replica ids so role_for assigns
+            # disaggregated roles to remote peers exactly as to locals
+            self._spawn_replica(
+                peer=self._peers[i] if i < len(self._peers) else None)
         self.replicas_spawned = 0       # construction is not a scale-up
         log_dist(
             f"serving fleet: {len(self._replicas)} replicas "
@@ -244,19 +267,40 @@ class ServingFleet:
 
     # -- replica lifecycle -------------------------------------------------
     def _spawn_replica(self, role: Optional[str] = None,
-                       lineage: Optional[int] = None):
+                       lineage: Optional[int] = None,
+                       peer: Optional[str] = None):
         rid = self._next_rid
         self._next_rid += 1
         role = role or self.fcfg.role_for(rid)
         if lineage is None:
             lineage = self.supervisor.register(role)
+        if peer is None:
+            # a remote lineage restarts by RE-DIALING its peer: the
+            # engine on the other end survives a dropped connection
+            peer = self._lineage_peer.get(lineage)
         self._lineage[rid] = lineage
-        if self.fcfg.backend == "process":
-            # the aggregator needs a scrape target, so a process
-            # replica under aggregation always gets an endpoint even
-            # when per-replica telemetry wasn't asked for explicitly
-            want_port = (self.fcfg.replica_telemetry
-                         or self._aggregator is not None)
+        # the aggregator needs a scrape target, so a process/remote
+        # replica under aggregation always gets an endpoint even when
+        # per-replica telemetry wasn't asked for explicitly
+        want_port = (self.fcfg.replica_telemetry
+                     or self._aggregator is not None)
+        if peer is not None:
+            from .federation.remote import RemoteReplica
+            self._lineage_peer[lineage] = peer
+            fed = self.fedcfg
+            rep = RemoteReplica(
+                rid, role, peer,
+                {**self._spec,
+                 "telemetry_port": 0 if want_port else None,
+                 # bugfix: the worker must bind /metrics on the dialed
+                 # interface, and the router scrapes that same host —
+                 # no localhost assumption on either end
+                 "telemetry_host": peer.rpartition(":")[0],
+                 "trace": self.fcfg.replica_trace},
+                connect_timeout_s=fed.connect_timeout_s,
+                reply_timeout_s=fed.reply_timeout_s,
+                max_frame_bytes=fed.max_frame_bytes)
+        elif self.fcfg.backend == "process":
             rep = ProcessReplica(rid, role,
                                  {**self._spec,
                                   "telemetry_port": 0 if want_port
@@ -268,9 +312,15 @@ class ServingFleet:
             rep = LocalReplica(rid, role, self._module, self._params,
                                self._replica_config,
                                telemetry=self.fcfg.replica_telemetry)
+        # spawns during/after a rolling update serve the NEW weights
+        # (the update stamps _module/_params/_spec at start)
+        rep.weights_version = (self.rolling.version
+                               if self.rolling is not None
+                               and not self.rolling.done
+                               else self.weights_version)
         self._replicas[rid] = rep
         if self._aggregator is not None:
-            if rep.backend == "process" and rep.telemetry_port:
+            if rep.backend != "inprocess" and rep.telemetry_port:
                 # reuse the replica's cached client: health sweeps and
                 # aggregator polls accumulate one staleness stamp
                 self._aggregator.add_scrape(rid, client=rep.scrape_client)
@@ -290,7 +340,35 @@ class ServingFleet:
                 if rep.alive and (roles is None or rep.role in roles)]
 
     def _stats(self, rids) -> List:
-        return [self._replicas[r].stats() for r in rids]
+        out = []
+        for r in rids:
+            s = self._replicas[r].stats()
+            if self._replicas[r].backend == "remote":
+                # scrape-driven routing (the deferred PR-12 half): a
+                # remote peer's synchronous stats ride the advance
+                # reply, but between replies its aggregator sample is
+                # the fresher load signal — stamp it so the router can
+                # weigh both (scraped off-step, read on-step: for a
+                # given scrape history the route replays bit-exactly)
+                s.scraped_load = self._scraped_load(r)
+            out.append(s)
+        return out
+
+    def _scraped_load(self, rid) -> Optional[float]:
+        if self._aggregator is None:
+            return None
+        entry = self._aggregator.replicas.get(rid)
+        sample = entry.get("sample") if entry else None
+        if not sample:
+            return None
+        total, seen = 0.0, False
+        for suffix in ("serving_queue_depth", "serving_active_slots"):
+            for key, value in sample.items():
+                if key.endswith(suffix):
+                    total += float(value)
+                    seen = True
+                    break
+        return total if seen else None
 
     def _submit_roles(self):
         if not self.fcfg.disaggregate:
@@ -305,7 +383,11 @@ class ServingFleet:
         telemetry considers dispatch-healthy (``up`` and not stale).
         Never empties the list on telemetry alone — with every replica
         stale the fleet still dispatches rather than bricking on its
-        own observability plane."""
+        own observability plane. Replicas a rolling update is draining
+        are excluded first (they finish what they own, take nothing
+        new), with the same never-empty fallback."""
+        undrained = [r for r in rids if r not in self._draining]
+        rids = undrained if undrained else rids
         if self._aggregator is None:
             return rids
         healthy = [r for r in rids if self._aggregator.healthy(r)]
@@ -392,6 +474,7 @@ class ServingFleet:
                   max_new: int):
         rep = self._replicas[rid]
         handle.replica_id = rid
+        handle.weights_version = getattr(rep, "weights_version", 0)
         if handle.prefill_replica_id is None:
             handle.prefill_replica_id = rid
         if rep.backend == "inprocess":
@@ -461,6 +544,10 @@ class ServingFleet:
         every live replica one engine step (lockstep), harvest
         completions, pump page handoffs, run the health sweep and the
         autoscaler on their cadences."""
+        if self._frontend is not None:
+            # HTTP arrivals enter the deterministic clock HERE, in FIFO
+            # mailbox order — handler threads never touch the fleet
+            self._frontend.drain(self)
         self._supervise_tick()
         for rid, rep in sorted(self._replicas.items()):
             if not rep.alive and rid not in self._failed:
@@ -492,6 +579,8 @@ class ServingFleet:
                     f"fleet: disaggregated fleet lost every {role} "
                     "replica — in-flight work cannot complete")
         self._redispatch_orphans()
+        if self.rolling is not None and not self.rolling.done:
+            self.rolling.tick(self)
         handoff_ready = []   # [(rid, id)] from process replicas
         for rid in self._alive():
             rep = self._replicas[rid]
@@ -817,6 +906,8 @@ class ServingFleet:
                 if handle is not None:
                     handle.replica_id = target
                     handle.handoffs += 1
+                    handle.weights_version = getattr(
+                        self._replicas[target], "weights_version", 0)
                 continue
             if error is None:
                 retry.append(ent)       # starvation: retry next step
@@ -1070,6 +1161,40 @@ class ServingFleet:
         log_dist(f"fleet: scale-down -> retired replica {rid} "
                  f"({len(victims)} requests re-dispatched)", ranks=[0])
 
+    # -- federation: HTTP front-end + rolling updates ----------------------
+    def attach_frontend(self, frontend):
+        """Wire a ``FleetFrontend``: its mailbox drains into ``submit``
+        at the top of every ``advance()`` (dispatch thread only — the
+        HTTP handler threads never touch the fleet)."""
+        self._frontend = frontend
+        return frontend
+
+    def start_rolling_update(self, *, checkpoint: Optional[str] = None,
+                             module=None, params=None,
+                             spec_update: Optional[dict] = None,
+                             verify: Optional[bool] = None):
+        """Begin a zero-downtime rolling weight update (federation/
+        rolling.py): manifest-verify the target, then drain -> swap ->
+        rejoin one replica per fleet step until the whole fleet serves
+        the new weights. Progress rides ``advance()``; the returned
+        ``RollingUpdate`` exposes ``done``/``snapshot()``."""
+        from .federation.rolling import RollingUpdate, RollingUpdateError
+        if self.rolling is not None and not self.rolling.done:
+            raise RollingUpdateError(
+                "a rolling update is already in progress "
+                f"(v{self.rolling.version}, "
+                f"{len(self.rolling.swapped)}/{len(self.rolling.order)} "
+                "swapped)")
+        fed = self.fedcfg
+        if verify is None:
+            verify = fed.rolling_verify if fed is not None else True
+        drain_cap = fed.rolling_drain_slot_cap if fed is not None else 1
+        self.rolling = RollingUpdate(
+            self, checkpoint=checkpoint, module=module, params=params,
+            spec_update=spec_update, verify=verify,
+            drain_slot_cap=drain_cap)
+        return self.rolling
+
     # -- telemetry ---------------------------------------------------------
     def per_request_breakdown(self, include_requests: bool = True) -> dict:
         """The per-request latency waterfall (observability/fleet.py):
@@ -1126,6 +1251,15 @@ class ServingFleet:
             "supervision": self.supervisor.snapshot(),
             "requests_submitted": self.requests_submitted,
             "requests_finished": self.requests_finished,
+            "remote_replicas": sum(
+                1 for rep in self._replicas.values()
+                if rep.backend == "remote" and rep.alive),
+            "weights_version": self.weights_version,
+            "rolling_updates": self.rolling_updates,
+            "rolling_swaps": self.rolling_swaps,
+            "rolling": (self.rolling.snapshot()
+                        if self.rolling is not None else None),
+            "draining": sorted(self._draining),
             "autoscale": self.last_scale_decision,
             "flight_recorder": self.recorder.snapshot(),
             "per_request_breakdown": self.per_request_breakdown(
@@ -1193,6 +1327,9 @@ class ServingFleet:
         return self.telemetry
 
     def close(self):
+        if self._frontend is not None:
+            f, self._frontend = self._frontend, None
+            f.stop()
         if self.telemetry is not None:
             t, self.telemetry = self.telemetry, None
             t.stop()

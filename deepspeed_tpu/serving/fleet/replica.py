@@ -96,6 +96,12 @@ class ReplicaStats:
     slot_cap: int = 0
     free_slots: int = 0
     class_ttft_p95: Dict[str, float] = field(default_factory=dict)
+    # Federation: the router-side view of a REMOTE peer's load, stamped
+    # by the manager from its FleetTelemetryAggregator snapshot (scraped
+    # off-step, read on-step — deterministic for a given scrape history).
+    # None for local replicas and never serialized: the worker's own
+    # stats reply has the authoritative synchronous numbers.
+    scraped_load: Optional[float] = None
 
     def to_dict(self) -> dict:
         return {"replica_id": self.replica_id, "alive": self.alive,
@@ -135,11 +141,14 @@ class LocalReplica:
         from ..engine import ServingEngine
         self.replica_id = replica_id
         self.role = role
+        self._config = config
+        self._telemetry = telemetry
         self.engine = ServingEngine(module, params, config)
         if role == "prefill":
             self.engine.set_prefill_role(True)
         self.alive = True
         self.missed_health = 0
+        self.weights_version = 0   # bumped by rolling updates
         self.fail_at: Optional[int] = None   # chaos: raise ReplicaCrash
                                              # once the clock passes this
         if telemetry:
@@ -212,6 +221,27 @@ class LocalReplica:
         return self.engine.inject_handoff(payload, request=request,
                                           on_token=on_token)
 
+    # -- rolling updates ---------------------------------------------------
+    def set_slot_cap(self, n: int):
+        """The PR 10 drain lever, surfaced on the replica interface so
+        rolling updates squeeze every backend the same way."""
+        self.engine.set_slot_cap(int(n))
+
+    def swap_weights(self, module, params):
+        """Rolling update: replace the engine wholesale with one built
+        from the new weights (same serving config, same role). Only
+        legal on a DRAINED replica — the manager guarantees zero
+        in-flight requests before calling."""
+        from ..engine import ServingEngine
+        had_telemetry = self.engine.telemetry is not None or self._telemetry
+        self.engine.close()
+        self.engine = ServingEngine(module, params, self._config)
+        if self.role == "prefill":
+            self.engine.set_prefill_role(True)
+        if had_telemetry:
+            self.engine.start_telemetry(port=0)
+        self.weights_version += 1
+
     # -- lifecycle ---------------------------------------------------------
     def kill(self):
         """Simulated hard death (the failover test's hook): the manager
@@ -244,6 +274,12 @@ class ProcessReplica:
         self.missed_health = 0
         self.reply_timeout_s = reply_timeout_s
         self.telemetry_port: Optional[int] = None
+        self.telemetry_host = "127.0.0.1"   # children bind loopback;
+                                            # RemoteReplica overrides with
+                                            # the host it dialed (bugfix:
+                                            # scrape URLs were localhost-
+                                            # only by assumption)
+        self.weights_version = 0            # bumped by rolling updates
         self.protocol_errors = 0   # malformed/truncated frames + reply
                                    # timeouts observed on this pipe
         self.last_partial_metrics: Optional[dict] = None
@@ -420,7 +456,7 @@ class ProcessReplica:
         if self._scrape is None:
             from ...observability.export import MetricsScrapeClient
             self._scrape = MetricsScrapeClient(
-                f"http://127.0.0.1:{self.telemetry_port}")
+                f"http://{self.telemetry_host}:{self.telemetry_port}")
         return self._scrape
 
     def probe_health(self) -> str:
@@ -469,6 +505,24 @@ class ProcessReplica:
         blob = base64.b64encode(serialize_handoff(payload)).decode("ascii")
         self._send({"op": "inject", "blob": blob})
         return bool(self._read_reply().get("accepted"))
+
+    # -- rolling updates ---------------------------------------------------
+    def set_slot_cap(self, n: int):
+        self._send({"op": "slot_cap", "n": int(n)})
+        self._read_reply()
+
+    def swap_weights_spec(self, spec_update: dict):
+        """Rolling update over the wire: the worker rebuilds its engine
+        from its init spec merged with ``spec_update`` (new checkpoint
+        or model seed). Returns the worker's fresh telemetry port (the
+        old endpoint died with the old engine)."""
+        self._send({"op": "swap", "spec": dict(spec_update)})
+        reply = self._read_reply()
+        self.telemetry_port = reply.get("telemetry_port")
+        self._scrape = None          # the endpoint moved with the port
+        self._last_stats = None
+        self.weights_version += 1
+        return self.telemetry_port
 
     # -- lifecycle ---------------------------------------------------------
     def _close_pipes(self):

@@ -99,10 +99,18 @@ class Router:
     def _load_key(s):
         """Least-loaded total order: outstanding work normalized by the
         admissible cap, then raw depth, then replica id — same stats
-        always pick the same replica."""
+        always pick the same replica. For REMOTE replicas the manager
+        stamps ``scraped_load`` (the aggregator's queue+active sample);
+        the pessimistic max of the synchronous and scraped views drives
+        the order, so a remote peer whose last advance reply predates a
+        local burst is not mistaken for idle — the scrape-driven half
+        of the PR-12 routing item."""
         cap = max(1, s.slot_cap)
-        return ((s.queue_depth + s.active_slots) / cap, s.queue_depth,
-                s.replica_id)
+        load = (s.queue_depth + s.active_slots) / cap
+        scraped = getattr(s, "scraped_load", None)
+        if scraped is not None:
+            load = max(load, scraped / cap)
+        return (load, s.queue_depth, s.replica_id)
 
     def route(self, prompt, stats: List, *, step: int = 0,
               request_id=None) -> int:

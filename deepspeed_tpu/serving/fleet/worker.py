@@ -8,6 +8,8 @@ telemetry port), builds its engine, then serves ops until ``stop``:
     {"op": "advance"}                 -> events/finished/handoffs/stats
     {"op": "export", "id"}            -> base64 handoff blob
     {"op": "inject", "blob": b64}     -> accepted true/false
+    {"op": "slot_cap", "n": N}        -> admission cap (rolling drain)
+    {"op": "swap", "spec": {...}}     -> rebuild engine (rolling update)
     {"op": "stop"}
 
 Replies go to stdout prefixed with the ``@fleet `` sentinel so they
@@ -61,9 +63,16 @@ def _build_engine(spec: dict):
 
 
 class _Worker:
+    # ``_reply`` is an instance METHOD (defaulting to the stdout pipe
+    # dialect) so the federation socket worker can subclass and answer
+    # over a FrameConnection instead — one op surface, two transports.
+    def _reply(self, msg: dict):
+        _reply(msg)
+
     def __init__(self, spec: dict):
         self.replica_id = spec.get("replica_id", 0)
         self.role = spec.get("role", "full")
+        self._spec = dict(spec)
         if spec.get("trace"):
             # fleet-wide tracing: this worker's spans (queue wait,
             # admit, prefill chunks, handoff inject, decode residency —
@@ -75,10 +84,7 @@ class _Worker:
         self.engine = _build_engine(spec)
         if self.role == "prefill":
             self.engine.set_prefill_role(True)
-        port = spec.get("telemetry_port")
-        telemetry_port = None
-        if port is not None:
-            telemetry_port = self.engine.start_telemetry(port=port).port
+        telemetry_port = self._start_telemetry(spec)
         self._handles = {}           # id -> Request
         self._reported = set()       # ids whose completion already went out
         self._admit_reported = set() # ids whose first admission went out
@@ -95,13 +101,30 @@ class _Worker:
         # the orchestrator) ships this worker's partial metrics snapshot
         # up the pipe before the default termination runs — a killed
         # replica's work must not vanish without a trace
-        signal.signal(signal.SIGTERM, self._on_sigterm)
-        _reply({"op": "ready", "replica_id": self.replica_id,
-                "telemetry_port": telemetry_port})
+        try:
+            signal.signal(signal.SIGTERM, self._on_sigterm)
+        except ValueError:
+            # federation tests host a socket worker on a non-main
+            # thread, where installing handlers is forbidden; the
+            # engine is still torn down by the stop op
+            pass
+        self._reply({"op": "ready", "replica_id": self.replica_id,
+                     "telemetry_port": telemetry_port})
+
+    def _start_telemetry(self, spec):
+        port = spec.get("telemetry_port")
+        if port is None:
+            return None
+        # bugfix ride-along: remote workers must bind their scrape
+        # endpoint on the federation listen interface, not the
+        # 127.0.0.1 the in-process spawn path assumed — the router's
+        # scrape client dials the host it dialed the worker on
+        host = spec.get("telemetry_host") or "127.0.0.1"
+        return self.engine.start_telemetry(port=port, host=host).port
 
     def _on_sigterm(self, signum, frame):
         try:
-            _reply({"op": "partial_metrics",
+            self._reply({"op": "partial_metrics",
                     "replica_id": self.replica_id,
                     "reason": f"signal {signum}",
                     "iteration": self.engine.iteration,
@@ -138,7 +161,7 @@ class _Worker:
             request_id=msg["id"], priority=msg.get("priority", 0),
             on_token=self._on_token, trace_id=msg.get("trace_id"))
         self._handles[msg["id"]] = req
-        _reply({"op": "submitted", "id": msg["id"], "status": req.status})
+        self._reply({"op": "submitted", "id": msg["id"], "status": req.status})
 
     def _admissions(self):
         """Ids admitted since the last advance reply (first admission
@@ -165,30 +188,72 @@ class _Worker:
         stats = {k: v for k, v in engine_stats(
             self.engine, self.replica_id, self.role).to_dict().items()
             if k not in ("replica_id", "alive", "role")}
-        _reply({"op": "advanced", "iteration": self.engine.iteration,
+        self._reply({"op": "advanced", "iteration": self.engine.iteration,
                 "events": events, "finished": self._completions(),
                 "admitted": self._admissions(),
                 "handoff_ready": sorted(self._staged, key=str),
                 "stats": stats})
 
-    def op_export(self, msg):
+    def _export_blob(self, msg) -> bytes:
+        """Pop the staged handoff and serialize it — shared by the pipe
+        dialect (base64 in the JSON reply) and the federation socket
+        (raw blob frame)."""
         slot, req = self._staged.pop(msg["id"])
         payload = self.engine.export_handoff(slot, req)
         self._handles.pop(msg["id"], None)   # completion lands elsewhere
-        _reply({"op": "payload", "id": msg["id"],
-                "blob": base64.b64encode(
-                    serialize_handoff(payload)).decode("ascii")})
+        return serialize_handoff(payload)
 
-    def op_inject(self, msg):
-        payload = deserialize_handoff(base64.b64decode(msg["blob"]))
+    def op_export(self, msg):
+        self._reply({"op": "payload", "id": msg["id"],
+                "blob": base64.b64encode(
+                    self._export_blob(msg)).decode("ascii")})
+
+    def _inject_payload(self, payload):
         rid = payload["request"]["request_id"]
         live = self.engine.inject_handoff(payload,
                                           on_token=self._on_token)
         if live is not None:
             self._handles[rid] = live
             self._admit_reported.add(rid)   # injection IS the admission
-        _reply({"op": "injected", "id": rid,
+        self._reply({"op": "injected", "id": rid,
                 "accepted": live is not None})
+
+    def op_inject(self, msg):
+        self._inject_payload(
+            deserialize_handoff(base64.b64decode(msg["blob"])))
+
+    def op_slot_cap(self, msg):
+        """Rolling-update drain lever: the parent squeezes this
+        replica's admission cap over the wire (the PR 10 slot-cap path)
+        so in-flight requests finish while nothing new is admitted."""
+        self.engine.set_slot_cap(int(msg["n"]))
+        self._reply({"op": "slot_capped", "n": int(msg["n"]),
+                     "iteration": self.engine.iteration})
+
+    def op_swap(self, msg):
+        """Rolling weight update: rebuild the engine from a new spec
+        (checkpoint or model seed). Refused while requests are in
+        flight — the parent drains first; a swap must never drop work."""
+        if self._handles and not all(r.done for r in self._handles.values()):
+            self._reply({"op": "error",
+                         "detail": "swap refused: requests in flight"})
+            return
+        spec = dict(self._spec)
+        spec.update(msg.get("spec") or {})
+        self.engine.close()
+        self._spec = spec
+        self.engine = _build_engine(spec)
+        if self.role == "prefill":
+            self.engine.set_prefill_role(True)
+        telemetry_port = self._start_telemetry(spec)
+        self._handles.clear()
+        self._reported.clear()
+        self._admit_reported.clear()
+        self._events = []
+        self._staged.clear()
+        self._reply({"op": "swapped", "replica_id": self.replica_id,
+                     "telemetry_port": telemetry_port,
+                     "iteration": self.engine.iteration})
 
     def op_trace_dump(self, msg):
         """Ship this worker's recorded span stream as Chrome-trace
@@ -196,7 +261,7 @@ class _Worker:
         from ...observability.trace import active_tracer, chrome_trace_events
         tracer = active_tracer()
         events = chrome_trace_events(tracer.events) if tracer else []
-        _reply({"op": "trace", "replica_id": self.replica_id,
+        self._reply({"op": "trace", "replica_id": self.replica_id,
                 "events": events})
 
     def serve(self):
@@ -210,16 +275,16 @@ class _Worker:
                 break
             handler = getattr(self, f"op_{op}", None)
             if handler is None:
-                _reply({"op": "error", "detail": f"unknown op {op!r}"})
+                self._reply({"op": "error", "detail": f"unknown op {op!r}"})
                 continue
             try:
                 handler(msg)
             except Exception as e:   # ds-tpu: lint-ok[PY001] — the
                 # protocol boundary: an op failure must reach the parent
                 # as a typed error reply, never kill the pipe silently
-                _reply({"op": "error", "detail": f"{op}: {e}"})
+                self._reply({"op": "error", "detail": f"{op}: {e}"})
         self.engine.close()
-        _reply({"op": "bye"})
+        self._reply({"op": "bye"})
 
 
 def main():

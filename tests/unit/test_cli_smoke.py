@@ -232,14 +232,15 @@ def test_serve_fleet_replica_crash_supervised_recovers(tmp_path):
 @pytest.mark.slow
 def test_chaos_fleet_scenario_pack():
     """The seeded fleet chaos pack (worker kill, crash loop, prefill
-    wipe, truncated handoff, hung worker) recovers end to end: exit 0
-    and every sub-scenario reports ok."""
+    wipe, truncated handoff, hung worker, partitioned federation
+    network) recovers end to end: exit 0 and every sub-scenario
+    reports ok."""
     r = _run([os.path.join(BIN, "ds_tpu_chaos"), "--scenario", "fleet"],
              timeout=570)
     assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-800:])
     assert "[chaos] all scenarios recovered" in r.stdout
     for sub in ("crash_loop", "prefill_wipe", "truncated_handoff",
-                "worker_kill", "hung_worker"):
+                "worker_kill", "hung_worker", "partitioned_network"):
         assert f"fleet/{sub}: RECOVERED" in r.stdout
 
 

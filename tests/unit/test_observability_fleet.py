@@ -416,7 +416,7 @@ class TestDiffAggregatedSnapshots:
 # handoff wire format v2 (trace_id travels; v1 still loads)
 # ---------------------------------------------------------------------------
 
-def _wire_payload(version=2, with_trace=True):
+def _wire_payload(version=3, with_trace=True):
     request = {"request_id": "r0", "prompt": np.arange(5, dtype=np.int32),
                "generated": [7], "max_new_tokens": 4, "priority": 1}
     if with_trace:
@@ -430,16 +430,24 @@ def _wire_payload(version=2, with_trace=True):
 
 
 class TestHandoffWireV2:
-    def test_v2_roundtrip_carries_trace_id(self):
+    def test_roundtrip_carries_trace_id(self):
         from deepspeed_tpu.serving.fleet.handoff import (
             HANDOFF_VERSION, deserialize_handoff, serialize_handoff)
-        assert HANDOFF_VERSION == 2
+        assert HANDOFF_VERSION == 3   # v3: federation socket blob framing
         payload = _wire_payload()
         out = deserialize_handoff(serialize_handoff(payload))
-        assert out["version"] == 2
+        assert out["version"] == 3
         assert out["request"]["trace_id"] == payload["request"]["trace_id"]
         np.testing.assert_array_equal(out["kv"][0]["k"],
                                       payload["kv"][0]["k"])
+
+    def test_v2_payload_still_loads(self):
+        from deepspeed_tpu.serving.fleet.handoff import (
+            deserialize_handoff, serialize_handoff)
+        blob = serialize_handoff(_wire_payload(version=2))
+        out = deserialize_handoff(blob)
+        assert out["version"] == 2
+        assert out["request"]["trace_id"] is not None
 
     def test_v1_payload_still_loads(self):
         from deepspeed_tpu.serving.fleet.handoff import (
