@@ -24,6 +24,9 @@ from deepspeed_tpu.serving.fleet.federation.transport import (
 _LAZY = {
     "RemoteReplica": "deepspeed_tpu.serving.fleet.federation.remote",
     "FleetFrontend": "deepspeed_tpu.serving.fleet.federation.frontend",
+    "FrontendOverloaded": "deepspeed_tpu.serving.fleet.federation.frontend",
+    "WireFaultInjector": "deepspeed_tpu.serving.fleet.federation.netfaults",
+    "WireFaultPlan": "deepspeed_tpu.serving.fleet.federation.netfaults",
     "RollingUpdate": "deepspeed_tpu.serving.fleet.federation.rolling",
     "RollingUpdateError": "deepspeed_tpu.serving.fleet.federation.rolling",
     "FederationWorkerServer": "deepspeed_tpu.serving.fleet.federation.worker",

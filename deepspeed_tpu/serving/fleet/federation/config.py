@@ -25,6 +25,32 @@ class FederationConfig:
     connect_timeout_s: float = 5.0
     reply_timeout_s: float = 60.0
     max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES
+    heartbeat_timeout_s: float = 5.0
+                        # the health sweep's ping deadline: a half-open
+                        # connection (writes vanish, nothing returns)
+                        # is declared dead after one silent heartbeat;
+                        # 0 disables the probe (pre-PR-19 behavior)
+    send_timeout_s: float = 10.0
+                        # deadline on every outbound sendall — a peer
+                        # that stops draining its receive window reads
+                        # as WorkerProtocolError("timeout") instead of
+                        # wedging the dispatch thread
+    outbound_queue_limit: int = 64
+                        # bound on the router's staged-handoff outbound
+                        # queue: past it the OLDEST payload is dropped
+                        # and its request re-prefills through failover
+                        # (a wedged decode pool must produce bounded
+                        # memory, not an unbounded backlog); 0 disables
+    http_queue_cap: int = 0
+                        # FleetFrontend admission bound: submissions
+                        # past this many queued+in-flight requests get
+                        # 429 + Retry-After instead of queueing
+                        # unboundedly; 0 = unbounded (legacy)
+    http_results_cap: int = 256
+                        # unread finished results retained by the
+                        # front-end (LRU): a completed request's record
+                        # is evicted on its first /v1/result read, or
+                        # when this many newer finals pile up unread
     http_host: str = "127.0.0.1"
     http_port: Optional[int] = None
     rolling_verify: bool = True
@@ -46,6 +72,24 @@ class FederationConfig:
         if self.max_frame_bytes < 4096:
             raise ValueError(
                 "serving.fleet.federation.max_frame_bytes must be >= 4096")
+        if self.heartbeat_timeout_s < 0:
+            raise ValueError(
+                "serving.fleet.federation.heartbeat_timeout_s must be "
+                ">= 0 (0 disables the heartbeat probe)")
+        if self.send_timeout_s <= 0:
+            raise ValueError(
+                "serving.fleet.federation.send_timeout_s must be > 0")
+        if self.outbound_queue_limit < 0:
+            raise ValueError(
+                "serving.fleet.federation.outbound_queue_limit must be "
+                ">= 0 (0 disables the bound)")
+        if self.http_queue_cap < 0:
+            raise ValueError(
+                "serving.fleet.federation.http_queue_cap must be >= 0 "
+                "(0 disables the bound)")
+        if self.http_results_cap < 1:
+            raise ValueError(
+                "serving.fleet.federation.http_results_cap must be >= 1")
         if self.http_port is not None and not (0 <= self.http_port < 65536):
             raise ValueError(
                 "serving.fleet.federation.http_port must be in [0, 65536) "

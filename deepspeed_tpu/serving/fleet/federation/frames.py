@@ -3,12 +3,28 @@
 The single-host fleet speaks sentinel-prefixed line JSON over pipes
 (`serving/fleet/worker.py`); a TCP byte stream has no line discipline a
 reader can trust, so the federation wire promotes each message to a
-framed record:
+framed record. Two wire revisions coexist:
+
+    DSF1 (rev 1, length only — a bit-flipped payload parses clean):
 
     +-------+------+----------------+---------...---+
     | magic | kind | length (u32 BE)| payload       |
     | 4 B   | 1 B  | 4 B            | `length` B    |
     +-------+------+----------------+---------...---+
+
+    DSF2 (rev 2, integrity-checked — crc32 of the payload rides the
+    header, so wire corruption surfaces as a NAMED fault instead of a
+    silently-wrong message):
+
+    +-------+------+----------------+----------------+---------...---+
+    | magic | kind | length (u32 BE)| crc32 (u32 BE) | payload       |
+    | 4 B   | 1 B  | 4 B            | 4 B            | `length` B    |
+    +-------+------+----------------+----------------+---------...---+
+
+The decoder accepts BOTH revisions per frame (the magic selects the
+header layout), so the revision a connection *sends* is negotiated at
+dial — ``wire_rev`` advertised in the init/ready exchange — and a DSF1
+peer interoperates untouched (transport.py owns the negotiation).
 
 ``kind`` distinguishes JSON control frames from raw binary blobs (the
 npz KV-handoff payload travels as a blob frame — no base64 detour).
@@ -19,13 +35,18 @@ backend does. Stdlib-only: no jax, importable from codec unit tests.
 """
 
 import struct
+import zlib
 
 MAGIC = b"DSF1"
+MAGIC2 = b"DSF2"
+WIRE_REV = 2                 # highest revision this build speaks
 KIND_JSON = 0
 KIND_BLOB = 1
 _KINDS = (KIND_JSON, KIND_BLOB)
 _HEADER = struct.Struct(">4sBI")
+_HEADER2 = struct.Struct(">4sBII")
 HEADER_BYTES = _HEADER.size
+HEADER2_BYTES = _HEADER2.size
 # One handoff blob for the demo configs is ~100 KiB; 64 MiB leaves room
 # for real model pages while still rejecting a garbage length prefix
 # before the reader tries to buffer gigabytes.
@@ -35,9 +56,11 @@ DEFAULT_MAX_FRAME_BYTES = 64 << 20
 class FrameError(ValueError):
     """A frame that cannot be decoded, with a machine-readable ``kind``:
     ``"malformed"`` (bad magic / kind byte / JSON), ``"truncated"``
-    (EOF mid-frame), ``"oversize"`` (declared length over the cap), or
-    ``"timeout"`` (no bytes within the read deadline — raised by the
-    transport layer, named here so every wire fault shares one type)."""
+    (EOF mid-frame), ``"oversize"`` (declared length over the cap),
+    ``"corrupt"`` (DSF2 payload fails its crc32 — the wire flipped a
+    bit), or ``"timeout"`` (no bytes within the read deadline, or a
+    send stalled past its deadline — raised by the transport layer,
+    named here so every wire fault shares one type)."""
 
     def __init__(self, kind, detail):
         self.kind = kind
@@ -45,18 +68,34 @@ class FrameError(ValueError):
         super().__init__(f"frame error ({kind}): {detail}")
 
 
-def encode_frame(payload, kind=KIND_JSON):
-    """``bytes`` for one frame; ``payload`` must already be encoded."""
+def encode_frame(payload, kind=KIND_JSON, rev=1):
+    """``bytes`` for one frame; ``payload`` must already be encoded.
+    ``rev`` selects the wire revision: 1 = DSF1 (length only), 2 = DSF2
+    (crc32-checked). Senders must not emit rev 2 until the peer has
+    advertised it (negotiated at dial — see transport.py)."""
     if kind not in _KINDS:
         raise ValueError(f"unknown frame kind {kind!r}")
-    return _HEADER.pack(MAGIC, kind, len(payload)) + payload
+    if rev == 1:
+        return _HEADER.pack(MAGIC, kind, len(payload)) + payload
+    if rev == 2:
+        return _HEADER2.pack(MAGIC2, kind, len(payload),
+                             zlib.crc32(payload) & 0xFFFFFFFF) + payload
+    raise ValueError(f"unknown wire revision {rev!r}")
 
 
 class FrameDecoder:
     """Incremental decoder: ``feed`` raw socket bytes, ``next_frame``
     yields complete ``(kind, payload)`` records (or None while a frame
-    is still partial). The caller signals stream end via ``eof()`` so a
-    torn frame surfaces as a named error instead of a silent drop."""
+    is still partial). Both wire revisions decode — the magic selects
+    the header layout per frame. The caller signals stream end via
+    ``eof()`` so a torn frame surfaces as a named error instead of a
+    silent drop.
+
+    Buffering is bounded: a complete-but-undrained prefix aside, the
+    decoder never holds more than one partial frame, and a partial
+    frame never exceeds ``max_frame_bytes`` + header (the length field
+    is validated BEFORE the body is buffered — a garbage length prefix
+    cannot make the reader buffer gigabytes)."""
 
     def __init__(self, max_frame_bytes=DEFAULT_MAX_FRAME_BYTES):
         self.max_frame_bytes = int(max_frame_bytes)
@@ -71,13 +110,21 @@ class FrameDecoder:
         self._buf += data
 
     def next_frame(self):
-        if len(self._buf) < HEADER_BYTES:
+        if len(self._buf) < 4:
             return None
-        magic, kind, length = _HEADER.unpack_from(self._buf)
-        if magic != MAGIC:
+        magic = bytes(self._buf[:4])
+        if magic == MAGIC:
+            header, header_bytes, want_crc = _HEADER, HEADER_BYTES, False
+        elif magic == MAGIC2:
+            header, header_bytes, want_crc = _HEADER2, HEADER2_BYTES, True
+        else:
             raise FrameError(
                 "malformed",
-                f"bad magic {bytes(self._buf[:4])!r} (expected {MAGIC!r})")
+                f"bad magic {magic!r} (expected {MAGIC!r} or {MAGIC2!r})")
+        if len(self._buf) < header_bytes:
+            return None
+        fields = header.unpack_from(self._buf)
+        kind, length = fields[1], fields[2]
         if kind not in _KINDS:
             raise FrameError("malformed", f"unknown frame kind {kind}")
         if length > self.max_frame_bytes:
@@ -85,10 +132,22 @@ class FrameDecoder:
                 "oversize",
                 f"declared length {length} exceeds cap "
                 f"{self.max_frame_bytes}")
-        end = HEADER_BYTES + length
+        end = header_bytes + length
         if len(self._buf) < end:
             return None
-        payload = bytes(self._buf[HEADER_BYTES:end])
+        payload = bytes(self._buf[header_bytes:end])
+        if want_crc:
+            crc = zlib.crc32(payload) & 0xFFFFFFFF
+            if crc != fields[3]:
+                # consume the frame before raising: the STREAM is still
+                # framed correctly — only this payload is damaged — but
+                # the request/reply pairing is broken either way, so the
+                # caller still treats it as a containment event
+                del self._buf[:end]
+                raise FrameError(
+                    "corrupt",
+                    f"payload crc32 {crc:#010x} != header "
+                    f"{fields[3]:#010x} ({length} bytes)")
         del self._buf[:end]
         return kind, payload
 

@@ -13,17 +13,35 @@ Endpoints:
 
 - ``POST /v1/submit``  body ``{"prompt": [ints], "max_new_tokens": N,
   "priority": P}`` → ``{"request_id": ...}`` (202; the request is
-  queued, not yet dispatched)
+  queued, not yet dispatched) — or 429 + ``Retry-After`` when the
+  admission bound is hit (below)
 - ``GET /v1/result?id=ID`` → ``{"request_id", "status", "tokens",
-  "done"}``
+  "done"}``; the first read of a FINISHED result consumes it (the
+  record is evicted — results are read-once so memory stays bounded)
 - ``GET /v1/stream?id=ID`` → ``application/x-ndjson``: one
-  ``{"token": t}`` line per generated token as it lands, then a final
-  ``{"done": true, "status": ...}`` line.
+  ``{"token": t}`` line per generated token as it lands,
+  ``{"keepalive": true}`` lines while the request sits queued behind a
+  busy fleet (so proxies and client read-timeouts see a live socket),
+  then a final ``{"done": true, "status": ...}`` line.
+
+Backpressure (byzantine-wire hardening): with ``queue_cap`` set, a
+submission past ``queue_cap`` open requests (queued + dispatched, not
+yet read) is REFUSED with 429 and a ``Retry-After`` hint instead of
+growing the mailbox without bound. The hint rides the QoS ladder's
+shed signal: while the fleet is shedding (a drained completion came
+back ``status == "shed"``, or the fleet reports degraded mode) the
+advertised backoff stretches, so well-behaved clients ease off exactly
+when the engines are load-shedding. Accepted requests are NEVER
+dropped by the front-end — 429 happens at admission or not at all.
+
+Retention: finished results a client never reads can't accumulate
+forever either — ``results_cap`` bounds them LRU, oldest unread final
+evicted first (and counted in ``results_evicted_unread``).
 """
 
 import json
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -31,7 +49,19 @@ import numpy as np
 
 _STREAM_POLL_S = 0.25      # long-poll wakeup cadence (transport-side
                            # only; never consulted by dispatch)
+_STREAM_KEEPALIVE_S = 5.0  # idle ndjson keepalive cadence
 _STREAM_MAX_WAIT_S = 600.0
+_RETRY_AFTER_S = 1          # admission-bound backoff hint
+_RETRY_AFTER_SHED_S = 5     # ...stretched while the QoS ladder sheds
+
+
+class FrontendOverloaded(RuntimeError):
+    """A submission refused at the admission bound (HTTP 429).
+    ``retry_after_s`` is the backoff hint the handler advertises."""
+
+    def __init__(self, msg, retry_after_s):
+        self.retry_after_s = int(retry_after_s)
+        super().__init__(msg)
 
 
 class _FrontendRequest:
@@ -67,34 +97,63 @@ class _FrontendRequest:
 class FleetFrontend:
     """Lock-protected mailbox between HTTP handler threads and the
     fleet dispatch thread. ``start()`` binds the server; ``drain()``
-    must only ever run on the dispatch thread."""
+    must only ever run on the dispatch thread.
 
-    def __init__(self, host="127.0.0.1", port=0):
+    ``queue_cap`` bounds OPEN requests (submitted, not yet finished);
+    0 keeps the legacy unbounded mailbox. ``results_cap`` bounds
+    finished-but-unread result records (LRU)."""
+
+    def __init__(self, host="127.0.0.1", port=0, *,
+                 queue_cap=0, results_cap=256):
         self._host = host
         self._port = port
+        self.queue_cap = int(queue_cap)
+        self.results_cap = int(results_cap)
         self._lock = threading.Lock()
         self._pending = deque()      # submitted via HTTP, not dispatched
-        self._requests = {}          # id -> _FrontendRequest
+        self._requests = {}          # id -> _FrontendRequest (open + unread)
+        self._finished = OrderedDict()   # id -> rec, finished, not yet
+                                         # read (LRU, oldest first)
         self._next_id = 0
+        self._open = 0               # submitted - finished
+        self._shedding = False       # the QoS ladder's shed signal, as
+                                     # seen by the last drain()
         self._active = []            # dispatched, awaiting completion
         self._server = None
         self._thread = None
         self.submitted = 0
         self.finished = 0
+        self.rejected_429 = 0
+        self.results_evicted_unread = 0
 
     @property
     def port(self):
         return self._server.server_address[1] if self._server else None
 
+    def retry_after_s(self) -> int:
+        """The Retry-After hint: stretched while the fleet's QoS ladder
+        is shedding (degraded engines want a longer breather than a
+        momentary queue spike does)."""
+        return _RETRY_AFTER_SHED_S if self._shedding else _RETRY_AFTER_S
+
     def submit(self, prompt, max_new_tokens, priority=0):
-        """HTTP-thread side: enqueue and hand back the request id."""
+        """HTTP-thread side: enqueue and hand back the request id, or
+        raise :class:`FrontendOverloaded` at the admission bound —
+        refusal happens HERE or never (an accepted request is never
+        dropped by the front-end)."""
         with self._lock:
+            if self.queue_cap > 0 and self._open >= self.queue_cap:
+                self.rejected_429 += 1
+                raise FrontendOverloaded(
+                    f"{self._open} requests open >= queue_cap "
+                    f"{self.queue_cap}", self.retry_after_s())
             self._next_id += 1
             rid = f"http-{self._next_id}"
             rec = _FrontendRequest(rid, [int(t) for t in prompt],
                                    int(max_new_tokens), int(priority))
             self._requests[rid] = rec
             self._pending.append(rec)
+            self._open += 1
             self.submitted += 1
         return rid
 
@@ -102,9 +161,25 @@ class FleetFrontend:
         with self._lock:
             return self._requests.get(request_id)
 
+    def read_result(self, request_id):
+        """The /v1/result read: returns the record's view, and CONSUMES
+        a finished record — the first successful read of a done result
+        evicts it (read-once keeps retention bounded without a TTL
+        clock)."""
+        with self._lock:
+            rec = self._requests.get(request_id)
+            if rec is None:
+                return None
+            view = rec.view()
+            if view["done"]:
+                self._requests.pop(request_id, None)
+                self._finished.pop(request_id, None)
+            return view
+
     def drain(self, fleet):
         """Dispatch-thread side: FIFO-submit everything queued since
-        the last fleet step, then publish completions."""
+        the last fleet step, then publish completions (and refresh the
+        shed signal the 429 path advertises)."""
         while True:
             with self._lock:
                 if not self._pending:
@@ -117,13 +192,33 @@ class FleetFrontend:
                 on_token=rec.on_token)
             self._active.append(rec)
         still = []
+        shed_seen = False
         for rec in self._active:
             if rec.handle is not None and rec.handle.done:
                 self.finished += 1
+                shed_seen = shed_seen or rec.handle.status == "shed"
                 rec.finish(rec.handle.status)
+                self._retire(rec)
             else:
                 still.append(rec)
         self._active = still
+        # the shed signal: sticky while the fleet reports degraded mode,
+        # pulsed by any shed completion this step
+        self._shedding = shed_seen or bool(getattr(fleet, "degraded",
+                                                   False))
+
+    def _retire(self, rec):
+        """Move a completed record into the bounded unread-finals LRU,
+        evicting the oldest unread result past ``results_cap``."""
+        with self._lock:
+            self._open -= 1
+            if rec.request_id not in self._requests:
+                return          # already consumed by a racing read
+            self._finished[rec.request_id] = rec
+            while len(self._finished) > self.results_cap > 0:
+                old_rid, _old = self._finished.popitem(last=False)
+                self._requests.pop(old_rid, None)
+                self.results_evicted_unread += 1
 
     @property
     def busy(self):
@@ -139,11 +234,13 @@ class FleetFrontend:
             def log_message(self, fmt, *args):
                 pass
 
-            def _reply(self, code, obj):
+            def _reply(self, code, obj, headers=()):
                 body = json.dumps(obj).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in headers:
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -160,20 +257,29 @@ class FleetFrontend:
                 except (ValueError, KeyError, TypeError) as e:
                     self._reply(400, {"error": f"bad submission: {e}"})
                     return
-                rid = frontend.submit(prompt, max_new, priority)
+                try:
+                    rid = frontend.submit(prompt, max_new, priority)
+                except FrontendOverloaded as e:
+                    self._reply(
+                        429,
+                        {"error": f"overloaded: {e}",
+                         "retry_after_s": e.retry_after_s},
+                        headers=(("Retry-After", str(e.retry_after_s)),))
+                    return
                 self._reply(202, {"request_id": rid})
 
             def do_GET(self):
                 url = urlparse(self.path)
                 rid = (parse_qs(url.query).get("id") or [None])[0]
-                rec = frontend.get(rid) if rid else None
                 if url.path == "/v1/result":
-                    if rec is None:
+                    view = frontend.read_result(rid) if rid else None
+                    if view is None:
                         self._reply(404, {"error": f"unknown id {rid!r}"})
                         return
-                    self._reply(200, rec.view())
+                    self._reply(200, view)
                     return
                 if url.path == "/v1/stream":
+                    rec = frontend.get(rid) if rid else None
                     if rec is None:
                         self._reply(404, {"error": f"unknown id {rid!r}"})
                         return
@@ -187,13 +293,25 @@ class FleetFrontend:
                 self.end_headers()
                 sent = 0
                 waited = 0.0
+                idle = 0.0
                 while waited < _STREAM_MAX_WAIT_S:
                     with rec._cond:
                         if sent == len(rec.tokens) and not rec.done:
                             rec._cond.wait(_STREAM_POLL_S)
                             waited += _STREAM_POLL_S
+                            idle += _STREAM_POLL_S
                         fresh = rec.tokens[sent:]
                         done, status = rec.done, rec.status
+                    if fresh:
+                        idle = 0.0
+                    elif not done and idle >= _STREAM_KEEPALIVE_S:
+                        # a backpressured fleet can hold a request
+                        # queued for a while: keep the socket visibly
+                        # alive for proxies and client read-timeouts
+                        idle = 0.0
+                        self.wfile.write(
+                            json.dumps({"keepalive": True}).encode()
+                            + b"\n")
                     for token in fresh:
                         self.wfile.write(
                             json.dumps({"token": token}).encode() + b"\n")

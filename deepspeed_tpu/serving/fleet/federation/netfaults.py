@@ -1,0 +1,199 @@
+"""Deterministic network fault injection for the federation wire.
+
+``runtime/resilience/faults.py`` for TCP: a seeded, frame-ordinal-keyed
+injector that sits on a :class:`~.transport.FrameConnection`'s outbound
+frame hook and damages specific frames — so every byzantine-wire claim
+in docs/serving.md's failure matrix is *demonstrated* by a replayable
+fault schedule rather than asserted. Usable from three places:
+
+- unit tests: build a :class:`WireFaultPlan`, attach a
+  :class:`WireFaultInjector` to one end of a socketpair, assert the
+  receiver's named containment;
+- the worker chaos spec: ``spec["chaos"]["netfaults"] = {...plan
+  kwargs...}`` makes a federation worker damage its OWN replies
+  (`federation/worker.py` attaches the injector, and keeps it across
+  reconnects so the ordinal clock never rewinds mid-scenario);
+- ``ds_tpu_chaos --scenario fleet``: the ``flaky_network`` sub drives a
+  live 2-host socket fleet through a seeded fault window and gates on
+  token-exactness.
+
+Determinism contract: the schedule is a pure function of (seed, frame
+ordinal) via crc32 folds — same seed, same faults, every run; there is
+no global RNG and no wall-clock in any *decision* (delays/drips sleep,
+but whether and where they fire is ordinal-keyed).
+
+Fault kinds (``FAULT_KINDS``):
+
+- ``corrupt``   flip one payload byte (position seeded) — a DSF2
+                receiver raises ``FrameError("corrupt")``; a DSF1
+                receiver would parse it clean, which is exactly the
+                gap DSF2 closes;
+- ``truncate``  send a prefix, then sever the connection (torn frame
+                → ``FrameError("truncated")`` at the receiver's EOF);
+- ``delay``     hold the frame ``delay_s`` before sending (trips read
+                deadlines when long, reorders wall timing when short);
+- ``duplicate`` send the frame twice (the receiver's seq fence must
+                drop the echo);
+- ``reorder``   hold the frame and release it AFTER the next one (held
+                frames flush on close so a quiet connection doesn't
+                turn a reorder into a silent drop);
+- ``drip``      send the frame in small chunks with pauses (exercises
+                the incremental decoder under adversarial pacing);
+- ``blackhole`` swallow this frame and every later one (half-open TCP:
+                the peer's heartbeat deadline is the detector).
+
+Stdlib-only; no jax.
+"""
+
+import time
+import zlib
+
+FAULT_KINDS = ("corrupt", "truncate", "delay", "duplicate", "reorder",
+               "drip", "blackhole")
+
+
+def _unit(seed, *parts):
+    """Deterministic [0, 1) from crc32 folds (the repo's no-salted-hash
+    discipline: stable across processes and Python versions)."""
+    key = ":".join(str(p) for p in (seed,) + parts).encode("utf-8")
+    return (zlib.crc32(key) & 0xFFFFFFFF) / 4294967296.0
+
+
+class WireFaultPlan:
+    """Which fault (if any) hits outbound frame ordinal ``n``.
+
+    Two layers, explicit winning over seeded: ``faults`` maps exact
+    ordinals to kinds ({12: "corrupt"}); the seeded layer fires inside
+    the ``[start, stop)`` ordinal window at probability ``rate``,
+    picking uniformly from ``kinds``. Everything derives from
+    ``(seed, ordinal)`` — ``schedule(n)`` materializes the prefix so
+    tests can assert same-seed equality."""
+
+    def __init__(self, seed=0, rate=0.0, kinds=FAULT_KINDS, faults=None,
+                 start=0, stop=None, delay_s=0.05, drip_chunks=8):
+        for kind in tuple(kinds) + tuple((faults or {}).values()):
+            if kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"unknown wire fault kind {kind!r} "
+                    f"(must be one of {FAULT_KINDS})")
+        if not 0.0 <= float(rate) <= 1.0:
+            raise ValueError(f"netfault rate must be in [0, 1], got {rate}")
+        self.seed = int(seed)
+        self.rate = float(rate)
+        self.kinds = tuple(kinds)
+        self.faults = {int(k): v for k, v in (faults or {}).items()}
+        self.start = int(start)
+        self.stop = None if stop is None else int(stop)
+        self.delay_s = float(delay_s)
+        self.drip_chunks = max(2, int(drip_chunks))
+
+    @classmethod
+    def from_spec(cls, spec):
+        """Build from a JSON-able dict (the worker chaos spec vehicle:
+        ``spec["chaos"]["netfaults"]``)."""
+        return cls(**dict(spec or {}))
+
+    def fault_at(self, ordinal):
+        """Fault kind for outbound frame ``ordinal``, or None."""
+        ordinal = int(ordinal)
+        if ordinal in self.faults:
+            return self.faults[ordinal]
+        if not self.rate or not self.kinds:
+            return None
+        if ordinal < self.start or \
+                (self.stop is not None and ordinal >= self.stop):
+            return None
+        if _unit(self.seed, ordinal) >= self.rate:
+            return None
+        pick = int(_unit(self.seed, ordinal, "kind") * len(self.kinds))
+        return self.kinds[min(pick, len(self.kinds) - 1)]
+
+    def schedule(self, n):
+        """``[(ordinal, kind), ...]`` for the first ``n`` ordinals —
+        the determinism probe (same seed → identical schedule)."""
+        out = []
+        for i in range(int(n)):
+            kind = self.fault_at(i)
+            if kind is not None:
+                out.append((i, kind))
+        return out
+
+
+class WireFaultInjector:
+    """The live end of a plan: attach to ``conn.fault_injector`` and
+    every outbound frame routes through :meth:`send`, which applies the
+    plan's fault for that frame's ordinal. ``fired`` logs
+    ``(ordinal, kind)`` for test assertions."""
+
+    def __init__(self, plan):
+        self.plan = plan
+        self.tx_ordinal = 0
+        self.fired = []
+        self._held = None        # a frame parked by "reorder"
+        self.blackholed = False
+
+    def send(self, conn, frame):
+        n = self.tx_ordinal
+        self.tx_ordinal += 1
+        if self.blackholed:
+            return               # half-open: everything vanishes
+        kind = self.plan.fault_at(n)
+        if kind is not None:
+            self.fired.append((n, kind))
+        held, self._held = self._held, None
+        if kind == "blackhole":
+            self.blackholed = True
+            return
+        if kind == "corrupt":
+            frame = self._flip_byte(frame, n)
+        elif kind == "truncate":
+            conn._raw_send(frame[:max(1, len(frame) // 2)])
+            conn.close()         # torn frame: receiver EOFs mid-frame
+            return
+        elif kind == "delay":
+            time.sleep(self.plan.delay_s)
+        elif kind == "reorder":
+            self._held = frame   # released after the NEXT frame
+            if held is not None:
+                conn._raw_send(held)
+            return
+        elif kind == "drip":
+            self._drip(conn, frame)
+            if held is not None:
+                conn._raw_send(held)
+            return
+        conn._raw_send(frame)
+        if kind == "duplicate":
+            conn._raw_send(frame)
+        if held is not None:
+            conn._raw_send(held)
+
+    def flush(self, conn):
+        """Release a reorder-held frame (called from teardown paths so
+        a quiet connection doesn't turn a reorder into a drop)."""
+        held, self._held = self._held, None
+        if held is not None and not conn.closed:
+            conn._raw_send(held)
+
+    def _flip_byte(self, frame, ordinal):
+        """Flip one PAYLOAD byte (never the header: the point is a
+        frame that still parses structurally but fails its crc)."""
+        from deepspeed_tpu.serving.fleet.federation.frames import (
+            HEADER_BYTES, HEADER2_BYTES, MAGIC2)
+        header = HEADER2_BYTES if frame[:4] == MAGIC2 else HEADER_BYTES
+        if len(frame) <= header:
+            return frame         # empty payload: nothing to damage
+        pos = header + int(_unit(self.plan.seed, ordinal, "pos")
+                           * (len(frame) - header))
+        pos = min(pos, len(frame) - 1)
+        out = bytearray(frame)
+        out[pos] ^= 0xFF
+        return bytes(out)
+
+    def _drip(self, conn, frame):
+        step = max(1, len(frame) // self.plan.drip_chunks)
+        pause = self.plan.delay_s / self.plan.drip_chunks
+        for i in range(0, len(frame), step):
+            conn._raw_send(frame[i:i + step])
+            if i + step < len(frame):
+                time.sleep(pause)

@@ -10,11 +10,31 @@ dialing the host the worker was dialed on instead of assuming
 localhost.
 
 Containment maps 1:1 onto PR 15's taxonomy: a read timeout, torn
-frame, or undecodable frame is a named ``WorkerProtocolError`` (kind
-timeout/truncated/malformed) — the connection is desynchronized, the
-replica is declared dead, and supervision's restart path runs, which
-for a remote lineage means RE-DIALING the peer (the engine on the
-other end survives a dropped connection; reconnect is the restart).
+frame, undecodable frame, or crc-failing DSF2 frame is a named
+``WorkerProtocolError`` (kind timeout/truncated/malformed/corrupt) —
+the connection is desynchronized, the replica is declared dead, and
+supervision's restart path runs, which for a remote lineage means
+RE-DIALING the peer (the engine on the other end survives a dropped
+connection; reconnect is the restart).
+
+Byzantine-wire hardening (PR 19):
+
+- wire revision is negotiated at dial (``wire_rev`` in init/ready):
+  new↔new pairs speak crc32-checked DSF2, a DSF1-only peer keeps
+  interoperating;
+- every request is stamped with this incarnation's ``_epoch`` and a
+  per-connection ``_seq``; the worker echoes both into its reply, and
+  the reader FENCES what comes back — a delayed reply from a
+  pre-restart incarnation (wrong epoch) or a duplicated frame (stale
+  seq) is dropped and counted (``fleet/stale_epoch_replies``,
+  ``fleet/duplicate_replies``), never applied;
+- the health sweep's probe sends a heartbeat ping with its own short
+  deadline, so a half-open TCP connection (peer power-loss, dropped
+  NAT state — writes succeed, nothing ever comes back) is detected on
+  the sweep cadence instead of on the next real request;
+- sends carry a deadline (``send_timeout_s``): a peer that stops
+  draining its receive window surfaces as the named timeout instead of
+  wedging the fleet's dispatch thread.
 """
 
 from typing import Optional
@@ -31,6 +51,7 @@ from deepspeed_tpu.serving.fleet.replica import (
 from deepspeed_tpu.serving.fleet.federation.frames import (
     DEFAULT_MAX_FRAME_BYTES,
     FrameError,
+    WIRE_REV,
 )
 from deepspeed_tpu.serving.fleet.federation.transport import (
     PeerGone,
@@ -47,7 +68,10 @@ class RemoteReplica(ProcessReplica):
                  spec: dict, *,
                  connect_timeout_s: float = 5.0,
                  reply_timeout_s: float = 60.0,
-                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES):
+                 max_frame_bytes: int = DEFAULT_MAX_FRAME_BYTES,
+                 epoch: int = 0,
+                 heartbeat_timeout_s: float = 0.0,
+                 send_timeout_s: Optional[float] = None):
         # deliberately NOT calling super().__init__ — it spawns a child
         # process; a remote peer is dialed, not forked
         self.replica_id = replica_id
@@ -55,6 +79,7 @@ class RemoteReplica(ProcessReplica):
         self.alive = True
         self.missed_health = 0
         self.reply_timeout_s = reply_timeout_s
+        self.heartbeat_timeout_s = heartbeat_timeout_s
         self.protocol_errors = 0
         self.last_partial_metrics: Optional[dict] = None
         self.weights_version = 0
@@ -62,6 +87,13 @@ class RemoteReplica(ProcessReplica):
         self._last_stats: Optional[ReplicaStats] = None
         self._last_blob: Optional[bytes] = None
         self._inflight = 0
+        # split-brain fencing: this incarnation's epoch is stamped into
+        # every request; replies echoing any OTHER epoch were produced
+        # for a pre-restart incarnation and must never be applied
+        self.epoch = int(epoch)
+        self._seq = 0
+        self.stale_epoch_replies = 0
+        self.duplicate_replies = 0
         self.host, self.port = parse_address(address)
         self.address = f"{self.host}:{self.port}"
         self.telemetry_host = self.host   # scrape where we dialed
@@ -69,7 +101,8 @@ class RemoteReplica(ProcessReplica):
         try:
             self._conn = connect(self.host, self.port,
                                  timeout_s=connect_timeout_s,
-                                 max_frame_bytes=max_frame_bytes)
+                                 max_frame_bytes=max_frame_bytes,
+                                 send_timeout_s=send_timeout_s)
         except OSError as e:
             # a failed dial is a spawn failure — supervision's backoff
             # machinery owns the retry, same as a worker that dies at
@@ -78,12 +111,17 @@ class RemoteReplica(ProcessReplica):
             raise ReplicaDead(
                 f"replica {replica_id} peer {self.address} unreachable: "
                 f"{e}") from e
+        # the init advertises our wire revision; the ready reply's
+        # advertisement decides what we SEND from then on (a DSF1-only
+        # peer omits the field and keeps its length-only frames)
         self._send({"op": "init", "replica_id": replica_id, "role": role,
-                    **spec})
+                    "wire_rev": WIRE_REV, **spec})
         ready = self._read_reply()
+        self._conn.negotiate(ready.get("wire_rev"))
         self.telemetry_port = ready.get("telemetry_port")
         log_dist(f"fleet: replica {replica_id} federated peer "
-                 f"{self.address} ready (role={role}, telemetry "
+                 f"{self.address} ready (role={role}, epoch "
+                 f"{self.epoch}, wire rev {self._conn.tx_rev}, telemetry "
                  f"{self.telemetry_host}:{self.telemetry_port})",
                  ranks=[0])
 
@@ -93,13 +131,46 @@ class RemoteReplica(ProcessReplica):
             self.alive = False
             raise ReplicaDead(
                 f"replica {self.replica_id} peer {self.address} is gone")
+        self._seq += 1
         try:
-            self._conn.send_msg(msg, blob=blob)
+            self._conn.send_msg(
+                {**msg, "_epoch": self.epoch, "_seq": self._seq},
+                blob=blob)
+        except FrameError as e:
+            # a stalled send (peer not draining past send_timeout_s):
+            # the frame may be half on the wire — desynchronized, dead
+            self._protocol_error(
+                e.kind if e.kind == "timeout" else "malformed",
+                f"send to {self.address} failed: {e.detail}")
         except OSError as e:
             self.alive = False
             raise ReplicaDead(
                 f"replica {self.replica_id} connection to {self.address} "
                 f"broke: {e}") from e
+
+    def _fence(self, msg) -> bool:
+        """True when ``msg`` must be DROPPED: a reply stamped with a
+        different epoch (a zombie incarnation's delayed answer crossing
+        the re-dial) or a stale seq (a duplicated frame). Unstamped
+        replies (older peers) pass — fencing marks capability."""
+        reply_epoch = msg.get("_epoch")
+        if reply_epoch is not None and int(reply_epoch) != self.epoch:
+            self.stale_epoch_replies += 1
+            from deepspeed_tpu.observability.metrics import get_registry
+            get_registry().counter("fleet/stale_epoch_replies").inc()
+            log_dist(
+                f"fleet: replica {self.replica_id} dropped a stale-epoch "
+                f"reply from {self.address} (op={msg.get('op')!r}, "
+                f"epoch {reply_epoch} != {self.epoch}) — zombie "
+                "incarnation fenced", ranks=[0])
+            return True
+        reply_seq = msg.get("_seq")
+        if reply_seq is not None and int(reply_seq) < self._seq:
+            self.duplicate_replies += 1
+            from deepspeed_tpu.observability.metrics import get_registry
+            get_registry().counter("fleet/duplicate_replies").inc()
+            return True
+        return False
 
     def _read_reply(self) -> dict:
         while True:
@@ -108,7 +179,8 @@ class RemoteReplica(ProcessReplica):
                     timeout_s=self.reply_timeout_s)
             except FrameError as e:
                 kind = e.kind if e.kind in ("timeout", "truncated",
-                                            "malformed") else "malformed"
+                                            "malformed", "corrupt") \
+                    else "malformed"
                 self._protocol_error(kind, f"peer {self.address}: "
                                      f"{e.detail}")
             except PeerGone:
@@ -121,15 +193,47 @@ class RemoteReplica(ProcessReplica):
                 raise ReplicaDead(
                     f"replica {self.replica_id} connection to "
                     f"{self.address} broke: {e}") from e
-            self._last_blob = blob
             if msg.get("op") == "partial_metrics":
+                # out-of-band and unstamped by design: never fenced
                 self.last_partial_metrics = msg
                 continue
+            if self._fence(msg):
+                continue
+            self._last_blob = blob
             if msg.get("op") == "error":
                 raise RuntimeError(
                     f"replica {self.replica_id} worker error: "
                     f"{msg.get('detail')}")
             return msg
+
+    # -- liveness (heartbeat on the health-sweep cadence) ------------------
+    def _ping(self):
+        """One heartbeat round-trip under the SHORT heartbeat deadline:
+        on a half-open connection the send lands in a void and the read
+        times out — WorkerProtocolError("timeout") → supervision
+        re-dials."""
+        self._send({"op": "ping"})
+        saved = self.reply_timeout_s
+        self.reply_timeout_s = self.heartbeat_timeout_s
+        try:
+            reply = self._read_reply()
+        finally:
+            self.reply_timeout_s = saved
+        if reply.get("op") != "pong":
+            self._protocol_error(
+                "malformed",
+                f"heartbeat answered with {reply.get('op')!r}")
+
+    def probe_health(self) -> str:
+        if self.heartbeat_timeout_s and self.alive \
+                and not self._conn.closed:
+            try:
+                self._ping()
+            except ReplicaDead:
+                # WorkerProtocolError subclasses ReplicaDead: the miss
+                # is already counted and the replica marked dead
+                return "dead"
+        return super().probe_health()
 
     # -- handoff (payloads travel as raw v3 blob frames — no base64) -------
     def export_handoff_by_id(self, request_id) -> dict:

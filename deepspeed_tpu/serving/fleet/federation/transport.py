@@ -5,9 +5,26 @@ A :class:`FrameConnection` wraps one connected TCP socket and speaks
 by one raw blob frame (flagged in-band with ``"_blob": true`` so the
 reader knows to consume the companion frame). All wire faults surface
 as named :class:`~.frames.FrameError`s (timeout / truncated /
-malformed / oversize) or :class:`PeerGone` on a clean disconnect —
-the remote-replica layer maps these onto ``WorkerProtocolError`` and
-``ReplicaDead`` exactly like the pipe backend does.
+malformed / oversize / corrupt) or :class:`PeerGone` on a clean
+disconnect — the remote-replica layer maps these onto
+``WorkerProtocolError`` and ``ReplicaDead`` exactly like the pipe
+backend does.
+
+Wire-revision negotiation: the DECODER accepts DSF1 and DSF2 frames
+unconditionally (the magic selects the layout), but a connection only
+*sends* DSF2 after :meth:`FrameConnection.negotiate` records that the
+peer advertised ``wire_rev >= 2`` in the init/ready exchange — so a
+DSF1-only peer keeps interoperating and a new↔new pair gets crc32
+integrity on every frame.
+
+Backpressure: ``send_timeout_s`` puts a deadline on every ``sendall``
+so one wedged peer (full receive window, half-open TCP) surfaces as a
+named ``FrameError("timeout")`` instead of stalling the fleet's
+dispatch thread forever.
+
+Fault injection: ``fault_injector`` (see ``netfaults.py``) intercepts
+outbound frames one at a time — the deterministic chaos instrument for
+the wire. None (the default) is the zero-overhead production path.
 
 Stdlib-only; no jax.
 """
@@ -44,15 +61,18 @@ def parse_address(address):
 
 
 def connect(host, port, timeout_s=5.0,
-            max_frame_bytes=DEFAULT_MAX_FRAME_BYTES):
+            max_frame_bytes=DEFAULT_MAX_FRAME_BYTES,
+            send_timeout_s=None):
     """Dial a federation peer; OSError propagates to the caller (a
     failed dial is a spawn failure, not a protocol error)."""
     sock = socket.create_connection((host, int(port)), timeout=timeout_s)
-    return FrameConnection(sock, max_frame_bytes=max_frame_bytes)
+    return FrameConnection(sock, max_frame_bytes=max_frame_bytes,
+                           send_timeout_s=send_timeout_s)
 
 
 class FrameConnection:
-    def __init__(self, sock, max_frame_bytes=DEFAULT_MAX_FRAME_BYTES):
+    def __init__(self, sock, max_frame_bytes=DEFAULT_MAX_FRAME_BYTES,
+                 send_timeout_s=None):
         try:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
@@ -60,20 +80,55 @@ class FrameConnection:
         self._sock = sock
         self._decoder = FrameDecoder(max_frame_bytes)
         self.closed = False
+        self.send_timeout_s = send_timeout_s
+        self.tx_rev = 1            # until the peer advertises wire_rev 2
+        self.fault_injector = None  # netfaults.WireFaultInjector or None
 
     def fileno(self):
         return self._sock.fileno()
 
+    def negotiate(self, peer_rev):
+        """Record the peer's advertised ``wire_rev`` (from its init or
+        ready message). Missing/old advertisements keep DSF1."""
+        self.tx_rev = 2 if peer_rev is not None and int(peer_rev) >= 2 \
+            else 1
+
     def send_msg(self, msg, blob=None):
         """One JSON frame, plus one blob frame when ``blob`` is given.
-        OSError (broken pipe, reset) propagates to the caller."""
+        OSError (broken pipe, reset) propagates to the caller; a send
+        that stalls past ``send_timeout_s`` raises the named
+        ``FrameError("timeout")``."""
         head = dict(msg)
         if blob is not None:
             head["_blob"] = True
-        data = encode_frame(json.dumps(head, default=float).encode("utf-8"))
+        self._send_frame(encode_frame(
+            json.dumps(head, default=float).encode("utf-8"),
+            rev=self.tx_rev))
         if blob is not None:
-            data += encode_frame(blob, KIND_BLOB)
-        self._sock.sendall(data)
+            self._send_frame(encode_frame(blob, KIND_BLOB,
+                                          rev=self.tx_rev))
+
+    def _send_frame(self, data):
+        """One encoded frame onto the wire — the per-frame hook point
+        the fault injector keys its ordinal schedule on."""
+        if self.fault_injector is not None:
+            self.fault_injector.send(self, data)
+        else:
+            self._raw_send(data)
+
+    def _raw_send(self, data):
+        self._sock.settimeout(self.send_timeout_s)
+        try:
+            self._sock.sendall(data)
+        except socket.timeout:
+            # the peer stopped draining its receive window (wedged or
+            # half-open): a partial frame may be on the wire, so the
+            # connection is desynchronized — the caller contains it the
+            # same way it contains a read timeout
+            raise FrameError(
+                "timeout",
+                f"send stalled past {self.send_timeout_s}s "
+                "(peer not draining)")
 
     def _recv_frame(self, timeout_s):
         while True:

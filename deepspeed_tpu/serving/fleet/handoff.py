@@ -30,10 +30,20 @@ frame on the federation socket (serving/fleet/federation/frames.py) —
 no base64 detour, torn frames contained by the frame codec before this
 module ever sees the blob. A v3 blob read off a pipe still decodes
 identically; the version marks wire capability, not layout change.
+
+Integrity (byzantine-wire hardening): a manifest-style ``digest`` — a
+crc32 fold over every KV page, scale plane, the prompt, and the
+geometry fields — is stamped into the v3 record at export and VERIFIED
+before injection (``verify_handoff``), so a bit flipped anywhere
+between the two engines (wire, staging queue, at rest) surfaces as the
+named :class:`HandoffError` with ``kind="digest"`` instead of silently
+entering a KV pool. Payloads without a digest (older peers) still
+inject — the digest marks capability, not a compat break.
 """
 
 import io
 import json
+import zlib
 from typing import Dict
 
 import numpy as np
@@ -45,12 +55,20 @@ _ARRAY_META = ("prompt",)
 
 
 class HandoffError(ValueError):
-    """A handoff payload that cannot be decoded: truncated blob,
-    corrupt archive, missing record, or an unknown wire version. Named
-    so the fleet's injection-retry path can tell transfer corruption
-    (bounded retry, then re-prefill through failover) from a
-    programming error — raw ``BadZipFile``/``KeyError`` never reach the
-    fleet loop."""
+    """A handoff payload that cannot be decoded or trusted: truncated
+    blob, corrupt archive, missing record, an unknown wire version, or
+    a digest mismatch. Named so the fleet's injection-retry path can
+    tell transfer corruption (bounded retry, then re-prefill through
+    failover) from a programming error — raw ``BadZipFile``/
+    ``KeyError`` never reach the fleet loop. ``kind`` refines the
+    verdict: ``"corrupt"`` (undecodable bytes), ``"version"`` (unknown
+    wire version), ``"digest"`` (decoded fine but fails its integrity
+    digest — the flipped-bit case the fleet counts under
+    ``fleet/handoffs_rejected_corrupt``)."""
+
+    def __init__(self, msg, kind="corrupt"):
+        self.kind = kind
+        super().__init__(msg)
 
 
 def handoff_nbytes(payload: Dict) -> int:
@@ -58,6 +76,55 @@ def handoff_nbytes(payload: Dict) -> int:
     bench reports): KV page contents + scale planes only."""
     return sum(int(a.nbytes) for rec in payload["kv"]
                for a in rec.values())
+
+
+def handoff_digest(payload: Dict) -> int:
+    """crc32 fold over everything that must survive the transfer
+    bit-exactly: geometry fields, the prompt, and every KV leaf (name +
+    raw bytes, leaves in sorted order so dict insertion order never
+    changes the digest). Deterministic across processes — no salted
+    hashing anywhere in the repo's replay surfaces."""
+    crc = zlib.crc32(b"ds-tpu-handoff-v3")
+    # normalize scalar types: an exporter-side numpy int and the same
+    # value back from a JSON roundtrip must fold identically
+    geometry = [int(payload["version"]), int(payload["page_len"]),
+                str(payload["kv_quant"]), int(payload["prefill_len"]),
+                int(payload["n_pages_filled"])]
+    crc = zlib.crc32(json.dumps(geometry).encode("utf-8"), crc)
+    prompt = np.ascontiguousarray(
+        np.asarray(payload["request"]["prompt"], np.int32))
+    crc = zlib.crc32(prompt.tobytes(), crc)
+    for rec in payload["kv"]:
+        for name in sorted(rec):
+            crc = zlib.crc32(name.encode("utf-8"), crc)
+            crc = zlib.crc32(
+                np.ascontiguousarray(rec[name]).tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def stamp_handoff(payload: Dict) -> Dict:
+    """Stamp the integrity digest (idempotent: re-stamping recomputes,
+    which is what an exporter wants after mutating the payload)."""
+    payload["digest"] = handoff_digest(payload)
+    return payload
+
+
+def verify_handoff(payload: Dict) -> Dict:
+    """The pre-injection gate: recompute the digest and refuse a
+    payload whose bits changed since export. Undigested payloads (an
+    older peer exported them) pass — the stamp marks capability."""
+    want = payload.get("digest")
+    if want is None:
+        return payload
+    got = handoff_digest(payload)
+    if int(want) != got:
+        raise HandoffError(
+            f"handoff digest mismatch for request "
+            f"{payload.get('request', {}).get('request_id')!r}: "
+            f"payload reads {got:#010x}, exporter stamped "
+            f"{int(want):#010x} — a bit flipped in transit; refusing "
+            f"to inject", kind="digest")
+    return payload
 
 
 def serialize_handoff(payload: Dict) -> bytes:
@@ -73,6 +140,9 @@ def serialize_handoff(payload: Dict) -> bytes:
         "n_pages_filled": payload["n_pages_filled"],
         "n_units": len(payload["kv"]),
         "state": payload["state"],
+        # the integrity digest rides the record: stamp here if the
+        # exporter didn't, so EVERY serialized payload is verifiable
+        "digest": payload.get("digest", handoff_digest(payload)),
         "request": {k: v for k, v in payload["request"].items()
                     if k not in _ARRAY_META},
     }
@@ -99,7 +169,8 @@ def deserialize_handoff(blob: bytes) -> Dict:
             if meta.get("version") not in COMPAT_HANDOFF_VERSIONS:
                 raise HandoffError(
                     f"unknown handoff wire version {meta.get('version')!r} "
-                    f"(this build speaks {COMPAT_HANDOFF_VERSIONS})")
+                    f"(this build speaks {COMPAT_HANDOFF_VERSIONS})",
+                    kind="version")
             kv = []
             for i in range(meta["n_units"]):
                 prefix = f"kv/{i}/"
@@ -116,7 +187,7 @@ def deserialize_handoff(blob: bytes) -> Dict:
         raise HandoffError(
             f"truncated or corrupt handoff payload ({len(blob)} bytes): "
             f"{type(e).__name__}: {e}") from e
-    return {
+    payload = {
         "version": meta["version"],
         "page_len": meta["page_len"],
         "kv_quant": meta["kv_quant"],
@@ -126,3 +197,8 @@ def deserialize_handoff(blob: bytes) -> Dict:
         "state": meta["state"],
         "request": request,
     }
+    if meta.get("digest") is not None:
+        payload["digest"] = int(meta["digest"])
+    # end-to-end gate: the npz member crcs only cover the zip transport;
+    # this digest covers exporter-engine to injector-engine
+    return verify_handoff(payload)

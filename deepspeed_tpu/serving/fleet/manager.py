@@ -36,7 +36,8 @@ from ...observability.metrics import get_registry
 from ...utils.logging import log_dist
 from ..request import Request
 from .config import FleetConfig
-from .handoff import HandoffError, deserialize_handoff, serialize_handoff
+from .handoff import (HandoffError, deserialize_handoff,
+                      serialize_handoff, stamp_handoff, verify_handoff)
 from .replica import (LocalReplica, ProcessReplica, ReplicaCrash,
                       ReplicaDead)
 from .router import Router
@@ -179,6 +180,11 @@ class ServingFleet:
         self._lineage_peer: Dict[int, str] = {}   # lineage -> address:
                                                   # a remote restart is a
                                                   # RE-DIAL of its peer
+        self._lineage_epoch: Dict[int, int] = {}  # lineage -> incarnation
+                                                  # epoch stamped into
+                                                  # every request so a
+                                                  # zombie's delayed
+                                                  # reply is fenced
         self._draining = set()      # rids excluded from dispatch while a
                                     # rolling update drains them
         self._frontend = None       # FleetFrontend (drained each step)
@@ -230,6 +236,22 @@ class ServingFleet:
         self.chaos_corrupt_handoffs = 0 # chaos hook: truncate the next N
                                         # handoff payloads in transit
                                         # (models wire corruption)
+        self.chaos_flip_handoff_bits = 0
+                                        # chaos hook: flip ONE byte in
+                                        # the next N handoff payloads
+                                        # AFTER the digest stamp — the
+                                        # flipped-bit case only the v3
+                                        # integrity digest catches
+        self.handoffs_rejected_corrupt = 0
+                                        # payloads refused by the
+                                        # pre-injection digest gate (a
+                                        # flipped bit never enters a KV
+                                        # pool)
+        self._stale_fence_pruned = [0, 0]
+                                        # [stale_epoch, duplicate] reply
+                                        # counts carried from pruned
+                                        # corpses (snapshot counters
+                                        # must never decrease)
         # fleet-level flight recorder: request lifecycle events on the
         # FLEET step clock (submit/admit/first_token/handoff/failover/
         # terminal) — the per-request waterfall's input and the crash
@@ -287,6 +309,11 @@ class ServingFleet:
         if peer is not None:
             from .federation.remote import RemoteReplica
             self._lineage_peer[lineage] = peer
+            # per-incarnation epoch: every re-dial of this lineage gets
+            # the next epoch, so a pre-restart incarnation's delayed
+            # reply can never be applied by its successor
+            epoch = self._lineage_epoch.get(lineage, -1) + 1
+            self._lineage_epoch[lineage] = epoch
             fed = self.fedcfg
             rep = RemoteReplica(
                 rid, role, peer,
@@ -299,7 +326,10 @@ class ServingFleet:
                  "trace": self.fcfg.replica_trace},
                 connect_timeout_s=fed.connect_timeout_s,
                 reply_timeout_s=fed.reply_timeout_s,
-                max_frame_bytes=fed.max_frame_bytes)
+                max_frame_bytes=fed.max_frame_bytes,
+                epoch=epoch,
+                heartbeat_timeout_s=fed.heartbeat_timeout_s,
+                send_timeout_s=fed.send_timeout_s)
         elif self.fcfg.backend == "process":
             rep = ProcessReplica(rid, role,
                                  {**self._spec,
@@ -802,13 +832,47 @@ class ServingFleet:
 
     # -- disaggregated handoff pump ---------------------------------------
     def _stage_handoff(self, payload: dict, handle):
-        """Queue one exported payload for injection (the chaos hook
-        models wire corruption here — a truncated blob in transit)."""
+        """Queue one exported payload for injection. The integrity
+        digest is stamped HERE for the in-process path (remote exports
+        arrive digest-verified off the wire), so every staged payload
+        is verifiable at injection time. Chaos hooks model transit
+        damage: a truncated blob, or a single flipped byte the v3
+        digest alone can catch."""
+        if "digest" not in payload:
+            stamp_handoff(payload)
         if self.chaos_corrupt_handoffs > 0:
             self.chaos_corrupt_handoffs -= 1
             blob = serialize_handoff(payload)
             payload = {"_truncated": blob[:max(8, len(blob) // 3)],
                        "request": payload["request"]}
+        elif self.chaos_flip_handoff_bits > 0 and payload.get("kv"):
+            self.chaos_flip_handoff_bits -= 1
+            payload = dict(payload)
+            payload["kv"] = [dict(rec) for rec in payload["kv"]]
+            rec = payload["kv"][0]
+            name = sorted(rec)[0]
+            arr = np.ascontiguousarray(rec[name]).copy()
+            arr.view(np.uint8).flat[0] ^= 0xFF   # the flipped bit
+            rec[name] = arr
+        if self.fedcfg is not None \
+                and self.fedcfg.outbound_queue_limit > 0:
+            # backpressure: a wedged/starved decode pool must cost
+            # bounded memory — past the bound the OLDEST staged payload
+            # is dropped and its request re-prefills through failover
+            while len(self._handoff_backlog) >= \
+                    self.fedcfg.outbound_queue_limit:
+                oldest = self._handoff_backlog.popleft()
+                self.handoffs_dropped += 1
+                get_registry().counter("fleet/handoffs_dropped").inc()
+                old_handle = oldest["handle"]
+                log_dist(
+                    "fleet: outbound handoff queue over "
+                    f"{self.fedcfg.outbound_queue_limit} entries — "
+                    "dropping the oldest payload "
+                    f"({oldest['payload'].get('request', {}).get('request_id')!r}) "
+                    "and re-prefilling through failover", ranks=[0])
+                if old_handle is not None and not old_handle.done:
+                    self._failover(old_handle)
         self._handoff_backlog.append(
             {"payload": payload, "handle": handle, "attempts": 0,
              "not_before": 0})
@@ -857,6 +921,7 @@ class ServingFleet:
                 log_dist(f"fleet: handoff export from replica {rid} "
                          f"failed ({e}) — failing the request over",
                          ranks=[0])
+                self._count_if_digest_reject(e)
                 self.handoffs_dropped += 1
                 get_registry().counter("fleet/handoffs_dropped").inc()
                 if handle is not None and not handle.done:
@@ -912,6 +977,7 @@ class ServingFleet:
             if error is None:
                 retry.append(ent)       # starvation: retry next step
                 continue
+            self._count_if_digest_reject(error)
             ent["attempts"] += 1
             self.handoff_retries += 1
             get_registry().counter("fleet/handoff_retries").inc()
@@ -936,6 +1002,19 @@ class ServingFleet:
             retry.append(ent)
         self._handoff_backlog = retry
 
+    def _count_if_digest_reject(self, e) -> None:
+        """Count an integrity-gate rejection. Covers BOTH paths a
+        digest mismatch surfaces on: a local ``verify_handoff`` raise
+        (``HandoffError.kind == "digest"``) and a REMOTE worker's
+        refusal, which crosses the wire as a typed error reply and
+        re-raises here as RuntimeError carrying the stable message
+        token."""
+        if getattr(e, "kind", None) == "digest" \
+                or "handoff digest mismatch" in str(e):
+            self.handoffs_rejected_corrupt += 1
+            get_registry().counter(
+                "fleet/handoffs_rejected_corrupt").inc()
+
     def _record_handoff_export(self, payload: dict, src_rid: int):
         self.recorder.record(
             "handoff_export",
@@ -950,6 +1029,11 @@ class ServingFleet:
             # chaos-corrupted in transit: decoding raises the named
             # HandoffError exactly as a real torn wire transfer would
             payload = deserialize_handoff(blob)
+        # the pre-injection integrity gate: a payload whose bits
+        # changed since export (wire, staging, at rest) raises the
+        # named HandoffError(kind="digest") — a flipped bit NEVER
+        # enters a KV pool (remote targets re-verify on their side too)
+        verify_handoff(payload)
         if rep.backend == "inprocess":
             live = rep.inject_handoff(
                 payload, on_token=(self._on_token_cb(handle)
@@ -1037,6 +1121,10 @@ class ServingFleet:
             # carried total so snapshot()'s counter never goes DOWN
             self._protocol_errors_pruned += getattr(
                 rep, "protocol_errors", 0)
+            self._stale_fence_pruned[0] += getattr(
+                rep, "stale_epoch_replies", 0)
+            self._stale_fence_pruned[1] += getattr(
+                rep, "duplicate_replies", 0)
             self._failed.discard(rid)
             self._lineage.pop(rid, None)
             if self._aggregator is not None:
@@ -1247,6 +1335,13 @@ class ServingFleet:
             "requests_parked": len(self._orphans),
             "worker_protocol_errors": self._protocol_errors_pruned + sum(
                 getattr(rep, "protocol_errors", 0)
+                for rep in self._replicas.values()),
+            "handoffs_rejected_corrupt": self.handoffs_rejected_corrupt,
+            "stale_epoch_replies": self._stale_fence_pruned[0] + sum(
+                getattr(rep, "stale_epoch_replies", 0)
+                for rep in self._replicas.values()),
+            "duplicate_replies": self._stale_fence_pruned[1] + sum(
+                getattr(rep, "duplicate_replies", 0)
                 for rep in self._replicas.values()),
             "supervision": self.supervisor.snapshot(),
             "requests_submitted": self.requests_submitted,

@@ -1,0 +1,740 @@
+"""Byzantine-wire hardening (PR 19): frame integrity, deterministic
+network fault injection, epoch/seq fencing, liveness heartbeats, send
+deadlines, handoff digests, and front-end backpressure.
+
+Acceptance surface:
+
+- DSF2 codec: crc32-checked frames round-trip; a flipped payload bit is
+  the NAMED ``FrameError("corrupt")``; DSF1 and DSF2 frames interleave
+  on one stream (the magic selects the layout per frame);
+- decoder fuzz: seeded random streams and valid-prefix/garbage-suffix
+  splices only ever produce named ``FrameError``s — never a hang, never
+  a raw struct error — and buffering stays bounded;
+- netfaults: the fault schedule is a pure function of (seed, ordinal) —
+  same seed, same schedule — and each live fault kind lands on the
+  advertised receiver-side containment over a real socketpair;
+- ``RemoteReplica`` fencing: wire-revision negotiation, crc corruption
+  → ``WorkerProtocolError("corrupt")``, heartbeat miss → probe "dead",
+  stale-epoch and duplicate-seq replies dropped AND counted, stalled
+  sends → the named timeout;
+- handoff digest: stamped at export, verified before injection; a
+  flipped KV bit or a wrong stamp is ``HandoffError(kind="digest")``;
+- ``FleetFrontend`` backpressure: 429 + Retry-After past ``queue_cap``
+  (stretched while the QoS shed signal is up), read-once result records
+  with a bounded unread-finals LRU, ndjson stream keepalives.
+
+No engines, no jax — everything here drives stubs and socketpairs.
+"""
+
+import json
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving.fleet.federation.frames import (
+    HEADER2_BYTES, KIND_BLOB, KIND_JSON, MAGIC, MAGIC2, WIRE_REV,
+    FrameDecoder, FrameError, encode_frame)
+from deepspeed_tpu.serving.fleet.federation.netfaults import (
+    FAULT_KINDS, WireFaultInjector, WireFaultPlan)
+from deepspeed_tpu.serving.fleet.federation.transport import (
+    FrameConnection, PeerGone)
+from deepspeed_tpu.serving.fleet.handoff import (
+    HandoffError, deserialize_handoff, handoff_digest, serialize_handoff,
+    stamp_handoff, verify_handoff)
+from deepspeed_tpu.serving.fleet.replica import (ReplicaDead,
+                                                 WorkerProtocolError)
+
+_NAMED_KINDS = ("malformed", "truncated", "oversize", "corrupt",
+                "timeout")
+
+
+# ---------------------------------------------------------------------------
+# DSF2 codec (no sockets)
+# ---------------------------------------------------------------------------
+
+class TestDsf2Codec:
+    def test_rev2_roundtrip_and_header_layout(self):
+        frame = encode_frame(b'{"op": "ready"}', KIND_JSON, rev=2)
+        assert frame[:4] == MAGIC2
+        assert len(frame) == HEADER2_BYTES + 15
+        dec = FrameDecoder()
+        dec.feed(frame)
+        assert dec.next_frame() == (KIND_JSON, b'{"op": "ready"}')
+        assert dec.eof() is None
+
+    def test_flipped_payload_bit_is_corrupt(self):
+        frame = bytearray(encode_frame(b"payload-bytes", rev=2))
+        frame[HEADER2_BYTES + 4] ^= 0x01
+        dec = FrameDecoder()
+        dec.feed(bytes(frame))
+        with pytest.raises(FrameError) as e:
+            dec.next_frame()
+        assert e.value.kind == "corrupt"
+
+    def test_corrupt_frame_is_consumed_stream_stays_framed(self):
+        """A crc failure consumes the damaged frame: the NEXT frame on
+        the stream still decodes (the stream is framed correctly; only
+        one payload was damaged)."""
+        bad = bytearray(encode_frame(b"damaged", rev=2))
+        bad[-1] ^= 0xFF
+        dec = FrameDecoder()
+        dec.feed(bytes(bad) + encode_frame(b"clean", rev=2))
+        with pytest.raises(FrameError):
+            dec.next_frame()
+        assert dec.next_frame() == (KIND_JSON, b"clean")
+
+    def test_rev1_flipped_bit_parses_clean_the_gap_dsf2_closes(self):
+        """The motivating gap: a DSF1 frame with a flipped payload bit
+        decodes without complaint — only DSF2 can see the damage."""
+        frame = bytearray(encode_frame(b"payload-bytes", rev=1))
+        frame[-2] ^= 0x01
+        dec = FrameDecoder()
+        dec.feed(bytes(frame))
+        kind, payload = dec.next_frame()
+        assert payload != b"payload-bytes"    # silently wrong
+
+    def test_mixed_revisions_interleave_per_frame(self):
+        dec = FrameDecoder()
+        dec.feed(encode_frame(b"one", rev=1)
+                 + encode_frame(b"two", rev=2)
+                 + encode_frame(b"\x00\x01", KIND_BLOB, rev=2)
+                 + encode_frame(b"three", rev=1))
+        got = [dec.next_frame() for _ in range(4)]
+        assert got == [(KIND_JSON, b"one"), (KIND_JSON, b"two"),
+                       (KIND_BLOB, b"\x00\x01"), (KIND_JSON, b"three")]
+        assert dec.next_frame() is None
+
+    def test_rev2_blob_crc_checked(self):
+        frame = bytearray(encode_frame(b"\x00" * 64, KIND_BLOB, rev=2))
+        frame[HEADER2_BYTES + 10] ^= 0x80
+        dec = FrameDecoder()
+        dec.feed(bytes(frame))
+        with pytest.raises(FrameError) as e:
+            dec.next_frame()
+        assert e.value.kind == "corrupt"
+
+    def test_encode_rejects_unknown_rev(self):
+        with pytest.raises(ValueError):
+            encode_frame(b"x", rev=3)
+
+    def test_empty_payload_rev2(self):
+        dec = FrameDecoder()
+        dec.feed(encode_frame(b"", rev=2))
+        assert dec.next_frame() == (KIND_JSON, b"")
+
+
+# ---------------------------------------------------------------------------
+# decoder fuzz: random streams and splices never hang, never leak a raw
+# error, never buffer unboundedly
+# ---------------------------------------------------------------------------
+
+def _drain(dec, limit=10000):
+    """Decode until quiescent; returns frames. AssertionError if the
+    decoder fails to make progress (the no-hang property)."""
+    frames = []
+    for _ in range(limit):
+        got = dec.next_frame()
+        if got is None:
+            return frames
+        frames.append(got)
+    raise AssertionError("decoder did not quiesce")
+
+
+class TestDecoderFuzz:
+    def test_random_streams_only_named_errors(self):
+        r = np.random.RandomState(0xBEEF)
+        outcomes = {"clean": 0, "error": 0}
+        for _ in range(300):
+            dec = FrameDecoder(max_frame_bytes=4096)
+            data = r.bytes(int(r.randint(1, 400)))
+            try:
+                # feed in random-sized chunks: partial headers included
+                i = 0
+                while i < len(data):
+                    step = int(r.randint(1, 64))
+                    dec.feed(data[i:i + step])
+                    _drain(dec)
+                    i += step
+                dec.eof()
+                outcomes["clean"] += 1
+            except FrameError as e:
+                assert e.kind in _NAMED_KINDS
+                outcomes["error"] += 1
+        # random bytes essentially never spell DSF magic: the point is
+        # that every trial terminated with a named verdict
+        assert outcomes["clean"] + outcomes["error"] == 300
+
+    def test_valid_prefix_garbage_suffix_splices(self):
+        """Cut a valid multi-frame stream at EVERY byte boundary and
+        splice garbage on: frames wholly before the cut decode exactly;
+        everything after is a named error or a clean truncated EOF."""
+        a = encode_frame(b'{"n": 1}', rev=1)
+        b = encode_frame(b'{"n": 2}', rev=2)
+        c = encode_frame(b"\x00\x01\x02", KIND_BLOB, rev=2)
+        stream = a + b + c
+        bounds = [len(a), len(a) + len(b), len(stream)]
+        for cut in range(1, len(stream) + 1):
+            dec = FrameDecoder(max_frame_bytes=4096)
+            dec.feed(stream[:cut] + b"\xde\xad\xbe\xef\xf0\x0d")
+            whole = sum(1 for edge in bounds if cut >= edge)
+            got = []
+            try:
+                for _ in range(100):
+                    frame = dec.next_frame()
+                    if frame is None:
+                        break
+                    got.append(frame)
+                else:
+                    raise AssertionError("decoder did not quiesce")
+                dec.eof()
+            except FrameError as e:
+                assert e.kind in _NAMED_KINDS
+            # every frame fully inside the prefix must have decoded
+            # (the splice can only damage what it overlaps)
+            assert len(got) >= whole
+
+    def test_buffering_bounded_after_drain(self):
+        """The decoder holds at most one partial frame once drained:
+        interleaved feed/drain across a long stream never accumulates
+        consumed bytes."""
+        frame = encode_frame(b"x" * 100, rev=2)
+        cap = len(frame)
+        dec = FrameDecoder(max_frame_bytes=4096)
+        stream = frame * 50
+        for i in range(0, len(stream), 37):
+            dec.feed(stream[i:i + 37])
+            _drain(dec)
+            assert dec.pending < cap
+        assert dec.eof() is None
+
+    def test_oversize_rejected_before_body_buffers(self):
+        dec = FrameDecoder(max_frame_bytes=1024)
+        dec.feed(struct.pack(">4sBII", MAGIC2, KIND_JSON, 1 << 30, 0))
+        with pytest.raises(FrameError) as e:
+            dec.next_frame()
+        assert e.value.kind == "oversize"
+        assert dec.pending < 64        # the header, not a gigabyte
+
+
+# ---------------------------------------------------------------------------
+# netfaults: determinism and live containment over a socketpair
+# ---------------------------------------------------------------------------
+
+class TestWireFaultPlan:
+    def test_same_seed_same_schedule(self):
+        one = WireFaultPlan(seed=7, rate=0.3).schedule(500)
+        two = WireFaultPlan(seed=7, rate=0.3).schedule(500)
+        assert one == two and len(one) > 0
+
+    def test_different_seeds_differ(self):
+        assert WireFaultPlan(seed=1, rate=0.3).schedule(500) != \
+            WireFaultPlan(seed=2, rate=0.3).schedule(500)
+
+    def test_explicit_faults_win_and_window_honored(self):
+        plan = WireFaultPlan(seed=3, rate=1.0, start=10, stop=20,
+                             faults={2: "corrupt"})
+        assert plan.fault_at(2) == "corrupt"     # explicit, outside window
+        assert plan.fault_at(5) is None          # before start
+        assert plan.fault_at(25) is None         # past stop
+        assert all(plan.fault_at(n) in FAULT_KINDS
+                   for n in range(10, 20))       # rate=1 inside window
+        assert plan.schedule(30) == [(2, "corrupt")] + [
+            (n, plan.fault_at(n)) for n in range(10, 20)]
+
+    def test_from_spec_json_roundtrip(self):
+        spec = {"seed": 5, "faults": {"6": "corrupt", "11": "duplicate"}}
+        plan = WireFaultPlan.from_spec(json.loads(json.dumps(spec)))
+        assert plan.fault_at(6) == "corrupt"
+        assert plan.fault_at(11) == "duplicate"
+        assert plan.fault_at(7) is None
+
+    def test_named_validation(self):
+        with pytest.raises(ValueError, match="fault kind"):
+            WireFaultPlan(faults={1: "gremlins"})
+        with pytest.raises(ValueError, match="rate"):
+            WireFaultPlan(rate=1.5)
+
+
+def _faulty_pair(plan, **kw):
+    a, b = socket.socketpair()
+    tx, rx = FrameConnection(a, **kw), FrameConnection(b, **kw)
+    tx.negotiate(2)                    # DSF2 so corruption is DETECTED
+    tx.fault_injector = WireFaultInjector(plan)
+    return tx, rx
+
+
+class TestWireFaultInjectorLive:
+    def test_corrupt_lands_as_named_corrupt(self):
+        tx, rx = _faulty_pair(WireFaultPlan(faults={0: "corrupt"}))
+        try:
+            tx.send_msg({"op": "advance", "pad": "x" * 64})
+            with pytest.raises(FrameError) as e:
+                rx.recv_msg(timeout_s=5.0)
+            assert e.value.kind == "corrupt"
+            assert tx.fault_injector.fired == [(0, "corrupt")]
+        finally:
+            tx.close()
+            rx.close()
+
+    def test_duplicate_delivers_twice(self):
+        tx, rx = _faulty_pair(WireFaultPlan(faults={0: "duplicate"}))
+        try:
+            tx.send_msg({"n": 1})
+            assert rx.recv_msg(timeout_s=5.0) == ({"n": 1}, None)
+            assert rx.recv_msg(timeout_s=5.0) == ({"n": 1}, None)
+        finally:
+            tx.close()
+            rx.close()
+
+    def test_reorder_swaps_adjacent_frames(self):
+        tx, rx = _faulty_pair(WireFaultPlan(faults={0: "reorder"}))
+        try:
+            tx.send_msg({"n": 1})          # held...
+            tx.send_msg({"n": 2})          # ...released after this one
+            assert rx.recv_msg(timeout_s=5.0)[0] == {"n": 2}
+            assert rx.recv_msg(timeout_s=5.0)[0] == {"n": 1}
+        finally:
+            tx.close()
+            rx.close()
+
+    def test_blackhole_swallows_everything_after(self):
+        tx, rx = _faulty_pair(WireFaultPlan(faults={1: "blackhole"}))
+        try:
+            tx.send_msg({"n": 1})
+            tx.send_msg({"n": 2})          # vanishes
+            tx.send_msg({"n": 3})          # vanishes too (half-open)
+            assert rx.recv_msg(timeout_s=5.0)[0] == {"n": 1}
+            with pytest.raises(FrameError) as e:
+                rx.recv_msg(timeout_s=0.2)
+            assert e.value.kind == "timeout"
+        finally:
+            tx.close()
+            rx.close()
+
+    def test_drip_still_decodes_intact(self):
+        plan = WireFaultPlan(faults={0: "drip"}, delay_s=0.01)
+        tx, rx = _faulty_pair(plan)
+        try:
+            tx.send_msg({"op": "payload"}, blob=b"\x07" * 2048)
+            msg, blob = rx.recv_msg(timeout_s=5.0)
+            assert msg == {"op": "payload"} and blob == b"\x07" * 2048
+        finally:
+            tx.close()
+            rx.close()
+
+    def test_truncate_severs_and_reads_as_truncated(self):
+        tx, rx = _faulty_pair(WireFaultPlan(faults={0: "truncate"}))
+        try:
+            tx.send_msg({"op": "advance", "pad": "y" * 64})
+            with pytest.raises(FrameError) as e:
+                rx.recv_msg(timeout_s=5.0)
+            assert e.value.kind == "truncated"
+        finally:
+            tx.close()
+            rx.close()
+
+
+# ---------------------------------------------------------------------------
+# send deadline (backpressure at the socket layer)
+# ---------------------------------------------------------------------------
+
+class TestSendDeadline:
+    def test_stalled_send_is_named_timeout(self):
+        a, b = socket.socketpair()
+        a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+        b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8192)
+        tx = FrameConnection(a, send_timeout_s=0.2)
+        try:
+            # nobody reads from b: the window fills and the send stalls
+            with pytest.raises(FrameError) as e:
+                tx.send_msg({"op": "payload"}, blob=b"\x00" * (1 << 22))
+            assert e.value.kind == "timeout"
+            assert "not draining" in e.value.detail
+        finally:
+            tx.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# RemoteReplica fencing (scripted stub peer — no engine)
+# ---------------------------------------------------------------------------
+
+class _StubPeer:
+    """A scripted federation 'worker': accepts ONE connection, answers
+    init with ``ready`` (optionally advertising a wire revision), then
+    hands the connection to ``script``."""
+
+    def __init__(self, script=None, ready=None):
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(1)
+        self.port = self._listener.getsockname()[1]
+        self.address = f"127.0.0.1:{self.port}"
+        self.init_msg = None
+        self._ready = ready or {"op": "ready", "telemetry_port": None}
+        self._script = script
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        sock, _ = self._listener.accept()
+        conn = FrameConnection(sock)
+        try:
+            self.init_msg, _ = conn.recv_msg(timeout_s=10.0)
+            if self._ready.get("wire_rev", 0) >= 2:
+                conn.negotiate(self.init_msg.get("wire_rev"))
+            conn.send_msg(self._ready)
+            if self._script is not None:
+                self._script(conn)
+        finally:
+            conn.close()
+            self._listener.close()
+
+    def join(self):
+        self._thread.join(timeout=10.0)
+
+
+def _remote(peer, **kw):
+    from deepspeed_tpu.serving.fleet.federation.remote import RemoteReplica
+    kw.setdefault("reply_timeout_s", 2.0)
+    return RemoteReplica(0, "full", peer.address, {"serving": {}}, **kw)
+
+
+class TestWireNegotiation:
+    def test_legacy_ready_keeps_dsf1(self):
+        peer = _StubPeer()
+        rep = _remote(peer)
+        peer.join()
+        assert peer.init_msg["wire_rev"] == WIRE_REV   # we advertise
+        assert rep._conn.tx_rev == 1                   # peer didn't
+        rep.kill()
+
+    def test_rev2_ready_upgrades_sender(self):
+        peer = _StubPeer(ready={"op": "ready", "telemetry_port": None,
+                                "wire_rev": 2})
+        rep = _remote(peer)
+        peer.join()
+        assert rep._conn.tx_rev == 2
+        rep.kill()
+
+    def test_connection_defaults_to_dsf1_until_negotiated(self):
+        a, b = socket.socketpair()
+        tx, rx = FrameConnection(a), FrameConnection(b)
+        try:
+            tx.send_msg({"n": 1})
+            tx.negotiate(2)
+            tx.send_msg({"n": 2})
+            raw = b.recv(1 << 16)
+            assert raw[:4] == MAGIC
+            assert MAGIC2 in raw[4:]
+        finally:
+            tx.close()
+            rx.close()
+
+
+class TestRemoteReplicaFencing:
+    def test_crc_corrupt_reply_is_named_protocol_error(self):
+        def script(conn):
+            conn.recv_msg(timeout_s=10.0)            # the advance op
+            frame = bytearray(encode_frame(
+                b'{"op": "advanced", "events": []}', rev=2))
+            frame[HEADER2_BYTES + 3] ^= 0x10
+            conn._sock.sendall(bytes(frame))
+        peer = _StubPeer(script=script)
+        rep = _remote(peer)
+        with pytest.raises(WorkerProtocolError) as e:
+            rep.advance()
+        assert e.value.kind == "corrupt" and e.value.replica_id == 0
+        assert not rep.alive and rep.protocol_errors == 1
+
+    def test_heartbeat_miss_probes_dead(self):
+        def script(conn):
+            conn.recv_msg(timeout_s=10.0)            # the ping, eaten
+            time.sleep(3.0)                          # ...never answered
+        peer = _StubPeer(script=script)
+        rep = _remote(peer, heartbeat_timeout_s=0.2)
+        assert rep.probe_health() == "dead"
+        assert not rep.alive and rep.protocol_errors == 1
+        # the long reply deadline was restored around the short probe
+        assert rep.reply_timeout_s == 2.0
+
+    def test_heartbeat_pong_probes_ok(self):
+        def script(conn):
+            msg, _ = conn.recv_msg(timeout_s=10.0)
+            conn.send_msg({"op": "pong", "_epoch": msg["_epoch"],
+                           "_seq": msg["_seq"]})
+        peer = _StubPeer(script=script)
+        rep = _remote(peer, heartbeat_timeout_s=2.0)
+        assert rep.probe_health() == "ok"
+        rep.kill()
+
+    def test_stale_epoch_and_duplicate_seq_replies_fenced(self):
+        def script(conn):
+            msg, _ = conn.recv_msg(timeout_s=10.0)
+            epoch, seq = msg["_epoch"], msg["_seq"]
+            # a pre-restart incarnation's delayed reply: WRONG epoch
+            conn.send_msg({"op": "echo", "which": "zombie",
+                           "_epoch": epoch - 1, "_seq": seq})
+            # a duplicated frame: right epoch, stale seq
+            conn.send_msg({"op": "echo", "which": "dup",
+                           "_epoch": epoch, "_seq": seq - 1})
+            # the real answer
+            conn.send_msg({"op": "echo", "which": "real",
+                           "_epoch": epoch, "_seq": seq})
+        peer = _StubPeer(script=script)
+        rep = _remote(peer, epoch=5)
+        rep._send({"op": "echo"})
+        reply = rep._read_reply()
+        assert reply["which"] == "real"
+        assert rep.stale_epoch_replies == 1
+        assert rep.duplicate_replies == 1
+        assert rep.alive            # fencing DROPS, it does not kill
+        rep.kill()
+
+    def test_unstamped_replies_pass_compat(self):
+        """Older peers echo no stamps: fencing marks capability, so
+        their replies are never dropped."""
+        def script(conn):
+            conn.recv_msg(timeout_s=10.0)
+            conn.send_msg({"op": "echo", "which": "legacy"})
+        peer = _StubPeer(script=script)
+        rep = _remote(peer, epoch=3)
+        rep._send({"op": "echo"})
+        assert rep._read_reply()["which"] == "legacy"
+        assert rep.stale_epoch_replies == 0
+        assert rep.duplicate_replies == 0
+        rep.kill()
+
+    def test_requests_carry_epoch_and_monotonic_seq(self):
+        seen = []
+
+        def script(conn):
+            for _ in range(2):
+                msg, _ = conn.recv_msg(timeout_s=10.0)
+                seen.append((msg["_epoch"], msg["_seq"]))
+                conn.send_msg({"op": "echo", "_epoch": msg["_epoch"],
+                               "_seq": msg["_seq"]})
+        peer = _StubPeer(script=script)
+        rep = _remote(peer, epoch=9)
+        for _ in range(2):
+            rep._send({"op": "echo"})
+            rep._read_reply()
+        peer.join()
+        assert peer.init_msg["_epoch"] == 9
+        assert seen == [(9, 2), (9, 3)]     # init took seq 1
+        rep.kill()
+
+
+# ---------------------------------------------------------------------------
+# handoff integrity digest
+# ---------------------------------------------------------------------------
+
+def _payload():
+    return {"version": 3, "page_len": 4, "kv_quant": "none",
+            "prefill_len": 5, "n_pages_filled": 2,
+            "kv": [{"k": np.arange(8, dtype=np.float32),
+                    "v": np.arange(8, dtype=np.float32) * 2}],
+            "state": {"last_token": 7, "remaining": 3},
+            "request": {"prompt": np.arange(5, dtype=np.int32),
+                        "request_id": "r1", "max_new_tokens": 3,
+                        "priority": 0}}
+
+
+class TestHandoffDigest:
+    def test_stamp_then_verify_roundtrip(self):
+        payload = stamp_handoff(_payload())
+        assert verify_handoff(payload) is payload
+        # deterministic across calls (no salted hashing)
+        assert payload["digest"] == handoff_digest(_payload())
+
+    def test_flipped_kv_bit_is_digest_error(self):
+        payload = stamp_handoff(_payload())
+        arr = payload["kv"][0]["k"]
+        arr.view(np.uint8).flat[0] ^= 0xFF
+        with pytest.raises(HandoffError) as e:
+            verify_handoff(payload)
+        assert e.value.kind == "digest"
+        assert "handoff digest mismatch" in str(e.value)
+
+    def test_geometry_and_prompt_are_covered(self):
+        base = stamp_handoff(_payload())
+        tampered = dict(_payload())
+        tampered["prefill_len"] = 6
+        assert handoff_digest(tampered) != base["digest"]
+        tampered = _payload()
+        tampered["request"]["prompt"] = np.arange(1, 6, dtype=np.int32)
+        assert handoff_digest(tampered) != base["digest"]
+
+    def test_serialize_stamps_and_deserialize_verifies(self):
+        blob = serialize_handoff(_payload())       # digest auto-stamped
+        out = deserialize_handoff(blob)
+        assert out["digest"] == handoff_digest(_payload())
+
+    def test_wrong_stamp_refused_at_deserialize(self):
+        payload = _payload()
+        payload["digest"] = 0xDEADBEEF             # exporter lied
+        blob = serialize_handoff(payload)
+        with pytest.raises(HandoffError) as e:
+            deserialize_handoff(blob)
+        assert e.value.kind == "digest"
+
+    def test_undigested_payload_passes_compat(self):
+        payload = _payload()
+        assert "digest" not in payload
+        assert verify_handoff(payload) is payload
+
+
+# ---------------------------------------------------------------------------
+# FleetFrontend backpressure + retention (fake fleet — no engine)
+# ---------------------------------------------------------------------------
+
+class _FakeHandle:
+    def __init__(self, rid, on_token, tokens=(1, 2, 3), status="finished"):
+        self.request_id = rid
+        self.status = status
+        self.done = True
+        for t in tokens:
+            on_token(self, t)
+
+
+class _FakeFleet:
+    """Finishes every request instantly at drain time."""
+
+    def __init__(self, status="finished"):
+        self.degraded = False
+        self._status = status
+
+    def submit(self, prompt, max_new_tokens, request_id=None,
+               priority=0, on_token=None):
+        return _FakeHandle(request_id, on_token, status=self._status)
+
+
+def _frontend(**kw):
+    from deepspeed_tpu.serving.fleet.federation.frontend import FleetFrontend
+    return FleetFrontend(**kw)
+
+
+class TestFrontendBackpressure:
+    def test_queue_cap_rejects_with_retry_after(self):
+        from deepspeed_tpu.serving.fleet.federation.frontend import (
+            FrontendOverloaded)
+        fe = _frontend(queue_cap=2)
+        fe.submit([1], 4)
+        fe.submit([2], 4)
+        with pytest.raises(FrontendOverloaded) as e:
+            fe.submit([3], 4)
+        assert e.value.retry_after_s >= 1
+        assert fe.rejected_429 == 1 and fe.submitted == 2
+
+    def test_drain_reopens_admission(self):
+        fe = _frontend(queue_cap=2)
+        fe.submit([1], 4)
+        fe.submit([2], 4)
+        fe.drain(_FakeFleet())
+        assert fe.finished == 2
+        fe.submit([3], 4)                  # admitted again
+        assert fe.submitted == 3
+
+    def test_shed_signal_stretches_retry_after(self):
+        fe = _frontend(queue_cap=1)
+        assert fe.retry_after_s() == 1
+        fe.submit([1], 4)
+        fe.drain(_FakeFleet(status="shed"))
+        assert fe.retry_after_s() > 1      # the QoS ladder's signal
+        fe.drain(_FakeFleet())             # healthy drain clears it
+        assert fe.retry_after_s() == 1
+
+    def test_http_429_with_retry_after_header(self):
+        fe = _frontend(queue_cap=1).start()
+        try:
+            base = f"http://127.0.0.1:{fe.port}"
+            body = json.dumps({"prompt": [1, 2],
+                               "max_new_tokens": 4}).encode()
+
+            def post():
+                return urllib.request.urlopen(urllib.request.Request(
+                    f"{base}/v1/submit", data=body,
+                    headers={"Content-Type": "application/json"}))
+
+            with post() as r:
+                assert r.status == 202
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post()
+            assert e.value.code == 429
+            assert int(e.value.headers["Retry-After"]) >= 1
+        finally:
+            fe.stop()
+
+
+class TestFrontendRetention:
+    def test_unread_finals_bounded_lru(self):
+        """N requests >> results_cap: memory stays bounded — the oldest
+        unread finals evict, the newest survive."""
+        fe = _frontend(results_cap=5)
+        rids = [fe.submit([i], 4) for i in range(40)]
+        fe.drain(_FakeFleet())
+        assert fe.finished == 40
+        assert len(fe._requests) == 5
+        assert fe.results_evicted_unread == 35
+        assert fe.read_result(rids[0]) is None        # evicted (oldest)
+        view = fe.read_result(rids[-1])               # newest retained
+        assert view["done"] and view["tokens"] == [1, 2, 3]
+
+    def test_result_read_is_consume_once(self):
+        fe = _frontend()
+        rid = fe.submit([1], 4)
+        fe.drain(_FakeFleet())
+        assert fe.read_result(rid)["done"]
+        assert fe.read_result(rid) is None
+        assert not fe._requests and not fe._finished
+
+    def test_unfinished_results_never_evicted(self):
+        class _Pending:
+            degraded = False
+
+            def submit(self, prompt, max_new_tokens, request_id=None,
+                       priority=0, on_token=None):
+                h = _FakeHandle(request_id, on_token)
+                h.done = False
+                h.status = "running"
+                return h
+
+        fe = _frontend(results_cap=2)
+        rids = [fe.submit([i], 4) for i in range(10)]
+        fe.drain(_Pending())
+        assert len(fe._requests) == 10     # open, not finals: all kept
+        view = fe.read_result(rids[3])
+        assert view is not None and not view["done"]
+        assert fe.read_result(rids[3]) is not None    # NOT consumed
+
+    def test_stream_emits_keepalives_while_quiet(self, monkeypatch):
+        import deepspeed_tpu.serving.fleet.federation.frontend as fmod
+        monkeypatch.setattr(fmod, "_STREAM_KEEPALIVE_S", 0.3)
+        monkeypatch.setattr(fmod, "_STREAM_POLL_S", 0.05)
+        fe = _frontend().start()
+        try:
+            rid = fe.submit([1], 4)        # never dispatched: quiet
+            sock = socket.create_connection(("127.0.0.1", fe.port),
+                                            timeout=5.0)
+            sock.sendall(f"GET /v1/stream?id={rid} HTTP/1.1\r\n"
+                         f"Host: x\r\n\r\n".encode())
+            sock.settimeout(5.0)
+            buf = b""
+            deadline = time.time() + 5.0
+            while b'"keepalive"' not in buf and time.time() < deadline:
+                buf += sock.recv(4096)
+            assert b'"keepalive"' in buf
+            rec = fe.get(rid)
+            rec.finish("cancelled")        # unblock + end the stream
+            while b'"done"' not in buf and time.time() < deadline:
+                buf += sock.recv(4096)
+            assert b'"status": "cancelled"' in buf
+            sock.close()
+        finally:
+            fe.stop()

@@ -618,17 +618,23 @@ class TestFederatedFleetEndToEnd:
                 assert r.status == 202
                 rid = json.loads(r.read())["request_id"]
             _run(fleet, until=lambda: not frontend.busy)
+            # stream BEFORE the result read: /v1/result consumes a
+            # finished record (read-once retention)
+            with urllib.request.urlopen(f"{base}/v1/stream?id={rid}") as r:
+                lines = [json.loads(ln) for ln in r.read().splitlines()]
+            assert lines[-1] == {"done": True, "status": "finished"}
             with urllib.request.urlopen(f"{base}/v1/result?id={rid}") as r:
                 result = json.loads(r.read())
             assert result["done"] and result["status"] == "finished"
             ref = _ref_tokens(m, params, prompt, 6)
             np.testing.assert_array_equal(np.asarray(result["tokens"]),
                                           ref)
-            with urllib.request.urlopen(f"{base}/v1/stream?id={rid}") as r:
-                lines = [json.loads(ln) for ln in r.read().splitlines()]
             assert [ln["token"] for ln in lines[:-1]] == result["tokens"]
-            assert lines[-1] == {"done": True, "status": "finished"}
             assert frontend.submitted == 1 and frontend.finished == 1
+            # the read consumed the finished record: a re-read is 404
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(f"{base}/v1/result?id={rid}")
+            assert e.value.code == 404
             # malformed submission and unknown id stay client errors
             with pytest.raises(urllib.error.HTTPError) as e:
                 urllib.request.urlopen(urllib.request.Request(
