@@ -54,6 +54,7 @@ _CONFIG_MODULES = (
     "deepspeed_tpu/serving/fleet/supervision.py",
     "deepspeed_tpu/serving/fleet/federation/config.py",
     "deepspeed_tpu/observability/config.py",
+    "deepspeed_tpu/observability/slo.py",
     "deepspeed_tpu/runtime/resilience/config.py",
     "deepspeed_tpu/runtime/tiering/config.py",
 )

@@ -42,12 +42,18 @@ from typing import Callable, Dict, List, Optional
 from .metrics import percentile
 
 # waterfall stages, in lifecycle order; each stage's mark names the
-# event that ENDS it (the chain starts at "submit")
-STAGES = ("queue", "prefill", "handoff", "decode")
+# event that ENDS it (the chain starts at "submit"). "handoff" ends
+# when the prefill side EXPORTS the KV payload; "wire" spans
+# export→inject — the steps the handoff spent crossing hosts (staged,
+# backlogged, retried, or crossing the federation wire). For a
+# non-disaggregated request both stages clamp to zero, so the five
+# stages still telescope exactly to total_steps for every request.
+STAGES = ("queue", "prefill", "handoff", "wire", "decode")
 _STAGE_END_EVENT = {
     "queue": "admit",
     "prefill": "first_token",
-    "handoff": "handoff_inject",
+    "handoff": "handoff_export",
+    "wire": "handoff_inject",
     # decode ends at whichever terminal event the request reached
 }
 TERMINAL_EVENTS = ("finished", "shed", "timeout", "cancelled")
@@ -132,8 +138,10 @@ def per_request_breakdown(events, include_requests: bool = True) -> dict:
     """Per-request stage waterfall from flight-recorder events.
 
     Stages run queue (submit→admit), prefill (admit→first_token),
-    handoff (first_token→handoff_inject; zero when the request never
-    crossed a replica boundary), decode (→terminal). Marks are made
+    handoff (first_token→handoff_export), wire (handoff_export→
+    handoff_inject — the steps the KV payload spent in flight between
+    replicas; both zero when the request never crossed a replica
+    boundary), decode (→terminal). Marks are made
     monotone (``max`` against the previous boundary), so per-request
     stage sums are EXACTLY ``terminal - submit`` — the request's
     end-to-end steps — no matter which marks are missing. Returns
@@ -188,7 +196,7 @@ _SPAN_STAGE = {
     "serving/admit": "prefill",
     "serving/prefill_chunk": "prefill",
     "serving/handoff_export": "handoff",
-    "serving/handoff_inject": "handoff",
+    "serving/handoff_inject": "wire",
     "serving/decode_residency": "decode",
 }
 

@@ -1,0 +1,243 @@
+"""Declarative SLO watch over the fleet's merged telemetry sample.
+
+The federation already *measures* everything an operator would page on
+— TTFT percentiles, shed rate, replica liveness, corrupt-handoff
+containment counts, wire RTT — but measurement without judgment is a
+dashboard, not an alarm. This module adds the judgment layer: a small
+set of declarative rules (``serving.fleet.slo`` config block) evaluated
+on the fleet's aggregation cadence, with fire/clear hysteresis so one
+flapping sample never pages, and a bounded structured incident log
+(flight-recorder pattern) that rides every snapshot and crash path.
+
+Determinism discipline (DT002 applies to alarms too): rules are
+evaluated on the fleet STEP clock and incidents are stamped only with
+step numbers and sample values — no wall clock anywhere in the
+evaluation or the incident records — so replaying the same sample
+sequence reproduces the incident log bit-exactly. ``SloWatch`` is a
+pure function of ``(rules, sample sequence)``.
+
+Sample keys (built by the fleet manager from its own books plus the
+:class:`~deepspeed_tpu.observability.fleet.FleetTelemetryAggregator`
+merged view):
+
+- ``ttft_p95_steps``       p95 of submit→first_token, in fleet steps
+- ``shed_rate``            shed / submitted (cumulative)
+- ``replica_up_fraction``  live replicas / fleet size
+- ``corrupt_handoff_rate`` handoffs_rejected_corrupt / handoff attempts
+- ``wire_rtt_p95_ms``      p95 dispatch→reply RTT across remote peers
+
+A missing key leaves its rule's streaks untouched-as-ok — a fleet with
+no remote peers never breaches the wire rule. A threshold of 0 (or
+less) disables the rule entirely.
+
+Gauges: ``slo/breaches`` (cumulative incidents opened) and
+``slo/incidents_open`` (currently firing) land in the process registry
+so /metrics, /statusz and ``ds_tpu_report`` surface them for free.
+
+Stdlib-only; no jax.
+"""
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .metrics import get_registry
+
+# (config attribute, sample key, direction) — direction names the
+# breaching side: "above" fires when value > threshold, "below" when
+# value < threshold
+_RULE_SPECS = (
+    ("ttft_p95_steps", "ttft_p95_steps", "above"),
+    ("shed_rate", "shed_rate", "above"),
+    ("replica_up_fraction", "replica_up_fraction", "below"),
+    ("corrupt_handoff_rate", "corrupt_handoff_rate", "above"),
+    ("wire_rtt_p95_ms", "wire_rtt_p95_ms", "above"),
+)
+
+
+@dataclass
+class SloConfig:
+    """The ``serving.fleet.slo`` config sub-block. ``enabled`` gates
+    the whole watch; a threshold of 0 disables that one rule (so the
+    defaults arm only the rules whose sample is always meaningful)."""
+
+    enabled: bool = False
+    # p95 submit→first_token in fleet steps; 0 = rule off
+    ttft_p95_steps: float = 0.0
+    # shed / submitted above this fraction breaches
+    shed_rate: float = 0.25
+    # live replicas / fleet size BELOW this fraction breaches
+    replica_up_fraction: float = 0.5
+    # corrupt-handoff rejections / handoff attempts; 0 = rule off
+    corrupt_handoff_rate: float = 0.0
+    # p95 dispatch→reply wire RTT in ms; 0 = rule off
+    wire_rtt_p95_ms: float = 0.0
+    # consecutive breaching evaluations before an incident FIRES
+    fire_streak: int = 3
+    # consecutive clean evaluations before an open incident CLEARS
+    clear_streak: int = 3
+    # bounded incident ring capacity (flight-recorder pattern)
+    incident_log_events: int = 64
+
+    def validate(self):
+        if self.fire_streak < 1:
+            raise ValueError(
+                f"serving.fleet.slo.fire_streak must be >= 1, got "
+                f"{self.fire_streak}")
+        if self.clear_streak < 1:
+            raise ValueError(
+                f"serving.fleet.slo.clear_streak must be >= 1, got "
+                f"{self.clear_streak}")
+        if self.incident_log_events < 0:
+            raise ValueError(
+                f"serving.fleet.slo.incident_log_events must be >= 0, "
+                f"got {self.incident_log_events}")
+        for knob in ("shed_rate", "replica_up_fraction",
+                     "corrupt_handoff_rate"):
+            v = getattr(self, knob)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(
+                    f"serving.fleet.slo.{knob} must be in [0, 1], "
+                    f"got {v}")
+        for knob in ("ttft_p95_steps", "wire_rtt_p95_ms"):
+            if getattr(self, knob) < 0:
+                raise ValueError(
+                    f"serving.fleet.slo.{knob} must be >= 0, got "
+                    f"{getattr(self, knob)}")
+
+
+@dataclass
+class SloRule:
+    """One armed rule: ``name`` (the config knob), the ``key`` it reads
+    from the merged sample, the breaching ``direction``, and the
+    threshold."""
+
+    name: str
+    key: str
+    threshold: float
+    direction: str = "above"   # "above" | "below"
+
+    def breaching(self, value: Optional[float]) -> bool:
+        if value is None:
+            return False       # absent sample counts as ok, by design
+        if self.direction == "above":
+            return value > self.threshold
+        return value < self.threshold
+
+
+def rules_from_config(cfg: SloConfig) -> List[SloRule]:
+    """The armed rules for a config — zero-threshold rules dropped."""
+    rules = []
+    for knob, key, direction in _RULE_SPECS:
+        threshold = float(getattr(cfg, knob))
+        if threshold > 0.0:
+            rules.append(SloRule(knob, key, threshold, direction))
+    return rules
+
+
+class SloWatch:
+    """Hysteresis-gated incident tracking over a sample stream.
+
+    ``evaluate(sample, step)`` is called on the aggregation cadence.
+    A rule must breach ``fire_streak`` consecutive evaluations before
+    an incident opens, and then pass ``clear_streak`` consecutive
+    evaluations before it clears — a single flapping sample moves a
+    streak but never opens or closes anything. Incident records carry
+    only step stamps and sample values (no wall clock), so the same
+    sample sequence replays to a bit-identical incident log.
+    """
+
+    def __init__(self, rules: List[SloRule], *, fire_streak: int = 3,
+                 clear_streak: int = 3, incident_log_events: int = 64):
+        self.rules = list(rules)
+        self.fire_streak = max(1, int(fire_streak))
+        self.clear_streak = max(1, int(clear_streak))
+        self._breach_streak: Dict[str, int] = {r.name: 0 for r in rules}
+        self._ok_streak: Dict[str, int] = {r.name: 0 for r in rules}
+        # rule name -> the open incident's record (also in the ring)
+        self.open_incidents: Dict[str, dict] = {}
+        self.incidents_opened = 0
+        self.incidents_cleared = 0
+        self.evaluations = 0
+        self._capacity = max(0, int(incident_log_events))
+        self._ring = deque(maxlen=self._capacity or None)
+        self._recorded = 0
+
+    @classmethod
+    def from_config(cls, cfg: SloConfig) -> "SloWatch":
+        return cls(rules_from_config(cfg),
+                   fire_streak=cfg.fire_streak,
+                   clear_streak=cfg.clear_streak,
+                   incident_log_events=cfg.incident_log_events)
+
+    def _record(self, rec: dict):
+        self._recorded += 1
+        if self._capacity:
+            self._ring.append(rec)
+
+    def evaluate(self, sample: Dict[str, float], step: int) -> List[dict]:
+        """One evaluation tick → the incident records that fired or
+        cleared THIS tick (empty most of the time). Also refreshes the
+        ``slo/*`` gauges."""
+        self.evaluations += 1
+        transitions = []
+        for rule in self.rules:
+            value = sample.get(rule.key)
+            if rule.breaching(value):
+                self._breach_streak[rule.name] += 1
+                self._ok_streak[rule.name] = 0
+                if (rule.name not in self.open_incidents
+                        and self._breach_streak[rule.name]
+                        >= self.fire_streak):
+                    rec = {"event": "incident_open",
+                           "rule": rule.name,
+                           "step": int(step),
+                           "value": value,
+                           "threshold": rule.threshold,
+                           "direction": rule.direction}
+                    self.open_incidents[rule.name] = rec
+                    self.incidents_opened += 1
+                    get_registry().counter("slo/breaches").inc()
+                    self._record(rec)
+                    transitions.append(rec)
+            else:
+                self._ok_streak[rule.name] += 1
+                self._breach_streak[rule.name] = 0
+                if (rule.name in self.open_incidents
+                        and self._ok_streak[rule.name]
+                        >= self.clear_streak):
+                    opened = self.open_incidents.pop(rule.name)
+                    rec = {"event": "incident_clear",
+                           "rule": rule.name,
+                           "step": int(step),
+                           "opened_step": opened["step"],
+                           "duration_steps": int(step) - opened["step"],
+                           "threshold": rule.threshold}
+                    self.incidents_cleared += 1
+                    self._record(rec)
+                    transitions.append(rec)
+        get_registry().gauge("slo/incidents_open").set(
+            len(self.open_incidents))
+        return transitions
+
+    def snapshot(self) -> dict:
+        """Structured state for /statusz, fleet snapshots and the crash
+        path: armed rules, open incidents, and the bounded incident
+        ring (flight-recorder shape: capacity / recorded / dropped)."""
+        return {
+            "rules": [{"name": r.name, "threshold": r.threshold,
+                       "direction": r.direction} for r in self.rules],
+            "fire_streak": self.fire_streak,
+            "clear_streak": self.clear_streak,
+            "evaluations": self.evaluations,
+            "incidents_opened": self.incidents_opened,
+            "incidents_cleared": self.incidents_cleared,
+            "open_incidents": [dict(v)
+                               for v in self.open_incidents.values()],
+            "incident_log": {
+                "capacity": self._capacity,
+                "recorded": self._recorded,
+                "dropped": self._recorded - len(self._ring),
+                "events": [dict(e) for e in self._ring],
+            },
+        }

@@ -134,8 +134,14 @@ import re as _re
 
 _INST_RE = _re.compile(
     r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*([a-z0-9]+)\[([0-9,]*)\]")
+# Operands print two ways depending on HLO dialect: bare names
+# (`dot(%lhs, %rhs)`, older dumps) or inline-typed
+# (`dot(f32[16,32]{1,0} %lhs, f32[32,96]{1,0} %rhs)`, current XLA).
+# Capture the optional dtype/dims prefix per operand so the contraction
+# size never depends on the name being resolvable in the shapes table.
+_OPERAND = r"(?:([a-z0-9]+)\[([0-9,]*)\](?:\{[^}]*\})?\s+)?%?([\w.\-]+)"
 _OPERANDS_RE = _re.compile(
-    r"(?:dot|convolution)\(%?([\w.\-]+),\s*%?([\w.\-]+)")
+    r"(?:dot|convolution)\(" + _OPERAND + r",\s*" + _OPERAND)
 _LHS_CDIMS_RE = _re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
 _DIM_LABELS_RE = _re.compile(r"dim_labels=([\w>\-]+)")
 _OP_NAME_RE = _re.compile(r'op_name="([^"]+)"')
@@ -152,6 +158,7 @@ def _strip_scope_segment(seg: str) -> Optional[str]:
     if not seg or not seg[0].isalpha():
         return None
     dropped = {"jit", "jvp", "transpose", "vmap", "while", "body", "cond",
+               "main",          # modern jax wraps everything in jit(main)
                "scan", "remat", "checkpoint", "closed_call", "custom_vjp",
                "custom_jvp", "train_step", "f", "fn", "shard_map", "pjit",
                "dot_general", "conv_general_dilated", "dot", "convolution",
@@ -160,6 +167,22 @@ def _strip_scope_segment(seg: str) -> Optional[str]:
     if seg in dropped or "->" in seg or "," in seg:
         return None
     return seg
+
+
+def _operand_shapes(ops, shapes):
+    """(dtype, dims) per captured operand: the inline typed form wins
+    when present, the instruction-table lookup covers bare names, None
+    marks an operand whose shape is unrecoverable either way."""
+    out = []
+    for dt, dims, name in ((ops.group(1), ops.group(2), ops.group(3)),
+                           (ops.group(4), ops.group(5), ops.group(6))):
+        if dt is not None:
+            out.append((dt, tuple(int(d) for d in dims.split(",") if d)))
+        elif name in shapes:
+            out.append(shapes[name])
+        else:
+            out.append(None)
+    return out
 
 
 def per_module_breakdown(compiled, max_depth: int = 4) -> Dict[str, Dict]:
@@ -194,18 +217,21 @@ def per_module_breakdown(compiled, max_depth: int = 4) -> Dict[str, Dict]:
         name, dt, dims = m.groups()
         out_shape = tuple(int(d) for d in dims.split(",") if d)
         ops = _OPERANDS_RE.search(line)
+        lhs = rhs = None
+        if ops:
+            lhs, rhs = _operand_shapes(ops, shapes)
         k = 1
         if is_dot:
             cd = _LHS_CDIMS_RE.search(line)
-            if ops and cd and ops.group(1) in shapes:
-                lhs_shape = shapes[ops.group(1)][1]
+            if lhs is not None and cd:
+                lhs_shape = lhs[1]
                 for i in (int(x) for x in cd.group(1).split(",") if x):
                     if i < len(lhs_shape):
                         k *= lhs_shape[i]
-        elif ops and ops.group(2) in shapes:
+        elif rhs is not None:
             # convolution: contraction = kernel elems per output channel
             # (kH*kW*Cin); the kernel's 'o' dim from dim_labels is excluded
-            kshape = shapes[ops.group(2)][1]
+            kshape = rhs[1]
             dl = _DIM_LABELS_RE.search(line)
             o_idx = None
             if dl:
@@ -217,9 +243,9 @@ def per_module_breakdown(compiled, max_depth: int = 4) -> Dict[str, Dict]:
         flops = 2.0 * float(np.prod(out_shape, dtype=np.float64)) * k
         nbytes = float(np.prod(out_shape, dtype=np.float64)) \
             * dtype_bytes.get(dt, 4)
-        for op in (ops.group(1), ops.group(2)) if ops else ():
-            if op in shapes:
-                odt, osh = shapes[op]
+        for op in (lhs, rhs):
+            if op is not None:
+                odt, osh = op
                 nbytes += float(np.prod(osh, dtype=np.float64)) \
                     * dtype_bytes.get(odt, 4)
         opm = _OP_NAME_RE.search(line)

@@ -122,6 +122,14 @@ class FleetConfig:
                                      # single-host fleet, no peers — the
                                      # manager still reads rolling
                                      # defaults from None safely
+    slo: Optional["SloConfig"] = field(default=None)
+                                     # declarative SLO watch over the
+                                     # aggregated telemetry sample
+                                     # (observability/slo.py): fire/
+                                     # clear hysteresis, bounded
+                                     # incident log; absent = defaults
+                                     # (watch DISABLED — slo.enabled:
+                                     # true arms it)
 
     def __post_init__(self):
         # nested-dict lift, same contract as ServingConfig.__post_init__
@@ -135,6 +143,12 @@ class FleetConfig:
         if isinstance(self.federation, dict):
             from .federation.config import FederationConfig
             self.federation = FederationConfig(**self.federation)
+        if self.slo is None:
+            from deepspeed_tpu.observability.slo import SloConfig
+            self.slo = SloConfig()
+        elif isinstance(self.slo, dict):
+            from deepspeed_tpu.observability.slo import SloConfig
+            self.slo = SloConfig(**self.slo)
 
     def validate(self, serving_config=None) -> "FleetConfig":
         if self.replicas < 1:
@@ -205,6 +219,7 @@ class FleetConfig:
                 "serving.fleet.worker_reply_timeout_s must be > 0, got "
                 f"{self.worker_reply_timeout_s}")
         self.supervision.validate()
+        self.slo.validate()
         if self.federation is not None:
             self.federation.validate()
             if len(self.federation.peers) > self.replicas:

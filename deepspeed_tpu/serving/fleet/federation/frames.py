@@ -100,6 +100,11 @@ class FrameDecoder:
     def __init__(self, max_frame_bytes=DEFAULT_MAX_FRAME_BYTES):
         self.max_frame_bytes = int(max_frame_bytes)
         self._buf = bytearray()
+        # cumulative wire bytes consumed as complete frames (header +
+        # payload, crc-failing frames included) — the rx half of the
+        # wire accountant's exact byte reconciliation: each complete
+        # frame consumes precisely len(encode_frame(payload, kind, rev))
+        self.consumed = 0
 
     @property
     def pending(self):
@@ -144,11 +149,13 @@ class FrameDecoder:
                 # the request/reply pairing is broken either way, so the
                 # caller still treats it as a containment event
                 del self._buf[:end]
+                self.consumed += end
                 raise FrameError(
                     "corrupt",
                     f"payload crc32 {crc:#010x} != header "
                     f"{fields[3]:#010x} ({length} bytes)")
         del self._buf[:end]
+        self.consumed += end
         return kind, payload
 
     def eof(self):

@@ -37,15 +37,31 @@ dropped by the front-end — 429 happens at admission or not at all.
 Retention: finished results a client never reads can't accumulate
 forever either — ``results_cap`` bounds them LRU, oldest unread final
 evicted first (and counted in ``results_evicted_unread``).
+
+Trace stitching: the front-end mints each request's ``trace_id`` at
+accept time (same derivation the fleet would use) and threads it
+through dispatch, so the 202 reply, every ndjson stream line's final
+record, and ``/v1/result`` all carry the id a client needs to find its
+request in the stitched fleet Chrome trace.
+
+Access log: every handled request lands in a bounded flight-recorder
+ring (method, path, status, trace_id, wall ms) plus
+``frontend/http_requests_total/<code>`` counters — surfaced through
+``snapshot()`` into /statusz and the ``ds_tpu_serve`` exit summary.
 """
 
 import json
 import threading
+import time
 from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
+
+from deepspeed_tpu.observability.fleet import (FlightRecorder,
+                                               make_trace_id)
+from deepspeed_tpu.observability.metrics import get_registry
 
 _STREAM_POLL_S = 0.25      # long-poll wakeup cadence (transport-side
                            # only; never consulted by dispatch)
@@ -65,11 +81,13 @@ class FrontendOverloaded(RuntimeError):
 
 
 class _FrontendRequest:
-    def __init__(self, request_id, prompt, max_new_tokens, priority):
+    def __init__(self, request_id, prompt, max_new_tokens, priority,
+                 trace_id=None):
         self.request_id = request_id
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.priority = priority
+        self.trace_id = trace_id
         self.tokens = []
         self.status = "queued"
         self.done = False
@@ -91,7 +109,8 @@ class _FrontendRequest:
     def view(self):
         with self._cond:
             return {"request_id": self.request_id, "status": self.status,
-                    "tokens": list(self.tokens), "done": self.done}
+                    "tokens": list(self.tokens), "done": self.done,
+                    "trace_id": self.trace_id}
 
 
 class FleetFrontend:
@@ -104,7 +123,7 @@ class FleetFrontend:
     finished-but-unread result records (LRU)."""
 
     def __init__(self, host="127.0.0.1", port=0, *,
-                 queue_cap=0, results_cap=256):
+                 queue_cap=0, results_cap=256, access_log_events=256):
         self._host = host
         self._port = port
         self.queue_cap = int(queue_cap)
@@ -125,6 +144,43 @@ class FleetFrontend:
         self.finished = 0
         self.rejected_429 = 0
         self.results_evicted_unread = 0
+        # bounded access log: one event per handled HTTP request
+        # (method, path, status, trace_id, wall ms); 0 disables the
+        # ring but status counters still accumulate
+        self.access_log = FlightRecorder(access_log_events)
+        self._status_counts = {}     # http status -> count
+
+    def record_access(self, method, path, status, trace_id=None,
+                      wall_ms=None):
+        """HTTP-thread side: one access-log event + the per-status
+        counter (``frontend/http_requests_total/<code>``)."""
+        code = int(status)
+        with self._lock:
+            self._status_counts[code] = \
+                self._status_counts.get(code, 0) + 1
+        get_registry().counter(
+            f"frontend/http_requests_total/{code}").inc()
+        self.access_log.record("http_request", trace_id=trace_id,
+                               method=method, path=path, status=code,
+                               wall_ms=wall_ms)
+
+    def snapshot(self) -> dict:
+        """The front-end section of the fleet snapshot: admission and
+        retention counters, per-status totals, and the bounded access
+        log — /statusz and the exit summary render from this."""
+        with self._lock:
+            counts = dict(sorted(self._status_counts.items()))
+            open_now = self._open
+            pending = len(self._pending)
+        return {"submitted": self.submitted,
+                "finished": self.finished,
+                "rejected_429": self.rejected_429,
+                "results_evicted_unread": self.results_evicted_unread,
+                "open": open_now,
+                "pending": pending,
+                "shedding": self._shedding,
+                "http_requests_total": counts,
+                "access_log": self.access_log.snapshot()}
 
     @property
     def port(self):
@@ -149,8 +205,13 @@ class FleetFrontend:
                     f"{self.queue_cap}", self.retry_after_s())
             self._next_id += 1
             rid = f"http-{self._next_id}"
+            # minted HERE (same derivation the fleet would use) so the
+            # 202 reply can hand the client its stitched-trace join key
+            # before the dispatch thread ever sees the request
             rec = _FrontendRequest(rid, [int(t) for t in prompt],
-                                   int(max_new_tokens), int(priority))
+                                   int(max_new_tokens), int(priority),
+                                   trace_id=make_trace_id(
+                                       rid, self._next_id))
             self._requests[rid] = rec
             self._pending.append(rec)
             self._open += 1
@@ -189,7 +250,7 @@ class FleetFrontend:
             rec.handle = fleet.submit(
                 np.asarray(rec.prompt, np.int32), rec.max_new_tokens,
                 request_id=rec.request_id, priority=rec.priority,
-                on_token=rec.on_token)
+                on_token=rec.on_token, trace_id=rec.trace_id)
             self._active.append(rec)
         still = []
         shed_seen = False
@@ -234,7 +295,7 @@ class FleetFrontend:
             def log_message(self, fmt, *args):
                 pass
 
-            def _reply(self, code, obj, headers=()):
+            def _reply(self, code, obj, headers=(), trace_id=None):
                 body = json.dumps(obj).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -243,8 +304,13 @@ class FleetFrontend:
                     self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(body)
+                frontend.record_access(
+                    self.command, urlparse(self.path).path, code,
+                    trace_id=trace_id,
+                    wall_ms=(time.perf_counter() - self._t0) * 1e3)
 
             def do_POST(self):
+                self._t0 = time.perf_counter()
                 if urlparse(self.path).path != "/v1/submit":
                     self._reply(404, {"error": "unknown endpoint"})
                     return
@@ -266,9 +332,14 @@ class FleetFrontend:
                          "retry_after_s": e.retry_after_s},
                         headers=(("Retry-After", str(e.retry_after_s)),))
                     return
-                self._reply(202, {"request_id": rid})
+                rec = frontend.get(rid)
+                trace_id = rec.trace_id if rec is not None else None
+                self._reply(202, {"request_id": rid,
+                                  "trace_id": trace_id},
+                            trace_id=trace_id)
 
             def do_GET(self):
+                self._t0 = time.perf_counter()
                 url = urlparse(self.path)
                 rid = (parse_qs(url.query).get("id") or [None])[0]
                 if url.path == "/v1/result":
@@ -276,7 +347,8 @@ class FleetFrontend:
                     if view is None:
                         self._reply(404, {"error": f"unknown id {rid!r}"})
                         return
-                    self._reply(200, view)
+                    self._reply(200, view,
+                                trace_id=view.get("trace_id"))
                     return
                 if url.path == "/v1/stream":
                     rec = frontend.get(rid) if rid else None
@@ -313,15 +385,22 @@ class FleetFrontend:
                             json.dumps({"keepalive": True}).encode()
                             + b"\n")
                     for token in fresh:
-                        self.wfile.write(
-                            json.dumps({"token": token}).encode() + b"\n")
+                        self.wfile.write(json.dumps(
+                            {"token": token,
+                             "trace_id": rec.trace_id}).encode() + b"\n")
                     sent += len(fresh)
                     self.wfile.flush()
                     if done:
                         self.wfile.write(json.dumps(
-                            {"done": True, "status": status}).encode()
+                            {"done": True, "status": status,
+                             "trace_id": rec.trace_id}).encode()
                             + b"\n")
                         self.wfile.flush()
+                        frontend.record_access(
+                            self.command, "/v1/stream", 200,
+                            trace_id=rec.trace_id,
+                            wall_ms=(time.perf_counter() - self._t0)
+                            * 1e3)
                         return
 
         self._server = ThreadingHTTPServer((self._host, self._port),

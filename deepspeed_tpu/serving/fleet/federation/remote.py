@@ -37,8 +37,10 @@ Byzantine-wire hardening (PR 19):
   wedging the fleet's dispatch thread.
 """
 
+import time
 from typing import Optional
 
+from deepspeed_tpu.observability.metrics import get_registry
 from deepspeed_tpu.serving.fleet.handoff import (
     deserialize_handoff,
     serialize_handoff,
@@ -94,6 +96,12 @@ class RemoteReplica(ProcessReplica):
         self._seq = 0
         self.stale_epoch_replies = 0
         self.duplicate_replies = 0
+        # wire-RTT pairing: each request stamps _sent_at; the matching
+        # reply observes the dispatch→reply round trip. Heartbeat pings
+        # route to their own histogram so health-probe cadence never
+        # skews the request-RTT percentiles.
+        self._sent_at: Optional[float] = None
+        self._in_ping = False
         self.host, self.port = parse_address(address)
         self.address = f"{self.host}:{self.port}"
         self.telemetry_host = self.host   # scrape where we dialed
@@ -111,6 +119,9 @@ class RemoteReplica(ProcessReplica):
             raise ReplicaDead(
                 f"replica {replica_id} peer {self.address} unreachable: "
                 f"{e}") from e
+        # label the connection for the wire accountant: every frame in
+        # either direction tallies under this peer id from here on
+        self._conn.peer = f"replica{replica_id}"
         # the init advertises our wire revision; the ready reply's
         # advertisement decides what we SEND from then on (a DSF1-only
         # peer omits the field and keeps its length-only frames)
@@ -136,6 +147,7 @@ class RemoteReplica(ProcessReplica):
             self._conn.send_msg(
                 {**msg, "_epoch": self.epoch, "_seq": self._seq},
                 blob=blob)
+            self._sent_at = time.perf_counter()
         except FrameError as e:
             # a stalled send (peer not draining past send_timeout_s):
             # the frame may be half on the wire — desynchronized, dead
@@ -169,6 +181,9 @@ class RemoteReplica(ProcessReplica):
             self.duplicate_replies += 1
             from deepspeed_tpu.observability.metrics import get_registry
             get_registry().counter("fleet/duplicate_replies").inc()
+            # a stale-seq frame is a retransmission observed on the wire
+            get_registry().counter(
+                f"wire/retransmits/replica{self.replica_id}").inc()
             return True
         return False
 
@@ -204,6 +219,13 @@ class RemoteReplica(ProcessReplica):
                 raise RuntimeError(
                     f"replica {self.replica_id} worker error: "
                     f"{msg.get('detail')}")
+            if self._sent_at is not None:
+                rtt_ms = (time.perf_counter() - self._sent_at) * 1e3
+                self._sent_at = None
+                name = ("wire/heartbeat_rtt_ms" if self._in_ping
+                        else "wire/rtt_ms")
+                get_registry().histogram(
+                    f"{name}/replica{self.replica_id}").observe(rtt_ms)
             return msg
 
     # -- liveness (heartbeat on the health-sweep cadence) ------------------
@@ -215,10 +237,12 @@ class RemoteReplica(ProcessReplica):
         self._send({"op": "ping"})
         saved = self.reply_timeout_s
         self.reply_timeout_s = self.heartbeat_timeout_s
+        self._in_ping = True
         try:
             reply = self._read_reply()
         finally:
             self.reply_timeout_s = saved
+            self._in_ping = False
         if reply.get("op") != "pong":
             self._protocol_error(
                 "malformed",

@@ -26,12 +26,25 @@ Fault injection: ``fault_injector`` (see ``netfaults.py``) intercepts
 outbound frames one at a time — the deterministic chaos instrument for
 the wire. None (the default) is the zero-overhead production path.
 
+Wire accountant (the PR-8 "measured not claimed" discipline applied to
+the federation wire): set ``conn.peer`` to a peer id and every frame
+that crosses this connection is tallied into the process registry —
+``wire/{tx,rx}_{frames,bytes}/<kind>/<peer>`` counters whose byte
+totals reconcile EXACTLY with ``encode_frame`` output sizes (tx counts
+the encoded frame as handed to the wire layer; rx counts the decoder's
+consumed bytes, header + payload, which is the same number), plus
+``wire/faults/<kind>/<peer>`` for every named ``FrameError``
+(corrupt / timeout / truncated / malformed / oversize). ``peer`` unset
+(the default) keeps the connection unaccounted — codec tests and
+anonymous sockets never pollute the registry.
+
 Stdlib-only; no jax.
 """
 
 import json
 import socket
 
+from deepspeed_tpu.observability.metrics import get_registry
 from deepspeed_tpu.serving.fleet.federation.frames import (
     DEFAULT_MAX_FRAME_BYTES,
     FrameDecoder,
@@ -42,6 +55,8 @@ from deepspeed_tpu.serving.fleet.federation.frames import (
 )
 
 _RECV_CHUNK = 1 << 16
+
+_KIND_LABELS = {KIND_JSON: "json", KIND_BLOB: "blob"}
 
 
 class PeerGone(ConnectionError):
@@ -83,6 +98,46 @@ class FrameConnection:
         self.send_timeout_s = send_timeout_s
         self.tx_rev = 1            # until the peer advertises wire_rev 2
         self.fault_injector = None  # netfaults.WireFaultInjector or None
+        # peer id for the wire accountant; None = unaccounted connection
+        self.peer = None
+        # rx watermark: decoder bytes already attributed to rx counters
+        self._rx_accounted = 0
+
+    def _account_tx(self, data):
+        """Tally one outbound encoded frame — called BEFORE the fault
+        injector so each logical frame counts exactly once no matter
+        what chaos (duplicate / blackhole / drip) does downstream."""
+        if self.peer is None:
+            return
+        reg = get_registry()
+        kind = _KIND_LABELS.get(data[4], "other")
+        reg.counter(f"wire/tx_frames/{kind}/{self.peer}").inc()
+        reg.counter(f"wire/tx_bytes/{kind}/{self.peer}").inc(len(data))
+
+    def _account_rx(self, kind):
+        """Attribute the decoder's newly-consumed bytes (header +
+        payload — exactly ``len(encode_frame(...))`` for the frame just
+        returned) to this peer's rx counters."""
+        if self.peer is None:
+            return
+        delta = self._decoder.consumed - self._rx_accounted
+        self._rx_accounted = self._decoder.consumed
+        reg = get_registry()
+        label = _KIND_LABELS.get(kind, "other")
+        reg.counter(f"wire/rx_frames/{label}/{self.peer}").inc()
+        reg.counter(f"wire/rx_bytes/{label}/{self.peer}").inc(delta)
+
+    def _account_fault(self, fault_kind):
+        """One named wire fault (corrupt / timeout / truncated /
+        malformed / oversize) against this peer. Damaged frames land
+        here, never in the rx byte tally."""
+        if self.peer is None:
+            # keep the rx watermark honest even while unaccounted
+            self._rx_accounted = self._decoder.consumed
+            return
+        get_registry().counter(
+            f"wire/faults/{fault_kind}/{self.peer}").inc()
+        self._rx_accounted = self._decoder.consumed
 
     def fileno(self):
         return self._sock.fileno()
@@ -111,6 +166,7 @@ class FrameConnection:
     def _send_frame(self, data):
         """One encoded frame onto the wire — the per-frame hook point
         the fault injector keys its ordinal schedule on."""
+        self._account_tx(data)
         if self.fault_injector is not None:
             self.fault_injector.send(self, data)
         else:
@@ -125,6 +181,7 @@ class FrameConnection:
             # half-open): a partial frame may be on the wire, so the
             # connection is desynchronized — the caller contains it the
             # same way it contains a read timeout
+            self._account_fault("timeout")
             raise FrameError(
                 "timeout",
                 f"send stalled past {self.send_timeout_s}s "
@@ -132,17 +189,27 @@ class FrameConnection:
 
     def _recv_frame(self, timeout_s):
         while True:
-            frame = self._decoder.next_frame()
+            try:
+                frame = self._decoder.next_frame()
+            except FrameError as exc:
+                self._account_fault(exc.kind)
+                raise
             if frame is not None:
+                self._account_rx(frame[0])
                 return frame
             self._sock.settimeout(timeout_s)
             try:
                 chunk = self._sock.recv(_RECV_CHUNK)
             except socket.timeout:
+                self._account_fault("timeout")
                 raise FrameError(
                     "timeout", f"no reply within {timeout_s}s")
             if not chunk:
-                self._decoder.eof()  # raises "truncated" when mid-frame
+                try:
+                    self._decoder.eof()  # raises "truncated" mid-frame
+                except FrameError as exc:
+                    self._account_fault(exc.kind)
+                    raise
                 raise PeerGone("peer closed the connection")
             self._decoder.feed(chunk)
 
@@ -151,17 +218,21 @@ class FrameConnection:
         sent with a companion blob frame."""
         kind, payload = self._recv_frame(timeout_s)
         if kind != KIND_JSON:
+            self._account_fault("malformed")
             raise FrameError("malformed", "blob frame without JSON header")
         try:
             msg = json.loads(payload.decode("utf-8"))
         except (ValueError, UnicodeDecodeError) as exc:
+            self._account_fault("malformed")
             raise FrameError("malformed", f"undecodable JSON frame: {exc}")
         if not isinstance(msg, dict):
+            self._account_fault("malformed")
             raise FrameError("malformed", "JSON frame is not an object")
         blob = None
         if msg.pop("_blob", False):
             kind, blob = self._recv_frame(timeout_s)
             if kind != KIND_BLOB:
+                self._account_fault("malformed")
                 raise FrameError(
                     "malformed", "expected blob frame after _blob header")
         return msg, blob

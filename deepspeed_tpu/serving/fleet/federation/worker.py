@@ -142,6 +142,9 @@ class FederationWorkerServer:
                     break
                 conn = FrameConnection(
                     sock, max_frame_bytes=self.max_frame_bytes)
+                # wire accountant: worker-side frames tally under the
+                # router's address
+                conn.peer = f"{peer[0]}:{peer[1]}"
                 print(f"[federation-worker] router connected from "
                       f"{peer[0]}:{peer[1]}", flush=True)
                 try:

@@ -32,7 +32,7 @@ import numpy as np
 from ...observability.fleet import (FleetTelemetryAggregator,
                                     FlightRecorder, make_trace_id,
                                     per_request_breakdown)
-from ...observability.metrics import get_registry
+from ...observability.metrics import get_registry, percentile
 from ...utils.logging import log_dist
 from ..request import Request
 from .config import FleetConfig
@@ -212,6 +212,7 @@ class ServingFleet:
         self.dead_replicas = 0
         self.requests_submitted = 0
         self.requests_finished = 0
+        self.requests_shed = 0
         self.last_scale_decision: Optional[dict] = None
         self.telemetry = None
         # -- supervision (the self-healing layer) --------------------------
@@ -263,6 +264,13 @@ class ServingFleet:
         self._aggregator = (
             FleetTelemetryAggregator(stale_after_s=self.fcfg.stale_after_s)
             if self.fcfg.aggregate_telemetry else None)
+        # declarative SLO watch (observability/slo.py): evaluated on
+        # the aggregation cadence against a sample built from the
+        # fleet's own books — deterministic on the fleet step clock
+        self.slo_watch = None
+        if self.fcfg.slo is not None and self.fcfg.slo.enabled:
+            from ...observability.slo import SloWatch
+            self.slo_watch = SloWatch.from_config(self.fcfg.slo)
         self._scaler = None
         if self.fcfg.autoscale:
             from ...elasticity.serving_autoscaler import (
@@ -449,9 +457,11 @@ class ServingFleet:
     # -- client API --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                request_id=None, priority: int = 0,
-               on_token=None) -> FleetRequest:
+               on_token=None, trace_id=None) -> FleetRequest:
         """Route one request to a replica (prefix affinity or least
-        loaded) and return its fleet-level handle."""
+        loaded) and return its fleet-level handle. ``trace_id`` lets a
+        front-end mint the id at accept time (so the HTTP reply can
+        carry it before dispatch); None derives it here as before."""
         if max_new_tokens is None:
             max_new_tokens = self.config.default_max_new_tokens
         if request_id is None:
@@ -461,7 +471,7 @@ class ServingFleet:
             raise RuntimeError("fleet: no live replica accepts submissions")
         handle = FleetRequest(prompt, max_new_tokens, request_id,
                               priority=priority, on_token=on_token,
-                              trace_id=make_trace_id(
+                              trace_id=trace_id or make_trace_id(
                                   request_id, self.requests_submitted))
         handle.submitted_iteration = self._iteration
         self.requests_submitted += 1
@@ -557,6 +567,8 @@ class ServingFleet:
         handle._inner = None
         if status == "finished":
             self.requests_finished += 1
+        elif status == "shed":
+            self.requests_shed += 1
         self.recorder.record(status, request_id=handle.request_id,
                              trace_id=handle.trace_id,
                              replica_id=handle.replica_id,
@@ -668,6 +680,15 @@ class ServingFleet:
             # off-thread: a wedged replica endpoint (scrape timeout x
             # retry) must never stall the dispatch/harvest data plane
             self._aggregator.poll_async()
+        if self.slo_watch is not None and \
+                self._iteration % self.fcfg.aggregate_every_steps == 0:
+            for rec in self.slo_watch.evaluate(self.slo_sample(),
+                                               self._iteration):
+                self.recorder.record(f"slo_{rec['event']}",
+                                     iteration=self._iteration,
+                                     rule=rec["rule"])
+                log_dist(f"fleet: slo {rec['event']} rule="
+                         f"{rec['rule']} step={rec['step']}", ranks=[0])
         self._iteration += 1
 
     @property
@@ -875,7 +896,7 @@ class ServingFleet:
                     self._failover(old_handle)
         self._handoff_backlog.append(
             {"payload": payload, "handle": handle, "attempts": 0,
-             "not_before": 0})
+             "not_before": 0, "exported_at": self._iteration})
 
     def _pump_handoffs(self, process_ready):
         """Export every staged prefill and inject into the least-loaded
@@ -968,6 +989,11 @@ class ServingFleet:
                     trace_id=payload["request"].get("trace_id"),
                     replica_id=target, iteration=self._iteration,
                     src=src)
+                # the waterfall's wire stage, as a fleet-level
+                # histogram: steps from export to accepted injection
+                get_registry().histogram("fleet/wire_rtt").observe(
+                    self._iteration - ent.get("exported_at",
+                                              self._iteration))
                 if handle is not None:
                     handle.replica_id = target
                     handle.handoffs += 1
@@ -1286,12 +1312,46 @@ class ServingFleet:
     # -- telemetry ---------------------------------------------------------
     def per_request_breakdown(self, include_requests: bool = True) -> dict:
         """The per-request latency waterfall (observability/fleet.py):
-        queue -> prefill -> handoff -> decode stage steps per traced
-        request plus per-stage p50/p95 — stage sums telescope exactly
-        to each request's end-to-end fleet steps. Derived from the
-        flight recorder, so it covers the last-N completed requests."""
+        queue -> prefill -> handoff -> wire -> decode stage steps per
+        traced request plus per-stage p50/p95 — stage sums telescope
+        exactly to each request's end-to-end fleet steps. Derived from
+        the flight recorder, so it covers the last-N completed
+        requests."""
         return per_request_breakdown(self.recorder.events,
                                      include_requests=include_requests)
+
+    def slo_sample(self) -> dict:
+        """The merged sample the SLO watch judges (observability/
+        slo.py), built from the fleet's own books on the step clock —
+        every value is deterministic given the same request trace. An
+        absent key (no completed requests yet, no remote peers) reads
+        as "ok" for its rule."""
+        sample = {}
+        bd = self.per_request_breakdown(include_requests=True)
+        # TTFT in fleet steps = submit->first_token = queue + prefill
+        waits = [row["queue"] + row["prefill"]
+                 for row in (bd.get("requests") or {}).values()]
+        if waits:
+            sample["ttft_p95_steps"] = float(percentile(waits, 95))
+        if self.requests_submitted:
+            sample["shed_rate"] = (self.requests_shed
+                                   / self.requests_submitted)
+        if self._replicas:
+            sample["replica_up_fraction"] = (len(self._alive())
+                                             / len(self._replicas))
+        attempts = self.handoffs_completed + self.handoff_retries
+        if attempts:
+            sample["corrupt_handoff_rate"] = (
+                self.handoffs_rejected_corrupt / attempts)
+        # dispatch->reply RTT pooled across every remote peer's
+        # sliding window (the wire accountant's histograms)
+        rtts = []
+        for name, hist in get_registry()._hists.items():
+            if name.startswith("wire/rtt_ms/"):
+                rtts.extend(hist.window)
+        if rtts:
+            sample["wire_rtt_p95_ms"] = float(percentile(rtts, 95))
+        return sample
 
     def snapshot(self) -> dict:
         """The fleet section of /statusz: per-replica stats + serving
@@ -1346,6 +1406,7 @@ class ServingFleet:
             "supervision": self.supervisor.snapshot(),
             "requests_submitted": self.requests_submitted,
             "requests_finished": self.requests_finished,
+            "requests_shed": self.requests_shed,
             "remote_replicas": sum(
                 1 for rep in self._replicas.values()
                 if rep.backend == "remote" and rep.alive),
@@ -1362,6 +1423,12 @@ class ServingFleet:
         }
         if self._aggregator is not None:
             out["telemetry"] = self._aggregator.snapshot()
+        if self.slo_watch is not None:
+            # rides every snapshot AND the crash path (the exit/crash
+            # dumps call snapshot()), so open incidents survive a wreck
+            out["slo"] = self.slo_watch.snapshot()
+        if self._frontend is not None:
+            out["frontend"] = self._frontend.snapshot()
         return out
 
     def metrics_snapshot(self) -> dict:

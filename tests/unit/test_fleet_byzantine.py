@@ -611,7 +611,7 @@ class _FakeFleet:
         self._status = status
 
     def submit(self, prompt, max_new_tokens, request_id=None,
-               priority=0, on_token=None):
+               priority=0, on_token=None, trace_id=None):
         return _FakeHandle(request_id, on_token, status=self._status)
 
 
@@ -699,7 +699,7 @@ class TestFrontendRetention:
             degraded = False
 
             def submit(self, prompt, max_new_tokens, request_id=None,
-                       priority=0, on_token=None):
+                       priority=0, on_token=None, trace_id=None):
                 h = _FakeHandle(request_id, on_token)
                 h.done = False
                 h.status = "running"

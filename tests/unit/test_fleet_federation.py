@@ -616,16 +616,26 @@ class TestFederatedFleetEndToEnd:
                     f"{base}/v1/submit", data=body,
                     headers={"Content-Type": "application/json"})) as r:
                 assert r.status == 202
-                rid = json.loads(r.read())["request_id"]
+                accepted = json.loads(r.read())
+                rid = accepted["request_id"]
+                trace_id = accepted["trace_id"]
+            assert trace_id          # minted at accept, before dispatch
             _run(fleet, until=lambda: not frontend.busy)
             # stream BEFORE the result read: /v1/result consumes a
             # finished record (read-once retention)
             with urllib.request.urlopen(f"{base}/v1/stream?id={rid}") as r:
                 lines = [json.loads(ln) for ln in r.read().splitlines()]
-            assert lines[-1] == {"done": True, "status": "finished"}
+            assert lines[-1] == {"done": True, "status": "finished",
+                                 "trace_id": trace_id}
+            # every stream event carries the stitched-trace join key
+            assert all(ln["trace_id"] == trace_id for ln in lines)
             with urllib.request.urlopen(f"{base}/v1/result?id={rid}") as r:
                 result = json.loads(r.read())
             assert result["done"] and result["status"] == "finished"
+            assert result["trace_id"] == trace_id
+            # the fleet-side request carries the SAME id end to end
+            assert any(ev.get("trace_id") == trace_id
+                       for ev in fleet.recorder.events)
             ref = _ref_tokens(m, params, prompt, 6)
             np.testing.assert_array_equal(np.asarray(result["tokens"]),
                                           ref)

@@ -98,11 +98,24 @@ class TestWaterfall:
     def test_full_chain_telescopes(self):
         events = [_ev("submit", "A", 0), _ev("admit", "A", 2),
                   _ev("first_token", "A", 5),
+                  _ev("handoff_export", "A", 6),
                   _ev("handoff_inject", "A", 7),
                   _ev("finished", "A", 20)]
         row = per_request_breakdown(events)["requests"]["A"]
         assert (row["queue"], row["prefill"], row["handoff"],
-                row["decode"]) == (2, 3, 2, 13)
+                row["wire"], row["decode"]) == (2, 3, 1, 1, 13)
+        assert sum(row[s] for s in STAGES) == row["total_steps"] == 20
+
+    def test_missing_export_mark_folds_into_wire(self):
+        # a legacy recorder stream (no handoff_export event): handoff
+        # clamps to zero, wire absorbs the export->inject gap, and the
+        # telescoping invariant holds untouched
+        events = [_ev("submit", "A", 0), _ev("admit", "A", 2),
+                  _ev("first_token", "A", 5),
+                  _ev("handoff_inject", "A", 7),
+                  _ev("finished", "A", 20)]
+        row = per_request_breakdown(events)["requests"]["A"]
+        assert (row["handoff"], row["wire"]) == (0, 2)
         assert sum(row[s] for s in STAGES) == row["total_steps"] == 20
 
     def test_missing_marks_collapse_not_break(self):
@@ -155,6 +168,7 @@ class TestWaterfall:
             span("serving/queue_wait", "A", 1000.0, pid=0),
             span("serving/prefill_chunk", "A", 2000.0, pid=0),
             span("serving/prefill_chunk", "A", 2000.0, pid=0),
+            span("serving/handoff_export", "A", 300.0, pid=0),
             span("serving/handoff_inject", "A", 500.0, pid=1),
             span("serving/decode_residency", "A", 4000.0, pid=1),
             span("serving/decode_iter", "A", 9.0, pid=1),  # unstaged
@@ -164,7 +178,8 @@ class TestWaterfall:
         row = out["requests"]["A"]
         assert row["queue"] == pytest.approx(1.0)
         assert row["prefill"] == pytest.approx(4.0)
-        assert row["handoff"] == pytest.approx(0.5)
+        assert row["handoff"] == pytest.approx(0.3)
+        assert row["wire"] == pytest.approx(0.5)
         assert row["decode"] == pytest.approx(4.0)
         assert row["lanes"] == 2        # crossed a replica boundary
         assert out["unit"] == "ms"
